@@ -1,0 +1,314 @@
+"""Llama-family decoder-only transformer as pure JAX functions over a pytree.
+
+Design notes (TPU-first, not a torch translation):
+
+- Params are a plain nested dict whose paths mirror HF checkpoint names
+  (``model.layers.0.self_attn.q_proj`` ...), so safetensors import/export is a
+  rename-free transpose (models/hf_io.py) and sharding rules match on path.
+- No module framework: ``forward`` is a pure function — trivially jittable,
+  shardable with NamedSharding on the params pytree, and rematerializable per
+  block with ``jax.checkpoint`` (the analog of the reference's
+  ``gradient_checkpointing=True``, reference ``training.py:280``).
+- Master params stay float32; compute casts to bfloat16 at use (the MXU path).
+  Softmax/RMSNorm/RoPE run in float32.
+- Covers SmolLM3 (GQA + NoPE-interleaved RoPE + tied embeddings), Llama-3,
+  Mistral (sliding window) via ModelConfig — the model surface of the
+  reference's ``AutoModelForCausalLM`` usage (reference ``training.py:97-102``).
+
+Linear weights are stored in JAX kernel layout ``[in, out]`` under the leaf
+name ``kernel`` (transpose of torch ``weight``); norm/embedding leaves are
+``weight`` in torch layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+from llm_fine_tune_distributed_tpu.ops.attention import attention, xla_attention
+from llm_fine_tune_distributed_tpu.ops.norms import rms_norm
+from llm_fine_tune_distributed_tpu.ops.rope import apply_rope, rope_cos_sin
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
+    """Random init (normal 0.02, HF convention). Returns the params pytree."""
+    h = config.hidden_size
+    d = config.resolved_head_dim
+    qd, kvd = config.num_heads * d, config.num_kv_heads * d
+    f, v = config.intermediate_size, config.vocab_size
+
+    keys = iter(jax.random.split(rng, 2 + config.num_layers * 7))
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
+
+    layers = {}
+    for i in range(config.num_layers):
+        attn = {
+            "q_proj": {"kernel": dense(next(keys), (h, qd))},
+            "k_proj": {"kernel": dense(next(keys), (h, kvd))},
+            "v_proj": {"kernel": dense(next(keys), (h, kvd))},
+            "o_proj": {"kernel": dense(next(keys), (qd, h))},
+        }
+        if config.attention_bias:
+            # HF Llama applies attention_bias to q/k/v/o alike.
+            attn["q_proj"]["bias"] = jnp.zeros((qd,), dtype)
+            attn["k_proj"]["bias"] = jnp.zeros((kvd,), dtype)
+            attn["v_proj"]["bias"] = jnp.zeros((kvd,), dtype)
+            attn["o_proj"]["bias"] = jnp.zeros((h,), dtype)
+        mlp = {
+            "gate_proj": {"kernel": dense(next(keys), (h, f))},
+            "up_proj": {"kernel": dense(next(keys), (h, f))},
+            "down_proj": {"kernel": dense(next(keys), (f, h))},
+        }
+        if config.mlp_bias:
+            mlp["gate_proj"]["bias"] = jnp.zeros((f,), dtype)
+            mlp["up_proj"]["bias"] = jnp.zeros((f,), dtype)
+            mlp["down_proj"]["bias"] = jnp.zeros((h,), dtype)
+        layers[str(i)] = {
+            "input_layernorm": {"weight": jnp.ones((h,), dtype)},
+            "self_attn": attn,
+            "post_attention_layernorm": {"weight": jnp.ones((h,), dtype)},
+            "mlp": mlp,
+        }
+
+    params: Params = {
+        "model": {
+            "embed_tokens": {"weight": dense(next(keys), (v, h))},
+            "layers": layers,
+            "norm": {"weight": jnp.ones((h,), dtype)},
+        }
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense(next(keys), (h, v))}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _linear(x, p, compute_dtype):
+    """x @ kernel (+ bias), with optional additive LoRA branch.
+
+    LoRA params, when present (parallel/lora.py), live beside the kernel as
+    ``lora_a [in, r]`` / ``lora_b [r, out]`` and contribute
+    ``(alpha/r) * x @ A @ B`` (external-doc LoRA config: r=16, alpha=8).
+    """
+    y = x @ p["kernel"].astype(compute_dtype)
+    if "lora_a" in p:
+        a = p["lora_a"].astype(compute_dtype)
+        b = p["lora_b"].astype(compute_dtype)
+        y = y + (x @ a) @ b * p["lora_scale"].astype(compute_dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(compute_dtype)
+    return y
+
+
+def _block(
+    lp: Params,
+    x,
+    cos,
+    sin,
+    padding_mask,
+    explicit_mask,
+    cache_entry,
+    cache_pos,
+    *,
+    config: ModelConfig,
+    layer_idx: int,
+    attention_impl: str,
+    compute_dtype,
+):
+    """One transformer block. Returns (x, new_cache_entry)."""
+    b, s, h = x.shape
+    d = config.resolved_head_dim
+    eps = config.rms_norm_eps
+    attn_p = lp["self_attn"]
+
+    hid = rms_norm(x, lp["input_layernorm"]["weight"], eps)
+    q = _linear(hid, attn_p["q_proj"], compute_dtype).reshape(b, s, config.num_heads, d)
+    k = _linear(hid, attn_p["k_proj"], compute_dtype).reshape(b, s, config.num_kv_heads, d)
+    v = _linear(hid, attn_p["v_proj"], compute_dtype).reshape(b, s, config.num_kv_heads, d)
+
+    if config.uses_rope(layer_idx):
+        q, k = apply_rope(q, k, cos, sin)
+
+    new_entry = None
+    if cache_entry is not None:
+        # Decode/prefill with a fixed-size KV buffer: write k,v at cache_pos.
+        ck = jax.lax.dynamic_update_slice(cache_entry["k"], k.astype(cache_entry["k"].dtype), (0, cache_pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cache_entry["v"], v.astype(cache_entry["v"].dtype), (0, cache_pos, 0, 0))
+        new_entry = {"k": ck, "v": cv}
+        k, v = ck, cv
+
+    if explicit_mask is not None:
+        out = xla_attention(q, k, v, mask=explicit_mask, causal=False)
+    else:
+        out = attention(
+            q,
+            k,
+            v,
+            impl=attention_impl,
+            padding_mask=padding_mask,
+            causal=True,
+            sliding_window=config.sliding_window,
+        )
+
+    out = out.reshape(b, s, config.num_heads * d)
+    x = x + _linear(out, attn_p["o_proj"], compute_dtype)
+
+    hid = rms_norm(x, lp["post_attention_layernorm"]["weight"], eps)
+    gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype)
+    up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype)
+    x = x + _linear(jax.nn.silu(gate) * up, lp["mlp"]["down_proj"], compute_dtype)
+    return x, new_entry
+
+
+def forward(
+    params: Params,
+    input_ids,
+    config: ModelConfig,
+    *,
+    positions=None,
+    padding_mask=None,
+    cache: Optional[Dict[str, Any]] = None,
+    cache_pos: int | jax.Array = 0,
+    attention_impl: str = "xla",
+    compute_dtype=jnp.bfloat16,
+    remat: bool = False,
+    logits_dtype=jnp.float32,
+    activation_sharding=None,
+) -> Tuple[jax.Array, Optional[Dict[str, Any]]]:
+    """Run the model.
+
+    Args:
+      input_ids: int32 [batch, seq].
+      positions: int32 [batch, seq] absolute positions (default arange, or
+        cache_pos offset when a cache is passed).
+      padding_mask: [batch, seq] 1=real token (training path).
+      cache: optional KV cache dict (see ``init_cache``); when given,
+        attention runs over the full cache buffer with a position mask.
+      cache_pos: scalar — where this chunk starts in the cache.
+      remat: rematerialize each block on backward
+        (analog of reference ``gradient_checkpointing=True``, training.py:280).
+      activation_sharding: optional ``NamedSharding`` for the [batch, seq,
+        hidden] activations (normally batch over (data, fsdp)). Constraining
+        activations explicitly keeps XLA/Shardy propagation on the intended
+        layout — without it, propagation can try to shard the hidden dim with
+        the same axis as the batch dim and fail (or silently pick a slow
+        layout). Set by the trainer whenever a mesh is in use.
+
+    Returns:
+      (logits [batch, seq, vocab] in ``logits_dtype``, updated cache or None).
+    """
+    b, s = input_ids.shape
+    if positions is None:
+        positions = jnp.arange(s, dtype=jnp.int32)[None, :] + cache_pos
+        positions = jnp.broadcast_to(positions, (b, s))
+
+    def constrain(h):
+        if activation_sharding is not None:
+            return jax.lax.with_sharding_constraint(h, activation_sharding)
+        return h
+
+    embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
+    x = constrain(embed[input_ids])
+    cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
+
+    explicit_mask = None
+    if cache is not None:
+        # Mask over the fixed-size buffer: key j visible to query i iff
+        # j <= position(i), and within the sliding window if configured.
+        kv_len = cache["layers"]["0"]["k"].shape[1]
+        k_pos = jnp.arange(kv_len, dtype=jnp.int32)[None, None, :]
+        q_pos = positions[:, :, None]
+        explicit_mask = k_pos <= q_pos
+        if config.sliding_window is not None:
+            explicit_mask &= k_pos > q_pos - config.sliding_window
+        if padding_mask is not None:
+            # With a cache, padding_mask must cover the WHOLE buffer
+            # [batch, kv_len] (1 = real token at that cache slot), so batched
+            # generate over ragged prompts can mask pad keys already written.
+            if padding_mask.shape[-1] != kv_len:
+                raise ValueError(
+                    f"with a KV cache, padding_mask must be [batch, {kv_len}] "
+                    f"(full buffer), got {padding_mask.shape}"
+                )
+            explicit_mask &= padding_mask.astype(bool)[:, None, :]
+
+    new_layers = {}
+    for i in range(config.num_layers):
+        entry = cache["layers"][str(i)] if cache is not None else None
+        block_fn = partial(
+            _block,
+            config=config,
+            layer_idx=i,
+            attention_impl=attention_impl,
+            compute_dtype=compute_dtype,
+        )
+        if remat and cache is None:
+            block_fn = jax.checkpoint(block_fn)
+        x, new_entry = block_fn(
+            params["model"]["layers"][str(i)],
+            x,
+            cos,
+            sin,
+            padding_mask,
+            explicit_mask,
+            entry,
+            cache_pos,
+        )
+        x = constrain(x)
+        if new_entry is not None:
+            new_layers[str(i)] = new_entry
+
+    x = rms_norm(x, params["model"]["norm"]["weight"], config.rms_norm_eps)
+
+    if config.tie_word_embeddings:
+        embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
+        logits = jnp.einsum("bsh,vh->bsv", x, embed)
+    else:
+        logits = x @ params["lm_head"]["kernel"].astype(compute_dtype)
+
+    new_cache = None
+    if cache is not None:
+        new_cache = {"layers": new_layers}
+    return logits.astype(logits_dtype), new_cache
+
+
+def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+    """Fixed-size KV cache buffers for autoregressive decoding."""
+    d = config.resolved_head_dim
+    shape = (batch_size, max_len, config.num_kv_heads, d)
+    return {
+        "layers": {
+            str(i): {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for i in range(config.num_layers)
+        }
+    }
+
+
+class TransformerLM:
+    """Thin OO facade over the functional API (convenience for scripts)."""
+
+    def __init__(self, config: ModelConfig):
+        self.config = config
+
+    def init(self, rng, dtype=jnp.float32) -> Params:
+        return init_params(rng, self.config, dtype)
+
+    def apply(self, params, input_ids, **kw):
+        return forward(params, input_ids, self.config, **kw)

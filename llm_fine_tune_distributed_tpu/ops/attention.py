@@ -1,0 +1,106 @@
+"""Attention implementations and dispatch.
+
+Replaces the reference's flash-attn-2 CUDA kernels
+(reference ``requirements.txt:10``, ``training.py:101``) with TPU paths:
+
+- ``"xla"``:   plain masked attention — XLA fuses this well at seq<=1024 and it
+               is the numerically-transparent fallback.
+- ``"flash"``: Pallas (Mosaic) blockwise flash attention kernel (ops/flash_attention.py).
+- ``"ring"``:  ring attention over a sequence-parallel mesh axis (parallel/ring_attention.py),
+               selected by the trainer when mesh.seq > 1.
+
+All implementations take/return the same layout:
+  q: [batch, q_len, num_heads, head_dim]
+  k,v: [batch, kv_len, num_kv_heads, head_dim]   (GQA: num_heads % num_kv_heads == 0)
+and compute softmax in float32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -2.0e38  # large finite negative; avoids NaN from (-inf) - (-inf)
+
+
+def _causal_mask(q_len: int, kv_len: int, sliding_window: Optional[int] = None):
+    """[q_len, kv_len] bool mask; True = attend. Supports decode offset where
+    q positions are the last q_len of kv_len."""
+    q_pos = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+    k_pos = jnp.arange(kv_len)[None, :]
+    mask = k_pos <= q_pos
+    if sliding_window is not None:
+        mask &= k_pos > q_pos - sliding_window
+    return mask
+
+
+def xla_attention(
+    q,
+    k,
+    v,
+    *,
+    padding_mask=None,
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    mask=None,
+):
+    """Reference masked attention with GQA, f32 softmax.
+
+    padding_mask: optional [batch, kv_len] bool/int, 1 = real token.
+    mask: optional explicit [batch, q_len, kv_len] bool mask (True = attend);
+      when given it replaces the causal mask (used by the KV-cache decode path).
+    """
+    b, q_len, num_heads, head_dim = q.shape
+    kv_len, num_kv = k.shape[1], k.shape[2]
+    groups = num_heads // num_kv
+
+    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+    # [b, q, kv_heads, groups, d]
+    qg = q.reshape(b, q_len, num_kv, groups, head_dim)
+    # scores: [b, kv_heads, groups, q, kv]
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * scale
+
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
+    elif causal:
+        cmask = _causal_mask(q_len, kv_len, sliding_window)
+        scores = jnp.where(cmask[None, None, None], scores, _NEG_INF)
+    if padding_mask is not None:
+        pm = padding_mask.astype(bool)[:, None, None, None, :]
+        scores = jnp.where(pm, scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, q_len, num_heads, head_dim).astype(q.dtype)
+
+
+def attention(
+    q,
+    k,
+    v,
+    *,
+    impl: str = "xla",
+    padding_mask=None,
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+):
+    """Dispatch to the selected attention implementation."""
+    if impl == "flash":
+        # Pallas kernel requires TPU, no sliding window (falls back otherwise).
+        from llm_fine_tune_distributed_tpu.ops.flash_attention import (
+            flash_attention_supported,
+            pallas_flash_attention,
+        )
+
+        if flash_attention_supported(q, k, v, sliding_window=sliding_window, causal=causal):
+            return pallas_flash_attention(q, k, v, padding_mask=padding_mask)
+        impl = "xla"
+    if impl == "xla":
+        return xla_attention(
+            q, k, v, padding_mask=padding_mask, causal=causal, sliding_window=sliding_window
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")

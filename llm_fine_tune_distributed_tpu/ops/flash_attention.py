@@ -1,0 +1,23 @@
+"""Pallas (Mosaic) flash attention for TPU.
+
+TPU-native replacement for the reference's flash-attn-2 CUDA kernels
+(reference ``requirements.txt:10``, ``training.py:101``). Blockwise-softmax
+attention computed in VMEM tiles so the [seq, seq] score matrix never
+materializes in HBM.
+
+Implemented in a later milestone; until then ``flash_attention_supported``
+returns False and the dispatcher (ops/attention.py) falls back to XLA
+attention, which is numerically identical.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def flash_attention_supported(q, k, v, *, sliding_window=None, causal=True) -> bool:
+    return False
+
+
+def pallas_flash_attention(q, k, v, *, padding_mask=None):
+    raise NotImplementedError("pallas flash attention lands in a later milestone")

@@ -1,0 +1,64 @@
+"""Optimizer chain — the first-party replacement for what the reference gets
+from HF Trainer's create_optimizer/scheduler inside TRL (C9):
+
+  AdamW + linear-decay-to-zero schedule (HF default ``lr_scheduler_type``),
+  global-norm clip 1.0 (reference ``training.py:264``),
+  lr x data_parallel_size scaling (reference ``training.py:263``),
+  frozen params get NO optimizer state (optax.multi_transform) — preserving
+  the memory profile of the freezing policy (C5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import optax
+
+from llm_fine_tune_distributed_tpu.config import TrainConfig
+
+
+def build_lr_schedule(config: TrainConfig, total_steps: int, data_parallel_size: int):
+    peak = config.scaled_learning_rate(data_parallel_size)
+    warmup = int(total_steps * config.warmup_ratio)
+    if config.lr_schedule == "constant":
+        return optax.constant_schedule(peak)
+    if config.lr_schedule == "linear":
+        # HF default: optional warmup, then linear decay to 0 over total steps.
+        if warmup > 0:
+            return optax.join_schedules(
+                [
+                    optax.linear_schedule(0.0, peak, warmup),
+                    optax.linear_schedule(peak, 0.0, max(total_steps - warmup, 1)),
+                ],
+                [warmup],
+            )
+        return optax.linear_schedule(peak, 0.0, max(total_steps, 1))
+    if config.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, peak, max(warmup, 1), max(total_steps, 2)
+        )
+    raise ValueError(f"unknown lr_schedule {config.lr_schedule!r}")
+
+
+def build_optimizer(
+    config: TrainConfig,
+    trainable_mask,
+    total_steps: int,
+    data_parallel_size: int,
+) -> optax.GradientTransformation:
+    schedule = build_lr_schedule(config, total_steps, data_parallel_size)
+    inner = optax.chain(
+        optax.clip_by_global_norm(config.max_grad_norm),
+        optax.adamw(
+            learning_rate=schedule,
+            b1=config.adam_b1,
+            b2=config.adam_b2,
+            eps=config.adam_eps,
+            weight_decay=config.weight_decay,
+        ),
+    )
+    labels = jax.tree.map(lambda t: "train" if t else "freeze", trainable_mask)
+    return optax.multi_transform(
+        {"train": inner, "freeze": optax.set_to_zero()}, labels
+    )

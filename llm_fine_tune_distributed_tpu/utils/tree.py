@@ -1,0 +1,43 @@
+"""Pytree helpers keyed by parameter path (used by freezing, sharding, LoRA)."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Tuple
+
+import jax
+import numpy as np
+
+
+def _path_str(key_path) -> str:
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def tree_paths(tree) -> list:
+    """List of (path_str, leaf) for every leaf."""
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    return [(_path_str(kp), leaf) for kp, leaf in leaves]
+
+
+def map_with_path(fn: Callable[[str, object], object], tree):
+    """tree_map where fn receives ('model/layers/0/self_attn/q_proj/kernel', leaf)."""
+    return jax.tree_util.tree_map_with_path(lambda kp, leaf: fn(_path_str(kp), leaf), tree)
+
+
+def count_params(tree) -> int:
+    return sum(int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def count_params_where(tree, predicate: Callable[[str], bool]) -> int:
+    total = 0
+    for path, leaf in tree_paths(tree):
+        if predicate(path):
+            total += int(np.prod(leaf.shape))
+    return total

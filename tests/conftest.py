@@ -1,0 +1,28 @@
+"""Test environment: 8 virtual CPU devices (the JAX-native 'fake backend' the
+reference lacks — SURVEY.md §4). Must run before jax initializes."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["HF_HUB_OFFLINE"] = "1"
+os.environ["TRANSFORMERS_OFFLINE"] = "1"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# In some environments a sitecustomize imports jax at interpreter startup and
+# pins JAX_PLATFORMS to a hardware plugin; the config update below overrides
+# it even then (the env assignment above only helps fresh interpreters).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return devs

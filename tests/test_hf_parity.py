@@ -1,0 +1,102 @@
+"""Numerics parity against the HF torch implementation (SURVEY.md §7.3 risk #1).
+
+Builds a tiny randomly-initialized HF SmolLM3 (and Llama/Mistral) torch model,
+round-trips its state dict through our safetensors bridge, and asserts logits
+match in float32. This gates RoPE convention (rotate_half), the NoPE layer
+pattern, GQA, RMSNorm semantics, and weight transposition all at once.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from llm_fine_tune_distributed_tpu.models.configs import from_hf_config  # noqa: E402
+from llm_fine_tune_distributed_tpu.models.hf_io import hf_state_dict_to_pytree  # noqa: E402
+from llm_fine_tune_distributed_tpu.models.transformer import forward  # noqa: E402
+
+
+def _torch_state_to_numpy(model):
+    state = {}
+    for k, v in model.state_dict().items():
+        if k.endswith("rotary_emb.inv_freq"):
+            continue
+        state[k.replace("model.model.", "model.")] = v.detach().to(torch.float32).numpy()
+    return state
+
+
+def _compare(hf_model, hf_config, seq=12, atol=2e-4):
+    cfg = from_hf_config(hf_config)
+    state = _torch_state_to_numpy(hf_model)
+    params = hf_state_dict_to_pytree(state, cfg, dtype=np.float32)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(2, seq))
+
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.to(torch.float32).numpy()
+
+    ours, _ = forward(params, jnp.asarray(ids, jnp.int32), cfg, compute_dtype=jnp.float32)
+    ours = np.asarray(ours)
+
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=atol)
+
+
+def test_smollm3_tiny_logit_parity():
+    hf_cfg = transformers.SmolLM3Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=5,  # includes one NoPE layer (layer idx 3)
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=True,
+        rope_theta=10000.0,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    model = transformers.SmolLM3ForCausalLM(hf_cfg).eval()
+    _compare(model, hf_cfg)
+
+
+def test_llama_tiny_logit_parity():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        attention_bias=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    _compare(model, hf_cfg)
+
+
+def test_mistral_tiny_logit_parity_with_sliding_window():
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        sliding_window=8,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(2)
+    model = transformers.MistralForCausalLM(hf_cfg).eval()
+    _compare(model, hf_cfg, seq=16)

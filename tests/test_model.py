@@ -1,0 +1,108 @@
+"""Model core tests: shapes, param counts, KV-cache consistency, presets."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_fine_tune_distributed_tpu.models import get_preset, init_params
+from llm_fine_tune_distributed_tpu.models.transformer import forward, init_cache
+from llm_fine_tune_distributed_tpu.utils.tree import count_params
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_param_count_matches_formula(tiny):
+    cfg, params = tiny
+    assert count_params(params) == cfg.num_params
+
+
+def test_smollm3_param_count_is_3b():
+    # claude.md:243 reports 3.075B total params for SmolLM3-3B.
+    cfg = get_preset("smollm3_3b")
+    assert abs(cfg.num_params - 3.075e9) / 3.075e9 < 0.01
+
+
+def test_forward_shapes_and_dtype(tiny):
+    cfg, params = tiny
+    ids = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], dtype=jnp.int32)
+    logits, cache = forward(params, ids, cfg, compute_dtype=jnp.float32)
+    assert logits.shape == (1, 8, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert cache is None
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_padding_mask_changes_nothing_for_valid_tokens(tiny):
+    """Causal attention: masking out future padding must not change logits of
+    real positions."""
+    cfg, params = tiny
+    ids_full = jnp.array([[5, 6, 7, 8, 1, 1, 1, 1]], dtype=jnp.int32)
+    mask = jnp.array([[1, 1, 1, 1, 0, 0, 0, 0]], dtype=jnp.int32)
+    lg_masked, _ = forward(params, ids_full, cfg, padding_mask=mask, compute_dtype=jnp.float32)
+    lg_plain, _ = forward(params, ids_full[:, :4], cfg, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(lg_masked[:, :4]), np.asarray(lg_plain), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_kv_cache_decode_matches_full_forward(tiny):
+    """Prefill + one-token-at-a-time decode must reproduce the full forward
+    pass logits (the correctness gate for infer/generate.py)."""
+    cfg, params = tiny
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, cfg.vocab_size)
+    full_logits, _ = forward(params, ids, cfg, compute_dtype=jnp.float32)
+
+    cache = init_cache(cfg, batch_size=2, max_len=16, dtype=jnp.float32)
+    prefill_len = 6
+    lg, cache = forward(
+        params, ids[:, :prefill_len], cfg, cache=cache, cache_pos=0, compute_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(full_logits[:, :prefill_len]), rtol=2e-4, atol=2e-4
+    )
+    for t in range(prefill_len, 10):
+        lg, cache = forward(
+            params, ids[:, t : t + 1], cfg, cache=cache, cache_pos=t, compute_dtype=jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full_logits[:, t]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_remat_matches_no_remat(tiny):
+    cfg, params = tiny
+    ids = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
+
+    def loss(p, remat):
+        lg, _ = forward(p, ids, cfg, compute_dtype=jnp.float32, remat=remat)
+        return jnp.mean(lg**2)
+
+    g1 = jax.grad(lambda p: loss(p, False))(params)
+    g2 = jax.grad(lambda p: loss(p, True))(params)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    flat2 = jax.tree_util.tree_leaves(g2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_untied_and_sliding_window_preset():
+    cfg = get_preset("tiny_mistral")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert "lm_head" in params
+    ids = jnp.ones((1, 8), dtype=jnp.int32)
+    logits, _ = forward(params, ids, cfg, compute_dtype=jnp.float32)
+    assert logits.shape == (1, 8, cfg.vocab_size)
+
+
+def test_smollm3_nope_pattern():
+    cfg = get_preset("smollm3_3b")
+    # every 4th layer (1-indexed) has NO rope — HF SmolLM3Config convention.
+    assert not cfg.uses_rope(3) and not cfg.uses_rope(7) and not cfg.uses_rope(35)
+    assert cfg.uses_rope(0) and cfg.uses_rope(34)
+    assert sum(cfg.no_rope_layers) == 27
