@@ -135,8 +135,17 @@ def build_sft_arrays(
         )
         for r in rows
     ]
+    input_ids = np.stack([e.input_ids for e in examples])
+    lengths = np.asarray([e.length for e in examples], dtype=np.int32)
+    # attention_mask: 1 where the token is real (not right-padding) — the
+    # collator behavior the reference inherits from HF (pad excluded from
+    # attention, reference training.py:92-94 pad=eos + right padding).
+    attention_mask = (
+        np.arange(input_ids.shape[1])[None, :] < lengths[:, None]
+    ).astype(np.float32)
     return {
-        "input_ids": np.stack([e.input_ids for e in examples]),
+        "input_ids": input_ids,
         "loss_mask": np.stack([e.loss_mask for e in examples]),
-        "lengths": np.asarray([e.length for e in examples], dtype=np.int32),
+        "attention_mask": attention_mask,
+        "lengths": lengths,
     }

@@ -85,6 +85,7 @@ class SFTBatchLoader:
             yield {
                 "input_ids": self.arrays["input_ids"][idx],
                 "loss_mask": self.arrays["loss_mask"][idx],
+                "attention_mask": self.arrays["attention_mask"][idx],
             }
 
     def __len__(self) -> int:

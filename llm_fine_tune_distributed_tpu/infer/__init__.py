@@ -1,0 +1,6 @@
+from llm_fine_tune_distributed_tpu.infer.generate import generate, GenerationParams  # noqa: F401
+from llm_fine_tune_distributed_tpu.infer.loading import load_model_dir  # noqa: F401
+from llm_fine_tune_distributed_tpu.infer.chat import (  # noqa: F401
+    build_chat_prompt,
+    extract_assistant_response,
+)

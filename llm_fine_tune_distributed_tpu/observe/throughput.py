@@ -1,0 +1,37 @@
+"""Samples/sec/chip instrumentation — first-class because it IS the
+north-star metric (BASELINE.json; the reference only surfaces HF's
+``train_samples_per_second`` in Aim, ``docs/AIM_WORKFLOW.md:42-43``)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class ThroughputMeter:
+    def __init__(self, n_chips: int, tokens_per_sample: Optional[int] = None):
+        self.n_chips = max(n_chips, 1)
+        self.tokens_per_sample = tokens_per_sample
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._samples = 0
+        self._steps = 0
+
+    def update(self, samples: int) -> None:
+        self._samples += samples
+        self._steps += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        sps = self._samples / dt
+        out = {
+            "samples_per_second": sps,
+            "samples_per_second_per_chip": sps / self.n_chips,
+            "steps_per_second": self._steps / dt,
+            "elapsed_seconds": dt,
+        }
+        if self.tokens_per_sample:
+            out["tokens_per_second_per_chip"] = sps * self.tokens_per_sample / self.n_chips
+        return out

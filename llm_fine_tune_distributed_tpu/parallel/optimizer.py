@@ -43,10 +43,19 @@ def build_lr_schedule(config: TrainConfig, total_steps: int, data_parallel_size:
 
 def build_optimizer(
     config: TrainConfig,
-    trainable_mask,
+    trainable_mask=None,
+    *,
     total_steps: int,
     data_parallel_size: int,
 ) -> optax.GradientTransformation:
+    """AdamW chain.
+
+    The trainer normally partitions params into trainable/frozen pytrees
+    up front (utils/tree.py:split_by_mask) and applies this optimizer to the
+    trainable subset only — pass ``trainable_mask=None`` for that. Passing a
+    boolean mask pytree instead wraps the chain in ``optax.multi_transform``
+    so frozen leaves get no state (for callers that keep one joint pytree).
+    """
     schedule = build_lr_schedule(config, total_steps, data_parallel_size)
     inner = optax.chain(
         optax.clip_by_global_norm(config.max_grad_norm),
@@ -58,6 +67,8 @@ def build_optimizer(
             weight_decay=config.weight_decay,
         ),
     )
+    if trainable_mask is None:
+        return inner
     labels = jax.tree.map(lambda t: "train" if t else "freeze", trainable_mask)
     return optax.multi_transform(
         {"train": inner, "freeze": optax.set_to_zero()}, labels

@@ -38,7 +38,22 @@ def make_mesh(
     config = config or MeshConfig()
     if devices is None:
         devices = jax.devices()
-    sizes = config.axis_sizes(len(devices))
+    try:
+        sizes = config.axis_sizes(len(devices))
+    except ValueError:
+        # Fully-specified mesh smaller than the device pool: use a prefix of
+        # the devices (tests / deliberate under-subscription).
+        explicit = {"data": config.data, "fsdp": config.fsdp,
+                    "tensor": config.tensor, "seq": config.seq}
+        if -1 in explicit.values():
+            raise
+        product = 1
+        for v in explicit.values():
+            product *= v
+        if product > len(devices):
+            raise
+        devices = list(devices)[:product]
+        sizes = config.axis_sizes(product)
     shape = tuple(sizes[a] for a in MESH_AXES)
     # Auto axis types: sharding propagates GSPMD/Shardy-style from the
     # annotations on params/batch plus with_sharding_constraint points.

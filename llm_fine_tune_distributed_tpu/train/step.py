@@ -1,0 +1,130 @@
+"""The jit-compiled train/eval steps — the hot loop the reference delegates to
+TRL/HF (``trainer.train()``, reference ``training.py:300``; loop anatomy in
+SURVEY.md §3.1). One XLA program per optimizer step:
+
+  scan over grad-accum microbatches (fwd+bwd, remat'd blocks)
+  -> mean grads -> clip(1.0) -> AdamW on trainable subset -> new state
+
+Gradient synchronization across data-parallel devices is NOT explicit: the
+loss averages over the (sharded) global microbatch, so jax.grad's psum is
+emitted by XLA from the sharding annotations — the compiler-native equivalent
+of DDP's bucketed NCCL all-reduce (reference ``docs/architecture-diagram.md:119-135``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig, TrainConfig, str_to_dtype
+from llm_fine_tune_distributed_tpu.models.transformer import forward
+from llm_fine_tune_distributed_tpu.train.state import TrainState
+from llm_fine_tune_distributed_tpu.utils.tree import merge_flat
+
+
+def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activation_sharding=None):
+    compute_dtype = str_to_dtype(train_config.compute_dtype)
+
+    def loss_fn(trainable, frozen, batch):
+        """Masked next-token cross-entropy (token-mean within the batch) —
+        the SFT objective TRL computes for packing=False full-sequence LM
+        loss (reference ``training.py:282-283``). Returns (loss, token_count)."""
+        params = merge_flat(trainable, frozen)
+        logits, _ = forward(
+            params,
+            batch["input_ids"],
+            model_config,
+            padding_mask=batch["attention_mask"],
+            attention_impl=train_config.attention_impl,
+            compute_dtype=compute_dtype,
+            remat=train_config.gradient_checkpointing,
+            activation_sharding=activation_sharding,
+            logits_dtype=jnp.float32,
+        )
+        targets = batch["input_ids"][:, 1:]
+        mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
+        tokens = jnp.maximum(mask.sum(), 1.0)
+        loss = (ce * mask).sum() / tokens
+        return loss, tokens
+
+    return loss_fn
+
+
+def build_train_step(
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    optimizer: optax.GradientTransformation,
+    activation_sharding=None,
+) -> Callable:
+    """Returns train_step(state, batch) -> (state, metrics).
+
+    ``batch`` arrays are [grad_accum, per_device_or_host_batch, seq]; the
+    accumulation loop is a lax.scan so XLA compiles ONE program regardless of
+    the accumulation factor (reference ``gradient_accumulation_steps=4``,
+    ``training.py:262``).
+    """
+    loss_fn = make_loss_fn(model_config, train_config, activation_sharding)
+    accum = train_config.gradient_accumulation_steps
+
+    def train_step(state: TrainState, batch):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def micro_step(carry, micro):
+            g_acc, loss_acc = carry
+            (loss, _tokens), grads = grad_fn(state.trainable, state.frozen, micro)
+            g_acc = jax.tree.map(jnp.add, g_acc, grads)
+            return (g_acc, loss_acc + loss), None
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.trainable)
+        (g_sum, loss_sum), _ = jax.lax.scan(micro_step, (zeros, jnp.float32(0.0)), batch)
+
+        # Mean over accumulation steps (HF semantics: mean of microbatch means).
+        grads = jax.tree.map(lambda g: g / accum, g_sum)
+        loss = loss_sum / accum
+
+        grad_norm = optax.global_norm(grads)  # pre-clip, matches HF's logged grad_norm
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.trainable)
+        new_trainable = optax.apply_updates(state.trainable, updates)
+
+        new_state = state.replace(
+            step=state.step + 1,
+            trainable=new_trainable,
+            opt_state=new_opt_state,
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+        }
+        return new_state, metrics
+
+    return train_step
+
+
+def build_eval_step(
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    activation_sharding=None,
+) -> Callable:
+    """eval_step(state, batch[b, s]) -> (sum_ce, token_count).
+
+    Returns sums (not means) so the caller aggregates a token-weighted eval
+    loss over the whole validation set — the quantity behind
+    ``eval_loss``/best-model tracking (reference ``training.py:273-275``)."""
+    loss_fn = make_loss_fn(model_config, train_config, activation_sharding)
+
+    def eval_step(state: TrainState, batch):
+        loss, tokens = loss_fn(state.trainable, state.frozen, batch)
+        return loss * tokens, tokens
+
+    return eval_step
+
+
+def jit_train_step(train_step, donate_state: bool = True):
+    """Jit with state donation — the step's output state reuses the input
+    buffers (param + opt-state memory is not duplicated during the update)."""
+    return jax.jit(train_step, donate_argnums=(0,) if donate_state else ())

@@ -1,0 +1,452 @@
+"""First-party SFT trainer — the replacement for the reference's entire
+L1 delegation to TRL SFTTrainer + Accelerate (reference ``training.py:289-300``
+and SURVEY.md §3.1 hot loop).
+
+End-to-end responsibilities (reference parity points cited inline):
+- model init or HF-checkpoint load, bf16 compute (``training.py:97-102``)
+- freezing policy: last-2 blocks + lm_head (``training.py:113-149``)
+- dataset: parquet -> 90/10 seed-42 split -> ChatML (``training.py:155-212``)
+- jitted train loop: grad-accum 4, clip 1.0, lr x dp_size, linear decay
+  (``training.py:258-287``), eval every 10 steps, log every 2 + first
+  (``training.py:266-271``)
+- best-eval-loss tracking + load-best-at-end (``training.py:273-275``)
+- Orbax checkpoint rotation keep-3 (``training.py:268,276``) + explicit resume
+  (absent in the reference, SURVEY.md §5.4)
+- host-0 artifact contract: ``best_model/`` safetensors + tokenizer,
+  ``training_history.json``, ``training_summary.json`` (``training.py:307-339``)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig, TrainConfig, str_to_dtype
+from llm_fine_tune_distributed_tpu.data.dataset import (
+    build_sft_arrays,
+    load_qa_dataset,
+    train_validation_split,
+)
+from llm_fine_tune_distributed_tpu.data.loader import SFTBatchLoader
+from llm_fine_tune_distributed_tpu.data.tokenizer import load_tokenizer
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.hf_io import load_hf_checkpoint, save_hf_checkpoint
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.observe.metrics import MetricLogger
+from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter
+from llm_fine_tune_distributed_tpu.parallel.freeze import describe_trainable, trainable_mask
+from llm_fine_tune_distributed_tpu.parallel.optimizer import build_lr_schedule, build_optimizer
+from llm_fine_tune_distributed_tpu.parallel.sharding import param_spec
+from llm_fine_tune_distributed_tpu.runtime.distributed import (
+    device_preflight,
+    is_primary_host,
+)
+from llm_fine_tune_distributed_tpu.runtime.mesh import data_parallel_size, make_mesh
+from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+from llm_fine_tune_distributed_tpu.train.state import TrainState
+from llm_fine_tune_distributed_tpu.train.step import (
+    build_eval_step,
+    build_train_step,
+    jit_train_step,
+)
+from llm_fine_tune_distributed_tpu.utils.tree import merge_flat, split_by_mask
+
+
+class SFTTrainer:
+    def __init__(
+        self,
+        config: TrainConfig,
+        model_config: Optional[ModelConfig] = None,
+        tokenizer=None,
+        mesh=None,
+        rng_seed: Optional[int] = None,
+    ):
+        self.config = config
+        self.model_config = model_config or get_preset(config.model_preset)
+        self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
+        self.dp_size = data_parallel_size(self.mesh)
+        self.tokenizer = tokenizer or load_tokenizer(
+            config.tokenizer_path or config.model_name
+        )
+        self.rng = jax.random.PRNGKey(config.seed if rng_seed is None else rng_seed)
+        self.metrics = MetricLogger(
+            config.output_dir,
+            aim_repo=config.aim_repo,
+            experiment=config.experiment_name,
+        )
+        if is_primary_host():
+            os.makedirs(os.path.join(config.output_dir, "best_model"), exist_ok=True)
+        device_preflight()
+
+        self._prepare_data()
+        self._prepare_state()
+        self._prepare_steps()
+
+    # ------------------------------------------------------------------ data
+
+    def _prepare_data(self) -> None:
+        cfg = self.config
+        dataset_path = os.path.join(cfg.data_dir, cfg.dataset_file)
+        rows = load_qa_dataset(dataset_path)
+        if is_primary_host():
+            print(f"Total dataset size: {len(rows):,} Q&A pairs")
+        train_rows, val_rows = train_validation_split(
+            rows, test_size=cfg.validation_fraction, seed=cfg.split_seed
+        )
+        self.n_train, self.n_val = len(train_rows), len(val_rows)
+        if is_primary_host():
+            print(f"Training samples: {self.n_train:,}")
+            print(f"Validation samples: {self.n_val:,}")
+
+        self.train_arrays = build_sft_arrays(
+            train_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss
+        )
+        self.val_arrays = build_sft_arrays(
+            val_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss
+        )
+        self.loader = SFTBatchLoader(
+            self.train_arrays,
+            per_device_batch_size=cfg.per_device_batch_size,
+            grad_accum_steps=cfg.gradient_accumulation_steps,
+            data_parallel_size=self.dp_size,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            seed=cfg.seed,
+            drop_last=cfg.drop_last,
+        )
+        self.steps_per_epoch = self.loader.steps_per_epoch
+        self.total_steps = self.steps_per_epoch * cfg.epochs
+
+    # ----------------------------------------------------------------- state
+
+    def _load_or_init_params(self):
+        cfg, mc = self.config, self.model_config
+        compute_dtype = str_to_dtype(cfg.compute_dtype)
+        source = cfg.model_name
+        if source and (os.path.isdir(source) or source.endswith(".safetensors")):
+            if is_primary_host():
+                print(f"Loading model weights from: {source}")
+            return load_hf_checkpoint(source, mc, dtype=np.float32)
+        if is_primary_host():
+            print(
+                f"No local checkpoint at {source!r}; random-initializing "
+                f"{mc.name} ({mc.num_params:,} params)"
+            )
+        return init_params(self.rng, mc, dtype=jnp.float32)
+
+    def _prepare_state(self) -> None:
+        cfg, mc = self.config, self.model_config
+        params = self._load_or_init_params()
+        mask = trainable_mask(params, mc, cfg)
+        self.trainable_report = describe_trainable(params, mask)
+        if is_primary_host():
+            r = self.trainable_report
+            print(
+                f"Trainable: {r['trainable_parameters']:,}/{r['total_parameters']:,} "
+                f"({r['trainable_percent']}%)"
+            )
+
+        trainable, frozen = split_by_mask(params, mask)
+        del params
+        param_dtype = str_to_dtype(cfg.param_dtype)
+        compute_dtype = str_to_dtype(cfg.compute_dtype)
+        # Master copies: trainable in f32, frozen in compute dtype (bf16) —
+        # frozen params carry no optimizer state and need no f32 master.
+        trainable = {k: jnp.asarray(v, param_dtype) for k, v in trainable.items()}
+        frozen = {k: jnp.asarray(v, compute_dtype) for k, v in frozen.items()}
+
+        # Shard onto the mesh per path rules.
+        def put(flat):
+            return {
+                k: jax.device_put(
+                    v,
+                    NamedSharding(
+                        self.mesh, self._validated_spec(k, v)
+                    ),
+                )
+                for k, v in flat.items()
+            }
+
+        trainable = put(trainable)
+        frozen = put(frozen)
+
+        self.optimizer = build_optimizer(
+            cfg, None, total_steps=self.total_steps, data_parallel_size=self.dp_size
+        )
+        opt_state = jax.jit(self.optimizer.init)(trainable)
+        # Adam moments inherit the param shardings via propagation, but
+        # scalar leaves (e.g. the Adam step count) come out single-device;
+        # replicate them over the mesh so the whole state shares one device
+        # set (restore-from-checkpoint builds shardings from this state).
+        full_device_set = set(np.asarray(self.mesh.devices).flat)
+
+        def on_full_mesh(x):
+            if getattr(x, "sharding", None) and set(x.sharding.device_set) == full_device_set:
+                return x
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+        opt_state = jax.tree.map(on_full_mesh, opt_state)
+        self.state = TrainState(
+            # replicated over the mesh so restore() places it consistently
+            step=jax.device_put(
+                jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())
+            ),
+            trainable=trainable,
+            frozen=frozen,
+            opt_state=opt_state,
+        )
+        self.lr_schedule = build_lr_schedule(cfg, self.total_steps, self.dp_size)
+
+    def _validated_spec(self, path: str, leaf) -> P:
+        from llm_fine_tune_distributed_tpu.parallel.sharding import _validate_spec
+
+        return _validate_spec(param_spec(path, leaf.ndim), leaf.shape, self.mesh)
+
+    # ----------------------------------------------------------------- steps
+
+    def _prepare_steps(self) -> None:
+        act = NamedSharding(self.mesh, P(("data", "fsdp"), None, None))
+        self._batch_sharding = NamedSharding(self.mesh, P(None, ("data", "fsdp")))
+        self._eval_sharding = NamedSharding(self.mesh, P(("data", "fsdp")))
+        train_step = build_train_step(
+            self.model_config, self.config, self.optimizer, activation_sharding=act
+        )
+        self.train_step = jit_train_step(train_step)
+        self.eval_step = jax.jit(
+            build_eval_step(self.model_config, self.config, activation_sharding=act)
+        )
+
+    def _device_batch(self, batch: Dict[str, np.ndarray], sharding) -> Dict[str, jax.Array]:
+        return {k: jax.device_put(v, sharding) for k, v in batch.items() if k != "lengths"}
+
+    # ------------------------------------------------------------------ eval
+
+    def evaluate(self) -> float:
+        """Token-weighted eval loss over the validation split
+        (eval cadence contract: reference ``training.py:270-271``)."""
+        cfg = self.config
+        bs = cfg.per_device_batch_size * self.dp_size
+        n = self.val_arrays["input_ids"].shape[0]
+        total_ce, total_tokens = 0.0, 0.0
+        for lo in range(0, n - bs + 1, bs):
+            batch = {
+                "input_ids": self.val_arrays["input_ids"][lo : lo + bs],
+                "loss_mask": self.val_arrays["loss_mask"][lo : lo + bs],
+                "attention_mask": self.val_arrays["attention_mask"][lo : lo + bs],
+            }
+            batch = self._device_batch(batch, self._eval_sharding)
+            ce, tokens = self.eval_step(self.state, batch)
+            total_ce += float(ce)
+            total_tokens += float(tokens)
+        return total_ce / max(total_tokens, 1.0)
+
+    # ------------------------------------------------------------------ train
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        ckpt_dir = os.path.join(cfg.output_dir, "checkpoints")
+        ckpt = CheckpointManager(
+            ckpt_dir,
+            max_to_keep=cfg.save_total_limit,
+            metric_name=cfg.metric_for_best_model,
+            greater_is_better=cfg.greater_is_better,
+        )
+
+        start_epoch = 0
+        if cfg.resume_from_checkpoint:
+            start_epoch = self._resume(ckpt)
+
+        best_eval = float("inf") if not cfg.greater_is_better else -float("inf")
+        best_trainable = None
+        last_eval: Optional[float] = None
+        meter = ThroughputMeter(
+            n_chips=self.mesh.size, tokens_per_sample=cfg.max_seq_length
+        )
+        samples_per_step = cfg.per_device_batch_size * cfg.gradient_accumulation_steps * self.dp_size
+
+        if is_primary_host():
+            print(
+                f"Starting SFT: {cfg.epochs} epochs x {self.steps_per_epoch} steps, "
+                f"effective batch {samples_per_step}, mesh {dict(self.mesh.shape)}"
+            )
+        t_start = time.perf_counter()
+        step = int(self.state.step)
+        final_loss = None
+
+        for epoch in range(start_epoch, cfg.epochs):
+            for batch in self.loader.epoch(epoch):
+                dev_batch = self._device_batch(batch, self._batch_sharding)
+                self.state, metrics = self.train_step(self.state, dev_batch)
+                step += 1
+                meter.update(samples_per_step)
+
+                do_log = (
+                    (cfg.logging_first_step and step == 1)
+                    or (cfg.logging_steps and step % cfg.logging_steps == 0)
+                )
+                do_eval = cfg.eval_steps and step % cfg.eval_steps == 0
+                do_save = cfg.save_steps and step % cfg.save_steps == 0
+
+                if do_eval:
+                    last_eval = self.evaluate()
+                    improved = (
+                        last_eval > best_eval if cfg.greater_is_better else last_eval < best_eval
+                    )
+                    if improved:
+                        best_eval = last_eval
+                        if cfg.load_best_model_at_end:
+                            best_trainable = jax.tree.map(
+                                lambda x: np.asarray(x), self.state.trainable
+                            )
+
+                if do_log or do_eval:
+                    final_loss = float(metrics["loss"])
+                    logs = {
+                        "loss": final_loss,
+                        "grad_norm": float(metrics["grad_norm"]),
+                        "learning_rate": float(self.lr_schedule(step - 1)),
+                        **meter.snapshot(),
+                    }
+                    if do_eval:
+                        logs["eval_loss"] = last_eval
+                    self.metrics.log(step, step / self.steps_per_epoch, logs)
+
+                if do_save:
+                    ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+
+        # end of training: final checkpoint + optional best-model restore
+        if last_eval is None and self.n_val >= cfg.per_device_batch_size * self.dp_size:
+            last_eval = self.evaluate()
+            if cfg.load_best_model_at_end and (
+                last_eval < best_eval if not cfg.greater_is_better else last_eval > best_eval
+            ):
+                best_eval = last_eval
+                best_trainable = None  # current state IS best
+        ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+        ckpt.wait()
+
+        if cfg.load_best_model_at_end and best_trainable is not None:
+            # reload best-eval weights (reference load_best_model_at_end,
+            # training.py:273-275)
+            self.state = self.state.replace(
+                trainable={
+                    k: jax.device_put(v, self.state.trainable[k].sharding)
+                    for k, v in best_trainable.items()
+                }
+            )
+
+        wall = time.perf_counter() - t_start
+        throughput = meter.snapshot()
+        summary = self._save_artifacts(final_loss, last_eval, wall, throughput)
+        ckpt.close()
+        self.metrics.close()
+        return summary
+
+    def _resume(self, ckpt: CheckpointManager) -> int:
+        target = self.config.resume_from_checkpoint
+        step = ckpt.latest_step if target in ("latest", "true", "1") else int(target)
+        if step is None:
+            if is_primary_host():
+                print("No checkpoint found to resume from; starting fresh")
+            return 0
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            self.state,
+        )
+        self.state = ckpt.restore(step, abstract)
+        resumed_step = int(self.state.step)
+        if is_primary_host():
+            print(f"Resumed from checkpoint step {resumed_step}")
+        return resumed_step // self.steps_per_epoch
+
+    # -------------------------------------------------------------- artifacts
+
+    def _save_artifacts(
+        self,
+        final_loss: Optional[float],
+        eval_loss: Optional[float],
+        wall_seconds: float,
+        throughput: Dict[str, float],
+    ) -> Dict[str, Any]:
+        """Artifact contract of reference ``training.py:307-339`` (host 0):
+        best_model/ safetensors + tokenizer, training_history.json,
+        training_summary.json with the same keys (+ TPU-native extras)."""
+        cfg = self.config
+        summary = {
+            "model_name": cfg.model_name,
+            "dataset_path": os.path.join(cfg.data_dir, cfg.dataset_file),
+            "epochs": cfg.epochs,
+            "batch_size": cfg.per_device_batch_size,
+            "learning_rate": cfg.learning_rate,
+            "trainable_params": self.trainable_report["trainable_parameters"],
+            "total_params": self.trainable_report["total_parameters"],
+            "training_samples": self.n_train,
+            "validation_samples": self.n_val,
+            "final_train_loss": final_loss,
+            "world_size": self.dp_size,
+            "distributed_training": self.dp_size > 1,
+            # TPU-native extras (north-star instrumentation)
+            "final_eval_loss": eval_loss,
+            "wall_clock_seconds": round(wall_seconds, 2),
+            "mesh": dict(self.mesh.shape),
+            **{k: round(v, 4) for k, v in throughput.items()},
+        }
+        if not is_primary_host():
+            return summary
+
+        best_dir = os.path.join(cfg.output_dir, "best_model")
+        params = merge_flat(
+            {k: np.asarray(v) for k, v in self.state.trainable.items()},
+            {k: np.asarray(v) for k, v in self.state.frozen.items()},
+        )
+        import ml_dtypes
+
+        save_hf_checkpoint(
+            params,
+            best_dir,
+            save_dtype=ml_dtypes.bfloat16,
+            metadata={"framework": "llm_fine_tune_distributed_tpu"},
+        )
+        if hasattr(self.tokenizer, "save_pretrained"):
+            self.tokenizer.save_pretrained(best_dir)
+        self._save_model_config(best_dir)
+        print(f"Best model saved to {best_dir}")
+
+        self.metrics.save_history(os.path.join(cfg.output_dir, "training_history.json"))
+        with open(os.path.join(cfg.output_dir, "training_summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        return summary
+
+    def _save_model_config(self, path: str) -> None:
+        """Write a config.json so the inference CLI can rebuild the model."""
+        mc = self.model_config
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(
+                {
+                    "model_type": mc.name,
+                    "vocab_size": mc.vocab_size,
+                    "hidden_size": mc.hidden_size,
+                    "intermediate_size": mc.intermediate_size,
+                    "num_hidden_layers": mc.num_layers,
+                    "num_attention_heads": mc.num_heads,
+                    "num_key_value_heads": mc.num_kv_heads,
+                    "head_dim": mc.head_dim,
+                    "rope_theta": mc.rope_theta,
+                    "max_position_embeddings": mc.max_position_embeddings,
+                    "rms_norm_eps": mc.rms_norm_eps,
+                    "tie_word_embeddings": mc.tie_word_embeddings,
+                    "attention_bias": mc.attention_bias,
+                    "mlp_bias": mc.mlp_bias,
+                    "no_rope_layers": list(mc.no_rope_layers),
+                    "sliding_window": mc.sliding_window,
+                },
+                f,
+                indent=2,
+            )

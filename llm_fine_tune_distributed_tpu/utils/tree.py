@@ -31,6 +31,48 @@ def map_with_path(fn: Callable[[str, object], object], tree):
     return jax.tree_util.tree_map_with_path(lambda kp, leaf: fn(_path_str(kp), leaf), tree)
 
 
+def flatten_dict(tree, prefix: str = "") -> dict:
+    """Nested dict -> {'a/b/c': leaf} flat dict."""
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(flatten_dict(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def unflatten_dict(flat: dict) -> dict:
+    """{'a/b/c': leaf} -> nested dict."""
+    tree: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def split_by_mask(params, mask):
+    """Split a params pytree into (trainable_flat, frozen_flat) dicts keyed by
+    path. Keeping them as separate pytrees means autodiff, optimizer state and
+    donation operate on the trainable subset ONLY — frozen params never get
+    f32 gradient buffers or Adam moments (the TPU-memory expression of the
+    reference's freezing policy, training.py:113-149)."""
+    flat_p = flatten_dict(params)
+    flat_m = flatten_dict(mask)
+    trainable = {k: v for k, v in flat_p.items() if flat_m[k]}
+    frozen = {k: v for k, v in flat_p.items() if not flat_m[k]}
+    return trainable, frozen
+
+
+def merge_flat(trainable: dict, frozen: dict) -> dict:
+    """Inverse of split_by_mask: rebuild the nested params pytree."""
+    return unflatten_dict({**trainable, **frozen})
+
+
 def count_params(tree) -> int:
     return sum(int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(tree))
 

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Distributed SFT entry point — TPU-native equivalent of the reference's
+``training.py`` (same env-var contract: EPOCHS, BATCH_SIZE, LEARNING_RATE,
+DATA_DIR, OUTPUT_DIR, AIM_REPO, WORLD_SIZE/RANK/MASTER_ADDR/MASTER_PORT;
+reference ``training.py:19-23,54-60``).
+
+Differences by design (TPU-first):
+- multi-host rendezvous is ``jax.distributed.initialize`` (coordinator =
+  MASTER_ADDR analog), not NCCL (SURVEY.md §2.5);
+- parallelism is a device mesh (data/fsdp/tensor/seq) instead of flat DDP —
+  shape via MESH_DATA/MESH_FSDP/MESH_TENSOR/MESH_SEQ env vars;
+- runs on TPU, CPU (simulation), or any JAX backend — no hard CUDA assert
+  (reference hard-fails without CUDA at ``training.py:81-83``).
+
+Usage:
+  python training.py                      # env-var config, like the reference
+  python training.py --config cfg.json    # config-file mode
+"""
+
+import argparse
+import os
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", help="JSON/YAML TrainConfig file")
+    parser.add_argument("--model-preset", help="model preset override")
+    parser.add_argument(
+        "--resume", nargs="?", const="latest", default=None,
+        help="resume from checkpoint ('latest' or a step number)",
+    )
+    args = parser.parse_args()
+
+    # Multi-host bootstrap MUST run before any jax backend use
+    # (reference analog: setup_distributed, training.py:16-42).
+    from llm_fine_tune_distributed_tpu.runtime.distributed import (
+        initialize_distributed,
+        is_primary_host,
+    )
+
+    info = initialize_distributed()
+
+    from llm_fine_tune_distributed_tpu.config import MeshConfig, TrainConfig
+
+    config = TrainConfig.load(args.config) if args.config else TrainConfig()
+    config.apply_env_overrides()
+    if args.model_preset:
+        config.model_preset = args.model_preset
+    if args.resume is not None:
+        config.resume_from_checkpoint = args.resume
+    mesh_env = {k: os.environ.get(f"MESH_{k.upper()}") for k in ("data", "fsdp", "tensor", "seq")}
+    if any(v is not None for v in mesh_env.values()):
+        config.mesh = MeshConfig(
+            **{k: int(v) for k, v in mesh_env.items() if v is not None}
+        )
+
+    if is_primary_host():
+        print("=" * 60)
+        print("TPU-native distributed SFT")
+        print(f"  process {info.process_index}/{info.process_count}, "
+              f"{info.global_device_count} devices ({info.platform})")
+        print(f"  epochs={config.epochs} batch={config.per_device_batch_size} "
+              f"lr={config.learning_rate} accum={config.gradient_accumulation_steps}")
+        print(f"  data={config.data_dir} output={config.output_dir}")
+        print("=" * 60)
+
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    trainer = SFTTrainer(config)
+    summary = trainer.train()
+
+    if is_primary_host():
+        print("\nDistributed Q&A fine-tuning completed successfully!")
+        print(f"Training artifacts saved to {config.output_dir}/")
+        print(f"samples/sec/chip: {summary.get('samples_per_second_per_chip')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
