@@ -168,6 +168,10 @@ class TrainConfig:
     # loss on completion tokens only? TRL SFTTrainer default (packing=False,
     # no completion_only flag in the reference) trains on the full sequence.
     completion_only_loss: bool = False
+    # Compute the cross-entropy in sequence chunks of this size so the
+    # [batch, seq, vocab] float32 logits tensor never materializes (HBM saver
+    # for large-vocab models; None = single full-sequence unembed).
+    loss_chunk_size: Optional[int] = None
 
     # freezing policy (reference training.py:113-149)
     freeze_strategy: str = "last_n_and_head"  # or "none" / "lora"
@@ -228,10 +232,12 @@ class TrainConfig:
         "AIM_REPO": ("aim_repo", str),
         "MODEL_NAME": ("model_name", str),
         "MODEL_PRESET": ("model_preset", str),
+        "TOKENIZER_PATH": ("tokenizer_path", str),
         "MAX_SEQ_LENGTH": ("max_seq_length", int),
         "GRAD_ACCUM_STEPS": ("gradient_accumulation_steps", int),
         "SEED": ("seed", int),
         "ATTENTION_IMPL": ("attention_impl", str),
+        "LOSS_CHUNK_SIZE": ("loss_chunk_size", int),
         "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
     }
 

@@ -191,6 +191,7 @@ def forward(
     remat: bool = False,
     logits_dtype=jnp.float32,
     activation_sharding=None,
+    output_hidden: bool = False,
 ) -> Tuple[jax.Array, Optional[Dict[str, Any]]]:
     """Run the model.
 
@@ -204,6 +205,10 @@ def forward(
       cache_pos: scalar — where this chunk starts in the cache.
       remat: rematerialize each block on backward
         (analog of reference ``gradient_checkpointing=True``, training.py:280).
+      output_hidden: return the final-norm hidden states [batch, seq, hidden]
+        (in ``compute_dtype``) instead of logits — the chunked-loss path
+        (train/step.py) unembeds chunk-by-chunk so the [batch, seq, vocab]
+        float32 logits tensor never materializes in HBM.
       activation_sharding: optional ``NamedSharding`` for the [batch, seq,
         hidden] activations (normally batch over (data, fsdp)). Constraining
         activations explicitly keeps XLA/Shardy propagation on the intended
@@ -277,16 +282,22 @@ def forward(
 
     x = rms_norm(x, params["model"]["norm"]["weight"], config.rms_norm_eps)
 
+    new_cache = {"layers": new_layers} if cache is not None else None
+    if output_hidden:
+        return x.astype(compute_dtype), new_cache
+    logits = unembed(params, x, config, compute_dtype=compute_dtype, logits_dtype=logits_dtype)
+    return logits, new_cache
+
+
+def unembed(params: Params, hidden, config: ModelConfig, *, compute_dtype=jnp.bfloat16, logits_dtype=jnp.float32):
+    """Project hidden states [..., hidden] -> logits [..., vocab] (tied or not)."""
+    h = hidden.astype(compute_dtype)
     if config.tie_word_embeddings:
         embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
-        logits = jnp.einsum("bsh,vh->bsv", x, embed)
+        logits = jnp.einsum("...h,vh->...v", h, embed)
     else:
-        logits = x @ params["lm_head"]["kernel"].astype(compute_dtype)
-
-    new_cache = None
-    if cache is not None:
-        new_cache = {"layers": new_layers}
-    return logits.astype(logits_dtype), new_cache
+        logits = h @ params["lm_head"]["kernel"].astype(compute_dtype)
+    return logits.astype(logits_dtype)
 
 
 def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):

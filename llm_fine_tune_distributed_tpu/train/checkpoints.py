@@ -40,9 +40,12 @@ class CheckpointManager:
             os.makedirs(directory, exist_ok=True)
         self.metric_name = metric_name
         self.greater_is_better = greater_is_better
+        # Missing metric maps to the WORST value for the configured mode so a
+        # metric-less checkpoint can never rank best.
+        worst = -float("inf") if greater_is_better else float("inf")
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
-            best_fn=(lambda m: m.get(metric_name, float("inf"))) if metric_name else None,
+            best_fn=(lambda m: m.get(metric_name, worst)) if metric_name else None,
             best_mode="max" if greater_is_better else "min",
             keep_checkpoints_without_metrics=True,
             create=True,
@@ -50,10 +53,12 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(directory, options=options)
 
     def save(self, step: int, state: TrainState, metrics: Optional[Dict[str, float]] = None):
+        # metrics=None stays None (not {}) so Orbax's
+        # keep_checkpoints_without_metrics applies to metric-less saves.
         self._mgr.save(
             step,
             args=ocp.args.Composite(state=ocp.args.StandardSave(state)),
-            metrics=metrics or {},
+            metrics=metrics,
         )
 
     def wait(self) -> None:

@@ -21,20 +21,51 @@ import jax.numpy as jnp
 import optax
 
 from llm_fine_tune_distributed_tpu.config import ModelConfig, TrainConfig, str_to_dtype
-from llm_fine_tune_distributed_tpu.models.transformer import forward
+from llm_fine_tune_distributed_tpu.models.transformer import forward, unembed
 from llm_fine_tune_distributed_tpu.train.state import TrainState
 from llm_fine_tune_distributed_tpu.utils.tree import merge_flat
 
 
+def chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfig, chunk_size: int, compute_dtype):
+    """Masked cross-entropy SUM computed in sequence chunks.
+
+    Unembeds ``chunk_size`` positions at a time (each chunk rematerialized on
+    backward) so peak HBM holds one [batch, chunk, vocab] f32 tile instead of
+    the full [batch, seq, vocab] logits — what makes 128k-vocab models
+    trainable on a 16GB chip at seq 1024.
+    """
+    b, s, h = hidden.shape
+    pad = (-s) % chunk_size
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk_size
+    # [n_chunks, batch, chunk, ...] so lax.map scans over chunks
+    hc = hidden.reshape(b, n, chunk_size, h).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n, chunk_size).transpose(1, 0, 2)
+    mc = mask.reshape(b, n, chunk_size).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def one_chunk(args):
+        h_c, t_c, m_c = args
+        logits = unembed(params, h_c, model_config, compute_dtype=compute_dtype)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, t_c)
+        return (ce * m_c).sum()
+
+    return jax.lax.map(one_chunk, (hc, tc, mc)).sum()
+
+
 def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activation_sharding=None):
     compute_dtype = str_to_dtype(train_config.compute_dtype)
+    chunk = train_config.loss_chunk_size
 
     def loss_fn(trainable, frozen, batch):
         """Masked next-token cross-entropy (token-mean within the batch) —
         the SFT objective TRL computes for packing=False full-sequence LM
         loss (reference ``training.py:282-283``). Returns (loss, token_count)."""
         params = merge_flat(trainable, frozen)
-        logits, _ = forward(
+        out, _ = forward(
             params,
             batch["input_ids"],
             model_config,
@@ -44,12 +75,19 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             remat=train_config.gradient_checkpointing,
             activation_sharding=activation_sharding,
             logits_dtype=jnp.float32,
+            output_hidden=chunk is not None,
         )
         targets = batch["input_ids"][:, 1:]
         mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
         tokens = jnp.maximum(mask.sum(), 1.0)
-        loss = (ce * mask).sum() / tokens
+        if chunk is not None:
+            ce_sum = chunked_ce_sum(
+                params, out[:, :-1], targets, mask, model_config, chunk, compute_dtype
+            )
+        else:
+            ce = optax.softmax_cross_entropy_with_integer_labels(out[:, :-1], targets)
+            ce_sum = (ce * mask).sum()
+        loss = ce_sum / tokens
         return loss, tokens
 
     return loss_fn

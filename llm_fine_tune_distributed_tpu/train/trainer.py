@@ -234,13 +234,24 @@ class SFTTrainer:
         cfg = self.config
         bs = cfg.per_device_batch_size * self.dp_size
         n = self.val_arrays["input_ids"].shape[0]
+        if n == 0:
+            return float("nan")
         total_ce, total_tokens = 0.0, 0.0
-        for lo in range(0, n - bs + 1, bs):
+        for lo in range(0, n, bs):
             batch = {
                 "input_ids": self.val_arrays["input_ids"][lo : lo + bs],
                 "loss_mask": self.val_arrays["loss_mask"][lo : lo + bs],
                 "attention_mask": self.val_arrays["attention_mask"][lo : lo + bs],
             }
+            short = bs - batch["input_ids"].shape[0]
+            if short > 0:
+                # pad the tail batch; padded rows carry zero loss_mask so they
+                # contribute no tokens to the token-weighted loss
+                for key in batch:
+                    pad_block = np.zeros((short,) + batch[key].shape[1:], batch[key].dtype)
+                    if key == "attention_mask":
+                        pad_block[:] = 1
+                    batch[key] = np.concatenate([batch[key], pad_block])
             batch = self._device_batch(batch, self._eval_sharding)
             ce, tokens = self.eval_step(self.state, batch)
             total_ce += float(ce)
@@ -259,9 +270,14 @@ class SFTTrainer:
             greater_is_better=cfg.greater_is_better,
         )
 
-        start_epoch = 0
+        resumed_step = 0
         if cfg.resume_from_checkpoint:
-            start_epoch = self._resume(ckpt)
+            resumed_step = self._resume(ckpt)
+        start_epoch = resumed_step // self.steps_per_epoch
+        # Mid-epoch resume: skip the batches this epoch already consumed
+        # (loader epochs are deterministic) so no sample trains twice and the
+        # lr schedule ends exactly at total_steps.
+        skip_batches = resumed_step % self.steps_per_epoch
 
         best_eval = float("inf") if not cfg.greater_is_better else -float("inf")
         best_trainable = None
@@ -281,7 +297,12 @@ class SFTTrainer:
         final_loss = None
 
         for epoch in range(start_epoch, cfg.epochs):
-            for batch in self.loader.epoch(epoch):
+            batches = self.loader.epoch(epoch)
+            if epoch == start_epoch and skip_batches:
+                import itertools
+
+                batches = itertools.islice(batches, skip_batches, None)
+            for batch in batches:
                 dev_batch = self._device_batch(batch, self._batch_sharding)
                 self.state, metrics = self.train_step(self.state, dev_batch)
                 step += 1
@@ -291,7 +312,7 @@ class SFTTrainer:
                     (cfg.logging_first_step and step == 1)
                     or (cfg.logging_steps and step % cfg.logging_steps == 0)
                 )
-                do_eval = cfg.eval_steps and step % cfg.eval_steps == 0
+                do_eval = cfg.eval_steps and step % cfg.eval_steps == 0 and self.n_val > 0
                 do_save = cfg.save_steps and step % cfg.save_steps == 0
 
                 if do_eval:
@@ -322,7 +343,7 @@ class SFTTrainer:
                     ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
 
         # end of training: final checkpoint + optional best-model restore
-        if last_eval is None and self.n_val >= cfg.per_device_batch_size * self.dp_size:
+        if last_eval is None and self.n_val > 0:
             last_eval = self.evaluate()
             if cfg.load_best_model_at_end and (
                 last_eval < best_eval if not cfg.greater_is_better else last_eval > best_eval
@@ -364,7 +385,7 @@ class SFTTrainer:
         resumed_step = int(self.state.step)
         if is_primary_host():
             print(f"Resumed from checkpoint step {resumed_step}")
-        return resumed_step // self.steps_per_epoch
+        return resumed_step
 
     # -------------------------------------------------------------- artifacts
 

@@ -1,0 +1,46 @@
+"""Chunked cross-entropy (loss_chunk_size) must match the full-logits loss
+bit-for-bit in value and gradients — it is a pure memory-layout optimization
+(train/step.py:chunked_ce_sum)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from llm_fine_tune_distributed_tpu.config import TrainConfig
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.parallel.freeze import trainable_mask
+from llm_fine_tune_distributed_tpu.train.step import make_loss_fn
+from llm_fine_tune_distributed_tpu.utils.tree import split_by_mask
+
+
+@pytest.mark.parametrize("chunk", [40, 96, 128])  # non-divisor, divisor, > seq
+def test_chunked_ce_matches_full(chunk):
+    mc = get_preset("tiny")
+    common = dict(model_preset="tiny", max_seq_length=96, compute_dtype="float32")
+    tc_full = TrainConfig(loss_chunk_size=None, **common)
+    tc_chunk = TrainConfig(loss_chunk_size=chunk, **common)
+
+    params = init_params(jax.random.PRNGKey(0), mc)
+    trainable, frozen = split_by_mask(params, trainable_mask(params, mc, tc_full))
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": rng.randint(0, mc.vocab_size, (2, 96)).astype(np.int32),
+        "loss_mask": (rng.rand(2, 96) > 0.3).astype(np.float32),
+        "attention_mask": np.ones((2, 96), np.int32),
+    }
+
+    loss_full, tok_full = make_loss_fn(mc, tc_full)(trainable, frozen, batch)
+    loss_chunk, tok_chunk = make_loss_fn(mc, tc_chunk)(trainable, frozen, batch)
+    assert float(tok_full) == float(tok_chunk)
+    assert abs(float(loss_full) - float(loss_chunk)) < 1e-5
+
+    g_full = jax.grad(lambda t: make_loss_fn(mc, tc_full)(t, frozen, batch)[0])(trainable)
+    g_chunk = jax.grad(lambda t: make_loss_fn(mc, tc_chunk)(t, frozen, batch)[0])(trainable)
+    diff = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_chunk))
+    )
+    assert diff < 1e-5
