@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Ask the ORIGINAL (pre-fine-tuning) base model the same question, under the
+identical wilderness system prompt, for before/after comparison — the
+TPU-native equivalent of the reference's ``ask_original_model.py``
+(same sampling; additionally passes ``enable_thinking=False`` to the chat
+template because SmolLM3 is a hybrid-reasoning model, reference
+``ask_original_model.py:44``).
+
+The base checkpoint must be a LOCAL HF directory (zero-egress environments
+cannot pull from the Hub): pass --model-dir or set BASE_MODEL_DIR.
+"""
+
+import sys
+
+from llm_fine_tune_distributed_tpu.infer.cli import run_ask_cli
+
+if __name__ == "__main__":
+    sys.exit(
+        run_ask_cli(
+            None,
+            description=__doc__,
+            default_model_dir="",
+            model_dir_env="BASE_MODEL_DIR",
+            missing_dir_help="Pass --model-dir /path/to/SmolLM3-3B or set BASE_MODEL_DIR.",
+            # compare the base model's direct answer, not its reasoning trace
+            # (reference ask_original_model.py:44)
+            template_kwargs={"enable_thinking": False},
+        )
+    )

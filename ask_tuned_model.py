@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Ask the fine-tuned model a question — TPU-native equivalent of the
+reference's ``ask_tuned_model.py``: loads the ``best_model/`` safetensors the
+trainer emitted (reference ``ask_tuned_model.py:15-35``), builds the ChatML
+prompt with the wilderness system prompt (``:40-49``), and samples with the
+reference's generation parameters (``:56-65``).
+
+Usage:
+  python ask_tuned_model.py "How many cups are in a gallon?"
+  python ask_tuned_model.py --model-dir outputs/best_model "What knot for a tarp?"
+"""
+
+import sys
+
+from llm_fine_tune_distributed_tpu.infer.cli import run_ask_cli
+
+if __name__ == "__main__":
+    sys.exit(
+        run_ask_cli(
+            None,
+            description=__doc__,
+            default_model_dir="outputs/best_model",
+            model_dir_env="MODEL_DIR",
+            missing_dir_help="Run training first (python training.py) or pass --model-dir.",
+        )
+    )

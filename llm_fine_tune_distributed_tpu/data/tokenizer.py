@@ -25,6 +25,8 @@ class ByteChatMLTokenizer:
 
     ROLES = ("system", "user", "assistant")
 
+    MARKER_FILE = "byte_chatml_tokenizer.json"
+
     def __init__(self, vocab_size: int = 512):
         assert vocab_size >= 262
         self.vocab_size = vocab_size
@@ -33,6 +35,16 @@ class ByteChatMLTokenizer:
         self.eos_token = "<|im_end|>"
         self.pad_token = "<|im_end|>"
         self.name_or_path = "byte-chatml"
+
+    def save_pretrained(self, path: str) -> None:
+        """Marker file so infer.load_tokenizer_dir can reconstruct this
+        tokenizer from a saved model directory."""
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, self.MARKER_FILE), "w") as f:
+            json.dump({"tokenizer_class": "ByteChatMLTokenizer", "vocab_size": self.vocab_size}, f)
 
     # -- core text <-> ids
 

@@ -1,0 +1,76 @@
+"""Shared implementation of the two question-answering CLIs
+(``ask_tuned_model.py`` / ``ask_original_model.py``): identical argparse
+surface, load path, and sampling defaults (reference ``ask_tuned_model.py``
+vs ``ask_original_model.py`` differ only in model source and the
+``enable_thinking=False`` template flag)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+
+def run_ask_cli(
+    argv: Optional[list],
+    *,
+    description: str,
+    default_model_dir: str,
+    model_dir_env: str,
+    missing_dir_help: str,
+    template_kwargs: Optional[dict] = None,
+) -> int:
+    parser = argparse.ArgumentParser(description=description)
+    parser.add_argument("question", nargs="+", help="question for the model")
+    parser.add_argument(
+        "--model-dir",
+        default=os.environ.get(model_dir_env, default_model_dir),
+        help="directory with config.json + model.safetensors (+ tokenizer)",
+    )
+    # sampling defaults = reference ask_tuned_model.py:56-65
+    parser.add_argument("--max-new-tokens", type=int, default=3768)
+    parser.add_argument("--temperature", type=float, default=0.6)
+    parser.add_argument("--top-p", type=float, default=0.95)
+    parser.add_argument("--top-k", type=int, default=40)
+    parser.add_argument("--repetition-penalty", type=float, default=1.1)
+    parser.add_argument("--greedy", action="store_true", help="disable sampling")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    question = " ".join(args.question)
+
+    if not args.model_dir or not os.path.isdir(args.model_dir):
+        # reference exits with guidance when the artifact is missing
+        # (ask_tuned_model.py:17-20)
+        print(f"Error: model directory not found: {args.model_dir!r}")
+        print(missing_dir_help)
+        return 1
+
+    from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
+    from llm_fine_tune_distributed_tpu.infer import (
+        GenerationConfig,
+        Generator,
+        load_model_dir,
+        load_tokenizer_dir,
+    )
+
+    print(f"Loading model from {args.model_dir} ...")
+    params, model_config = load_model_dir(args.model_dir)
+    tokenizer = load_tokenizer_dir(args.model_dir)
+    generator = Generator(params, model_config, tokenizer)
+
+    gen = GenerationConfig(
+        max_new_tokens=args.max_new_tokens,
+        do_sample=not args.greedy,
+        temperature=args.temperature,
+        top_p=args.top_p,
+        top_k=args.top_k,
+        repetition_penalty=args.repetition_penalty,
+    )
+    messages = [
+        {"role": "system", "content": WILDERNESS_EXPERT_SYSTEM_PROMPT},
+        {"role": "user", "content": question},
+    ]
+    print(f"\nQuestion: {question}\n")
+    answer = generator.chat(messages, gen, seed=args.seed, **(template_kwargs or {}))
+    print(f"Answer: {answer}")
+    return 0

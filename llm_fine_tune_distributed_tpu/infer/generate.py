@@ -1,0 +1,244 @@
+"""Autoregressive generation: jitted prefill + ``lax.while_loop`` KV-cache
+decode — the TPU-native replacement for the reference's ``model.generate``
+call (reference ``ask_tuned_model.py:55-65``). The whole decode loop is ONE
+XLA program; prompt lengths are bucketed so recompiles are rare.
+
+Layout invariant: decoded token *t* is written at cache slot
+``prompt_len + t``, so cache-slot index == logical position and the causal
+mask over the fixed-size buffer needs no separate validity tracking (pad
+slots written during prefill sit at positions > query position until
+overwritten, hence always masked).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import partial
+from types import SimpleNamespace
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig, sample_token
+from llm_fine_tune_distributed_tpu.models.transformer import forward, init_cache, unembed
+
+_PROMPT_BUCKET = 256
+
+
+class Generator:
+    """Single-host generation engine over a params pytree."""
+
+    def __init__(
+        self,
+        params,
+        model_config: ModelConfig,
+        tokenizer,
+        compute_dtype=jnp.bfloat16,
+        eos_token_ids: Optional[Sequence[int]] = None,
+    ):
+        self.params = params
+        self.config = model_config
+        self.tokenizer = tokenizer
+        self.compute_dtype = compute_dtype
+        eos = eos_token_ids
+        if eos is None:
+            eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
+        self.eos_token_ids = tuple(int(e) for e in eos)
+        self._jit_cache = {}
+
+    # ------------------------------------------------------------- jit build
+
+    def _build(self, prompt_bucket: int, gen: GenerationConfig):
+        """Compile one (prompt_bucket, generation-config) specialization."""
+        mc = self.config
+        dtype = self.compute_dtype
+        buf_len = prompt_bucket + gen.max_new_tokens
+        eos = jnp.asarray(self.eos_token_ids, jnp.int32) if self.eos_token_ids else None
+
+        def step_logits(params, token_ids, cache, cache_pos):
+            hidden, cache = forward(
+                params,
+                token_ids,
+                mc,
+                cache=cache,
+                cache_pos=cache_pos,
+                compute_dtype=dtype,
+                output_hidden=True,
+            )
+            logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype)
+            return logits, cache
+
+        @jax.jit
+        def run(params, prompt_ids, prompt_len, rng):
+            b, pb = prompt_ids.shape
+            cache = init_cache(mc, b, buf_len, dtype=dtype)
+
+            # ---- prefill: all prompt positions in one pass
+            hidden, cache = forward(
+                params,
+                prompt_ids,
+                mc,
+                cache=cache,
+                cache_pos=0,
+                compute_dtype=dtype,
+                output_hidden=True,
+            )
+            last_h = jax.lax.dynamic_index_in_dim(hidden, prompt_len - 1, axis=1)
+            logits0 = unembed(params, last_h[:, 0], mc, compute_dtype=dtype)
+
+            # repetition-penalty memory: vocab-sized seen-set from the prompt
+            # (pad slots aliased onto the first real token so they add nothing)
+            valid = jnp.arange(pb)[None, :] < prompt_len
+            safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
+            seen = jnp.zeros((b, mc.vocab_size), bool).at[
+                jnp.arange(b)[:, None], safe_ids
+            ].set(True)
+
+            rng, sub = jax.random.split(rng)
+            first = sample_token(sub, logits0, seen, gen)
+            out = jnp.zeros((b, gen.max_new_tokens), jnp.int32)
+            out = out.at[:, 0].set(first)
+            done = jnp.isin(first, eos) if eos is not None else jnp.zeros((b,), bool)
+            seen = seen.at[jnp.arange(b), first].set(True)
+
+            def cond(c):
+                t, _, _, _, done, _ = c
+                return (t < gen.max_new_tokens) & ~done.all()
+
+            def body(c):
+                t, cache, out, seen, done, rng = c
+                last = jax.lax.dynamic_index_in_dim(out, t - 1, axis=1)
+                logits, cache = step_logits(params, last, cache, prompt_len + t - 1)
+                rng, sub = jax.random.split(rng)
+                nxt = sample_token(sub, logits, seen, gen)
+                hit_eos = jnp.isin(nxt, eos) if eos is not None else jnp.zeros((b,), bool)
+                # finished rows keep emitting eos/pad, excluded by n_generated
+                nxt = jnp.where(done, nxt * 0 + (eos[0] if eos is not None else 0), nxt)
+                out = out.at[:, t].set(nxt)
+                seen = seen.at[jnp.arange(b), nxt].set(True)
+                return (t + 1, cache, out, seen, done | hit_eos, rng)
+
+            t, cache, out, seen, done, rng = jax.lax.while_loop(
+                cond, body, (jnp.int32(1), cache, out, seen, done, rng)
+            )
+            return out, t
+
+        return run
+
+    # -------------------------------------------------------------- generate
+
+    def generate_ids(
+        self,
+        prompt_ids: Sequence[int],
+        gen: Optional[GenerationConfig] = None,
+        seed: int = 0,
+    ) -> List[int]:
+        """Generate continuation token ids for one prompt (batch 1)."""
+        gen = gen or GenerationConfig()
+        prompt_ids = list(prompt_ids)
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        bucket = -(-len(prompt_ids) // _PROMPT_BUCKET) * _PROMPT_BUCKET
+        key = (bucket, gen)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build(bucket, gen)
+        run = self._jit_cache[key]
+
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(prompt_ids)] = prompt_ids
+        out, n = run(
+            self.params,
+            jnp.asarray(padded),
+            jnp.int32(len(prompt_ids)),
+            jax.random.PRNGKey(seed),
+        )
+        tokens = np.asarray(out)[0, : int(n)].tolist()
+        # trim everything from the first stop token on
+        for i, tok in enumerate(tokens):
+            if tok in self.eos_token_ids:
+                return tokens[:i]
+        return tokens
+
+    def chat(
+        self,
+        messages: List[dict],
+        gen: Optional[GenerationConfig] = None,
+        seed: int = 0,
+        **template_kwargs,
+    ) -> str:
+        """ChatML conversation -> assistant reply text.
+
+        The reference recovers the assistant turn by scanning the decoded full
+        text for ``<|im_start|>assistant`` markers (reference
+        ``ask_tuned_model.py:69-92``) because HF returns prompt+completion;
+        here only the generated ids are decoded, which is the same extraction
+        without the string fragility.
+        """
+        try:
+            prompt_ids = self.tokenizer.apply_chat_template(
+                messages, tokenize=True, add_generation_prompt=True, **template_kwargs
+            )
+        except TypeError:  # tokenizer without template kwargs support
+            prompt_ids = self.tokenizer.apply_chat_template(
+                messages, tokenize=True, add_generation_prompt=True
+            )
+        ids = self.generate_ids(prompt_ids, gen, seed)
+        return self.tokenizer.decode(ids, skip_special_tokens=True).strip()
+
+
+# ---------------------------------------------------------------------------
+# model-directory loading (the inference-side artifact contract)
+# ---------------------------------------------------------------------------
+
+
+def load_model_dir(path: str, dtype=None) -> Tuple[dict, ModelConfig]:
+    """Load a model directory (``best_model/`` emitted by the trainer, or any
+    local HF Llama-family checkpoint) into (params, ModelConfig).
+
+    Mirrors the reference inference entry (``ask_tuned_model.py:15-35``):
+    ``config.json`` describes the architecture; weights come from
+    ``*.safetensors``. ``dtype=None`` keeps the checkpoint's stored dtype
+    (bf16 for trainer-emitted ``best_model/`` — upcasting a 3B model to f32
+    would not fit a 16GB chip beside its KV cache).
+    """
+    from llm_fine_tune_distributed_tpu.models.configs import from_hf_config
+    from llm_fine_tune_distributed_tpu.models.hf_io import load_hf_checkpoint
+
+    cfg_path = os.path.join(path, "config.json")
+    if not os.path.exists(cfg_path):
+        raise FileNotFoundError(f"no config.json under {path}")
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    model_config = from_hf_config(SimpleNamespace(**raw))
+    params = load_hf_checkpoint(path, model_config, dtype=dtype)
+    return params, model_config
+
+
+def load_tokenizer_dir(path: str):
+    """Tokenizer saved beside the weights.
+
+    Resolution order: the hermetic byte tokenizer's marker file (written by
+    its ``save_pretrained``), then HF tokenizer files, else raise — a silent
+    byte-tokenizer fallback against a 128k-vocab model would emit garbage.
+    """
+    from llm_fine_tune_distributed_tpu.data.tokenizer import (
+        ByteChatMLTokenizer,
+        load_tokenizer,
+    )
+
+    if os.path.exists(os.path.join(path, ByteChatMLTokenizer.MARKER_FILE)):
+        return load_tokenizer("byte-chatml")
+    has_hf_tok = any(
+        os.path.exists(os.path.join(path, f))
+        for f in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model")
+    )
+    if not has_hf_tok:
+        raise FileNotFoundError(
+            f"no tokenizer files under {path} (expected tokenizer.json / "
+            f"tokenizer_config.json / tokenizer.model, or the byte-chatml marker)"
+        )
+    return load_tokenizer(path)
