@@ -178,14 +178,9 @@ class Generator:
         here only the generated ids are decoded, which is the same extraction
         without the string fragility.
         """
-        try:
-            prompt_ids = self.tokenizer.apply_chat_template(
-                messages, tokenize=True, add_generation_prompt=True, **template_kwargs
-            )
-        except TypeError:  # tokenizer without template kwargs support
-            prompt_ids = self.tokenizer.apply_chat_template(
-                messages, tokenize=True, add_generation_prompt=True
-            )
+        prompt_ids = self.tokenizer.apply_chat_template(
+            messages, tokenize=True, add_generation_prompt=True, **template_kwargs
+        )
         ids = self.generate_ids(prompt_ids, gen, seed)
         return self.tokenizer.decode(ids, skip_special_tokens=True).strip()
 

@@ -55,7 +55,6 @@ def test_greedy_is_deterministic(tiny_setup):
 def test_eos_stops_generation(tiny_setup):
     """Force the first sampled token to be EOS by making eos the argmax."""
     mc, params, tok = tiny_setup
-    gen = Generator(params, mc, tok, compute_dtype=jnp.float32)
     cfg = GenerationConfig(max_new_tokens=16, do_sample=False, repetition_penalty=1.0)
     prompt = tok.encode("x")
     logits, _ = forward(params, jnp.asarray([prompt], jnp.int32), mc, compute_dtype=jnp.float32)
