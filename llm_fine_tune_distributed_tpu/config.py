@@ -210,6 +210,16 @@ class TrainConfig:
     experiment_name: str = "smollm3-wilderness-finetuning-distributed"
     profile_dir: Optional[str] = None
 
+    # native runtime (C++ layer, native/*.cc)
+    use_native_loader: bool = True   # prefetching C++ batch pipeline, auto-fallback
+    heartbeat: bool = False          # TCP failure detector (auto-on multi-host)
+    heartbeat_port: int = 23457      # analog of reference master port 23456
+    heartbeat_timeout_ms: int = 30000
+    # cross-host param-consistency check every N steps (0 = off) — the
+    # systematic form of the reference runbook's gradient-desync diagnosis
+    # (docs/single-vs-distributed-comparison.md:571-580)
+    desync_check_steps: int = 0
+
     # resume
     resume_from_checkpoint: Optional[str] = None  # "latest" or a path
 

@@ -131,6 +131,7 @@ def _block(
     layer_idx: int,
     attention_impl: str,
     compute_dtype,
+    mesh=None,
 ):
     """One transformer block. Returns (x, new_cache_entry)."""
     b, s, h = x.shape
@@ -165,6 +166,7 @@ def _block(
             padding_mask=padding_mask,
             causal=True,
             sliding_window=config.sliding_window,
+            mesh=mesh,
         )
 
     out = out.reshape(b, s, config.num_heads * d)
@@ -229,6 +231,12 @@ def forward(
             return jax.lax.with_sharding_constraint(h, activation_sharding)
         return h
 
+    # Ring attention (sequence parallelism) needs the mesh to shard_map over;
+    # recover it from the activation sharding so call sites stay unchanged.
+    mesh = None
+    if attention_impl == "ring" and activation_sharding is not None:
+        mesh = getattr(activation_sharding, "mesh", None)
+
     embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
     x = constrain(embed[input_ids])
     cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
@@ -263,6 +271,7 @@ def forward(
             layer_idx=i,
             attention_impl=attention_impl,
             compute_dtype=compute_dtype,
+            mesh=mesh,
         )
         if remat and cache is None:
             block_fn = jax.checkpoint(block_fn)

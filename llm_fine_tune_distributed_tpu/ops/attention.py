@@ -87,8 +87,24 @@ def attention(
     padding_mask=None,
     causal: bool = True,
     sliding_window: Optional[int] = None,
+    mesh=None,
 ):
-    """Dispatch to the selected attention implementation."""
+    """Dispatch to the selected attention implementation.
+
+    ``mesh`` is only consulted by the ring path (sequence parallelism); the
+    trainer passes the active mesh whenever ``attention_impl="ring"``.
+    """
+    if impl == "ring":
+        from llm_fine_tune_distributed_tpu.parallel.ring_attention import (
+            ring_attention,
+            ring_attention_supported,
+        )
+
+        if ring_attention_supported(
+            q, k, mesh, sliding_window=sliding_window, causal=causal
+        ):
+            return ring_attention(q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal)
+        impl = "xla"  # seq axis of 1 (or unsupported shape): plain attention
     if impl == "flash":
         # Pallas kernel requires TPU, no sliding window (falls back otherwise).
         from llm_fine_tune_distributed_tpu.ops.flash_attention import (

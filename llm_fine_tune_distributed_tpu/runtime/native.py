@@ -1,0 +1,115 @@
+"""Build + ctypes bindings for the native C++ runtime (native/*.cc).
+
+The reference's host-side native layer arrives via dependencies (Arrow C++,
+torch DataLoader workers, NCCL bootstrap — SURVEY.md §2.3); ours is
+first-party: a threaded prefetching batch pipeline (native/loader.cc) and a
+TCP heartbeat failure detector (native/heartbeat.cc). Compiled on first use
+with g++ into ``native/_tpu_runtime.so`` and rebuilt whenever a source file
+is newer than the binary. Everything degrades gracefully: callers check
+``available()`` and fall back to the pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SOURCES = ("loader.cc", "heartbeat.cc")
+_LIB_NAME = "_tpu_runtime.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _needs_build(lib_path: str) -> bool:
+    if not os.path.exists(lib_path):
+        return True
+    lib_mtime = os.path.getmtime(lib_path)
+    return any(
+        os.path.getmtime(os.path.join(_NATIVE_DIR, s)) > lib_mtime for s in _SOURCES
+    )
+
+
+def _build(lib_path: str) -> None:
+    # Compile to a per-pid temp file, then atomically rename into place:
+    # concurrent first-use builds (multi-host shared checkout, parallel test
+    # workers) must never dlopen a half-written .so.
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+    tmp_path = f"{lib_path}.tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-Wall", "-Werror", "-o", tmp_path, *srcs,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    os.replace(tmp_path, lib_path)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    i32p, i64p = c.POINTER(c.c_int32), c.POINTER(c.c_int64)
+    lib.sft_loader_create.restype = c.c_void_p
+    lib.sft_loader_create.argtypes = [
+        i32p, i32p, i32p, c.c_int64, c.c_int64, c.c_int64, c.c_int64,
+        c.c_int64, c.c_int64, c.c_uint64, c.c_int, c.c_int, c.c_int,
+    ]
+    lib.sft_loader_steps_per_epoch.restype = c.c_int64
+    lib.sft_loader_steps_per_epoch.argtypes = [c.c_void_p]
+    lib.sft_loader_start_epoch.restype = None
+    lib.sft_loader_start_epoch.argtypes = [c.c_void_p, c.c_int64]
+    lib.sft_loader_next.restype = c.c_int
+    lib.sft_loader_next.argtypes = [c.c_void_p, i32p, i32p, i32p]
+    lib.sft_loader_destroy.restype = None
+    lib.sft_loader_destroy.argtypes = [c.c_void_p]
+    lib.sft_loader_epoch_order.restype = None
+    lib.sft_loader_epoch_order.argtypes = [c.c_void_p, c.c_int64, i64p]
+
+    lib.hb_start_coordinator.restype = c.c_void_p
+    lib.hb_start_coordinator.argtypes = [c.c_int, c.c_int]
+    lib.hb_coordinator_port.restype = c.c_int
+    lib.hb_coordinator_port.argtypes = [c.c_void_p]
+    lib.hb_dead_mask.restype = c.c_uint64
+    lib.hb_dead_mask.argtypes = [c.c_void_p, c.c_int]
+    lib.hb_rank_age_ms.restype = c.c_int64
+    lib.hb_rank_age_ms.argtypes = [c.c_void_p, c.c_int]
+    lib.hb_stop_coordinator.restype = None
+    lib.hb_stop_coordinator.argtypes = [c.c_void_p]
+    lib.hb_start_worker.restype = c.c_void_p
+    lib.hb_start_worker.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int]
+    lib.hb_stop_worker.restype = None
+    lib.hb_stop_worker.argtypes = [c.c_void_p]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and load the native library; None if unavailable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        lib_path = os.path.join(_NATIVE_DIR, _LIB_NAME)
+        try:
+            if _needs_build(lib_path):
+                _build(lib_path)
+            _lib = _bind(ctypes.CDLL(lib_path))
+        except (OSError, RuntimeError, subprocess.SubprocessError) as e:
+            _build_error = str(e)
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error

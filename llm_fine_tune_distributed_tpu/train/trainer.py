@@ -111,8 +111,7 @@ class SFTTrainer:
         self.val_arrays = build_sft_arrays(
             val_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss
         )
-        self.loader = SFTBatchLoader(
-            self.train_arrays,
+        loader_kw = dict(
             per_device_batch_size=cfg.per_device_batch_size,
             grad_accum_steps=cfg.gradient_accumulation_steps,
             data_parallel_size=self.dp_size,
@@ -121,6 +120,36 @@ class SFTTrainer:
             seed=cfg.seed,
             drop_last=cfg.drop_last,
         )
+        self.loader = None
+        if cfg.use_native_loader:
+            # C++ prefetch pipeline (native/loader.cc): batch assembly overlaps
+            # device step time. Falls back to the Python loader without g++.
+            # The two engines use different (each deterministic) permutations,
+            # so the choice must be UNANIMOUS across hosts — a mixed fleet
+            # would shard different epoch orders and silently desync the data.
+            from llm_fine_tune_distributed_tpu.runtime import native
+
+            use_native = native.available()
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                votes = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.array([1 if use_native else 0], np.int32)
+                    )
+                ).reshape(-1)
+                use_native = bool(votes.min())
+            if use_native:
+                from llm_fine_tune_distributed_tpu.data.native_loader import (
+                    NativeBatchLoader,
+                )
+
+                self.loader = NativeBatchLoader(self.train_arrays, **loader_kw)
+            elif is_primary_host():
+                print(f"[data] native loader unavailable on >=1 host "
+                      f"({native.build_error()}); all hosts using Python loader")
+        if self.loader is None:
+            self.loader = SFTBatchLoader(self.train_arrays, **loader_kw)
         self.steps_per_epoch = self.loader.steps_per_epoch
         self.total_steps = self.steps_per_epoch * cfg.epochs
 
@@ -212,9 +241,14 @@ class SFTTrainer:
     # ----------------------------------------------------------------- steps
 
     def _prepare_steps(self) -> None:
-        act = NamedSharding(self.mesh, P(("data", "fsdp"), None, None))
-        self._batch_sharding = NamedSharding(self.mesh, P(None, ("data", "fsdp")))
-        self._eval_sharding = NamedSharding(self.mesh, P(("data", "fsdp")))
+        # Sequence parallelism: when a seq axis is live and ring attention is
+        # selected, activations and batches shard the sequence dim too — the
+        # ring (parallel/ring_attention.py) then rotates K/V over that axis.
+        seq_sharded = self.config.attention_impl == "ring" and self.mesh.shape["seq"] > 1
+        seq_ax = "seq" if seq_sharded else None
+        act = NamedSharding(self.mesh, P(("data", "fsdp"), seq_ax, None))
+        self._batch_sharding = NamedSharding(self.mesh, P(None, ("data", "fsdp"), seq_ax))
+        self._eval_sharding = NamedSharding(self.mesh, P(("data", "fsdp"), seq_ax))
         train_step = build_train_step(
             self.model_config, self.config, self.optimizer, activation_sharding=act
         )
@@ -292,55 +326,96 @@ class SFTTrainer:
                 f"Starting SFT: {cfg.epochs} epochs x {self.steps_per_epoch} steps, "
                 f"effective batch {samples_per_step}, mesh {dict(self.mesh.shape)}"
             )
+
+        # Failure detection (native/heartbeat.cc): auto-on for multi-host runs
+        # so a wedged peer is detected instead of hanging in a collective.
+        detector = None
+        if cfg.heartbeat or jax.process_count() > 1:
+            try:
+                from llm_fine_tune_distributed_tpu.runtime.failure import FailureDetector
+
+                coordinator = os.environ.get("MASTER_ADDR", "127.0.0.1")
+                detector = FailureDetector(
+                    rank=jax.process_index(),
+                    world_size=jax.process_count(),
+                    coordinator_host=coordinator,
+                    port=cfg.heartbeat_port,
+                    timeout_ms=cfg.heartbeat_timeout_ms,
+                )
+            except RuntimeError as e:
+                if is_primary_host():
+                    print(f"[runtime] heartbeat unavailable: {e}")
+        from llm_fine_tune_distributed_tpu.runtime.desync import DesyncMonitor
+
+        desync = DesyncMonitor(cfg.desync_check_steps)
+
         t_start = time.perf_counter()
         step = int(self.state.step)
         final_loss = None
 
-        for epoch in range(start_epoch, cfg.epochs):
-            batches = self.loader.epoch(epoch)
-            if epoch == start_epoch and skip_batches:
-                import itertools
+        try:
+            for epoch in range(start_epoch, cfg.epochs):
+                batches = self.loader.epoch(epoch)
+                if epoch == start_epoch and skip_batches:
+                    import itertools
 
-                batches = itertools.islice(batches, skip_batches, None)
-            for batch in batches:
-                dev_batch = self._device_batch(batch, self._batch_sharding)
-                self.state, metrics = self.train_step(self.state, dev_batch)
-                step += 1
-                meter.update(samples_per_step)
+                    batches = itertools.islice(batches, skip_batches, None)
+                for batch in batches:
+                    dev_batch = self._device_batch(batch, self._batch_sharding)
+                    self.state, metrics = self.train_step(self.state, dev_batch)
+                    step += 1
+                    meter.update(samples_per_step)
 
-                do_log = (
-                    (cfg.logging_first_step and step == 1)
-                    or (cfg.logging_steps and step % cfg.logging_steps == 0)
-                )
-                do_eval = cfg.eval_steps and step % cfg.eval_steps == 0 and self.n_val > 0
-                do_save = cfg.save_steps and step % cfg.save_steps == 0
+                    desync.maybe_check(step, self.state.trainable)
+                    if detector is not None and not detector.all_alive():
+                        dead = detector.dead_ranks()
+                        # Fail fast so the job manager restarts the fleet and
+                        # resumes from the last periodic checkpoint. No save
+                        # here: a sharded Orbax save needs EVERY host to
+                        # participate, and with a peer dead it would hang —
+                        # the exact collective-timeout limbo this detector
+                        # exists to avoid.
+                        raise RuntimeError(
+                            f"hosts {dead} stopped heartbeating at step {step}; "
+                            "aborting for restart+resume"
+                        )
 
-                if do_eval:
-                    last_eval = self.evaluate()
-                    improved = (
-                        last_eval > best_eval if cfg.greater_is_better else last_eval < best_eval
+                    do_log = (
+                        (cfg.logging_first_step and step == 1)
+                        or (cfg.logging_steps and step % cfg.logging_steps == 0)
                     )
-                    if improved:
-                        best_eval = last_eval
-                        if cfg.load_best_model_at_end:
-                            best_trainable = jax.tree.map(
-                                lambda x: np.asarray(x), self.state.trainable
-                            )
+                    do_eval = cfg.eval_steps and step % cfg.eval_steps == 0 and self.n_val > 0
+                    do_save = cfg.save_steps and step % cfg.save_steps == 0
 
-                if do_log or do_eval:
-                    final_loss = float(metrics["loss"])
-                    logs = {
-                        "loss": final_loss,
-                        "grad_norm": float(metrics["grad_norm"]),
-                        "learning_rate": float(self.lr_schedule(step - 1)),
-                        **meter.snapshot(),
-                    }
                     if do_eval:
-                        logs["eval_loss"] = last_eval
-                    self.metrics.log(step, step / self.steps_per_epoch, logs)
+                        last_eval = self.evaluate()
+                        improved = (
+                            last_eval > best_eval if cfg.greater_is_better else last_eval < best_eval
+                        )
+                        if improved:
+                            best_eval = last_eval
+                            if cfg.load_best_model_at_end:
+                                best_trainable = jax.tree.map(
+                                    lambda x: np.asarray(x), self.state.trainable
+                                )
 
-                if do_save:
-                    ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+                    if do_log or do_eval:
+                        final_loss = float(metrics["loss"])
+                        logs = {
+                            "loss": final_loss,
+                            "grad_norm": float(metrics["grad_norm"]),
+                            "learning_rate": float(self.lr_schedule(step - 1)),
+                            **meter.snapshot(),
+                        }
+                        if do_eval:
+                            logs["eval_loss"] = last_eval
+                        self.metrics.log(step, step / self.steps_per_epoch, logs)
+
+                    if do_save:
+                        ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+        finally:
+            if detector is not None:
+                detector.stop()
 
         # end of training: final checkpoint + optional best-model restore
         if last_eval is None and self.n_val > 0:

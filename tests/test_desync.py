@@ -1,0 +1,41 @@
+"""Desync monitor (runtime/desync.py): the systematic replacement for the
+reference's hand-run gradient-desync runbook (SURVEY.md §5.2)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_fine_tune_distributed_tpu.runtime.desync import DesyncMonitor, check_param_sync
+
+
+def test_finite_params_pass():
+    ok, sums = check_param_sync({"a": jnp.ones((4, 4)), "b": jnp.zeros((3,))})
+    assert ok
+    assert len(sums) == 1
+
+
+def test_nan_fails():
+    bad = {"a": jnp.array([1.0, float("nan")])}
+    ok, _ = check_param_sync(bad)
+    assert not ok
+
+
+def test_inf_fails():
+    bad = {"a": jnp.array([1.0, float("inf")])}
+    ok, _ = check_param_sync(bad)
+    assert not ok
+
+
+def test_monitor_cadence_and_raise():
+    mon = DesyncMonitor(every_n_steps=2)
+    good = {"a": jnp.ones((2,))}
+    bad = {"a": jnp.array([float("nan")])}
+    assert mon.maybe_check(1, bad)  # off-cadence: not checked
+    assert mon.maybe_check(2, good)
+    with pytest.raises(RuntimeError, match="desync"):
+        mon.maybe_check(4, bad)
+
+
+def test_monitor_disabled():
+    mon = DesyncMonitor(every_n_steps=0)
+    assert mon.maybe_check(1, {"a": jnp.array([float("nan")])})
