@@ -1,0 +1,38 @@
+#!/bin/bash
+# Confirm-gated teardown (reference deploy/cleanup-distributed.sh:1-112, C17):
+# job delete, force pod sweep, service delete, separately-gated PVC delete.
+set -uo pipefail
+
+NAMESPACE="${NAMESPACE:-lyric-professor}"
+JOB_NAME="smollm3-tpu-finetuning"
+SEL="app=${JOB_NAME}"
+
+read -r -p "Delete JobSet ${JOB_NAME} and its pods? [y/N] " yn
+if [[ "$yn" == [Yy]* ]]; then
+    kubectl delete jobset "$JOB_NAME" -n "$NAMESPACE" --timeout=60s 2>/dev/null || true
+    # Force-delete stragglers (reference :43-47)
+    kubectl delete pods -n "$NAMESPACE" -l "$SEL" --force --grace-period=0 2>/dev/null || true
+    # Service (reference :49-51)
+    kubectl delete service "$JOB_NAME" -n "$NAMESPACE" 2>/dev/null || true
+    echo "Job resources removed."
+fi
+
+# PVC deletion is gated separately — it destroys the trained model
+# (reference :53-60)
+read -r -p "ALSO delete PVCs (model output + Aim runs)? This DESTROYS trained models and metrics. [y/N] " yn
+if [[ "$yn" == [Yy]* ]]; then
+    kubectl delete pvc master-model-storage-pvc -n "$NAMESPACE" 2>/dev/null || true
+    kubectl delete pvc aim-runs-claim -n "$NAMESPACE" 2>/dev/null || true
+    echo "PVCs removed."
+fi
+
+# Orphan sweep (reference :71-88)
+orphans=$(kubectl get pods -n "$NAMESPACE" -l "$SEL" -o name 2>/dev/null)
+if [[ -n "$orphans" ]]; then
+    echo "Sweeping orphans: $orphans"
+    kubectl delete -n "$NAMESPACE" $orphans --force --grace-period=0 2>/dev/null || true
+fi
+
+# Temp manifest (reference :94-100)
+rm -f "$(dirname "$0")/jobset-temp.yaml"
+echo "Cleanup complete."

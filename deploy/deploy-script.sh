@@ -1,0 +1,71 @@
+#!/bin/bash
+# Build -> push -> patch -> apply -> tail, the reference deploy pipeline
+# (deploy/deploy-script.sh:1-141, C15) retargeted at the TPU JobSet.
+set -euo pipefail
+
+REGISTRY_URL="${REGISTRY_URL:-ghcr.io/example}"
+IMAGE_NAME="${IMAGE_NAME:-smollm3-tpu-finetune}"
+NAMESPACE="${NAMESPACE:-lyric-professor}"
+JOB_NAME="smollm3-tpu-finetuning"
+
+cd "$(dirname "$0")/.."
+
+# Timestamp version stamp (reference :15-23)
+VERSION="0.1.$(date +%Y%m%d%H%M%S)"
+echo "$VERSION" > .version
+echo "=== Deploying ${IMAGE_NAME}:${VERSION} ==="
+
+# Build + push (reference :29-36)
+docker build -f deploy/Dockerfile -t "${REGISTRY_URL}/${IMAGE_NAME}:${VERSION}" .
+docker push "${REGISTRY_URL}/${IMAGE_NAME}:${VERSION}"
+
+# Patch a temp copy of the JobSet with image/version (reference :42-49)
+sed -e "s|REGISTRY_URL/smollm3-tpu-finetune:VERSION|${REGISTRY_URL}/${IMAGE_NAME}:${VERSION}|" \
+    deploy/jobset.yaml > deploy/jobset-temp.yaml
+
+# Delete any existing job, force stragglers (reference :58-77)
+if kubectl get jobset "$JOB_NAME" -n "$NAMESPACE" >/dev/null 2>&1; then
+    echo "Deleting existing JobSet ${JOB_NAME}..."
+    kubectl delete jobset "$JOB_NAME" -n "$NAMESPACE" --timeout=60s || true
+    kubectl delete pods -n "$NAMESPACE" -l "app=${JOB_NAME}" \
+        --force --grace-period=0 2>/dev/null || true
+fi
+
+# Storage + Aim stack (reference :79-81)
+kubectl apply -f deploy/storage.yaml
+kubectl apply -f aim/aim-pvc.yaml -f aim/aim-deploy.yaml -f aim/aim-svc.yaml
+
+# Headless service for pod-to-pod DNS: the jax.distributed coordinator and
+# the heartbeat detector dial worker-0 by name (the reference creates the
+# master Service on 23456 here, :83-105)
+kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Service
+metadata:
+  name: ${JOB_NAME}
+  namespace: ${NAMESPACE}
+spec:
+  clusterIP: None
+  selector:
+    app: ${JOB_NAME}
+  ports:
+    - name: coordinator
+      port: 23456
+    - name: heartbeat
+      port: 23457
+EOF
+
+# Apply the job (reference :107-109)
+kubectl apply -f deploy/jobset-temp.yaml
+
+echo "=== Status ==="
+kubectl get jobset "$JOB_NAME" -n "$NAMESPACE"
+kubectl get pods -n "$NAMESPACE" -l "app=${JOB_NAME}" -o wide
+
+# Tail host-0 logs (reference :141-142)
+echo "=== Following host-0 logs (Ctrl-C to stop) ==="
+kubectl wait --for=condition=Ready pod \
+    -l "app=${JOB_NAME},batch.kubernetes.io/job-completion-index=0" \
+    -n "$NAMESPACE" --timeout=600s || true
+kubectl logs -f -n "$NAMESPACE" \
+    -l "app=${JOB_NAME},batch.kubernetes.io/job-completion-index=0"

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Cluster-side monitoring (reference deploy/monitor-distributed.sh:1-79, C16):
+# job/pod status, resource usage, TPU allocation, events, interactive log follow.
+set -uo pipefail
+
+NAMESPACE="${NAMESPACE:-lyric-professor}"
+JOB_NAME="smollm3-tpu-finetuning"
+SEL="app=${JOB_NAME}"
+
+echo "=== JobSet status ==="
+kubectl get jobset "$JOB_NAME" -n "$NAMESPACE" 2>/dev/null || echo "(no JobSet)"
+echo
+echo "=== Pods ==="
+kubectl get pods -n "$NAMESPACE" -l "$SEL" -o wide
+
+echo
+echo "=== Resource usage (kubectl top) ==="
+kubectl top pods -n "$NAMESPACE" -l "$SEL" 2>/dev/null || echo "(metrics-server unavailable)"
+
+echo
+echo "=== TPU allocation ==="
+kubectl get pods -n "$NAMESPACE" -l "$SEL" \
+    -o custom-columns='POD:.metadata.name,TPUS:.spec.containers[0].resources.requests.google\.com/tpu,NODE:.spec.nodeName'
+
+echo
+echo "=== Recent events ==="
+kubectl get events -n "$NAMESPACE" --sort-by=.lastTimestamp 2>/dev/null | tail -10
+
+echo
+echo "Follow logs: [0-9] host index, (a)ll hosts, (q)uit"
+read -r -n 1 choice
+echo
+case "$choice" in
+    [0-9])
+        kubectl logs -f -n "$NAMESPACE" \
+            -l "$SEL,batch.kubernetes.io/job-completion-index=${choice}"
+        ;;
+    a)
+        kubectl logs -f -n "$NAMESPACE" -l "$SEL" --prefix --max-log-requests=16
+        ;;
+    *) ;;
+esac

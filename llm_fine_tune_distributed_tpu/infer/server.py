@@ -1,0 +1,134 @@
+"""Minimal HTTP serving for the tuned model.
+
+The reference has NO serving server — inference is CLI-only, and
+``examples/openshift-deploy.yaml`` (C21) is an unrelated KServe template kept
+"for a future endpoint" (SURVEY.md §2.1 C21, "not present" list). This
+closes that gap with a dependency-free stdlib server exposing:
+
+  GET  /healthz                      -> 200 "ok" (readiness probe target)
+  POST /v1/generate {"question": .., -> {"answer": ..}
+        optional: "max_new_tokens", "temperature", "top_p", "top_k",
+                  "repetition_penalty", "greedy", "seed", "system_prompt"}
+
+Single-threaded by design: one Generator owns the TPU; requests serialize.
+Run: ``python -m llm_fine_tune_distributed_tpu.infer.server --model-dir ...``
+or ``ask_tuned_model.py --serve``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Optional
+
+
+def serve(model_dir: str, host: str = "0.0.0.0", port: int = 8080) -> None:
+    from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
+    from llm_fine_tune_distributed_tpu.infer import (
+        GenerationConfig,
+        Generator,
+        load_model_dir,
+        load_tokenizer_dir,
+    )
+
+    print(f"Loading model from {model_dir} ...")
+    params, model_config = load_model_dir(model_dir)
+    tokenizer = load_tokenizer_dir(model_dir)
+    generator = Generator(params, model_config, tokenizer)
+    print("Model ready.")
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict | str) -> None:
+            body = (
+                payload if isinstance(payload, str) else json.dumps(payload)
+            ).encode()
+            self.send_response(code)
+            self.send_header(
+                "Content-Type",
+                "text/plain" if isinstance(payload, str) else "application/json",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            if self.path == "/healthz":
+                self._send(200, "ok")
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/v1/generate":
+                self._send(404, {"error": "not found"})
+                return
+            # Optional fields cast and forwarded only when present, so
+            # GenerationConfig stays the single source of sampling defaults.
+            field_casts = {
+                "max_new_tokens": int,
+                "temperature": float,
+                "top_p": float,
+                "top_k": int,
+                "repetition_penalty": float,
+            }
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise TypeError("body must be a JSON object")
+                question = req["question"]
+                gen_kwargs = {
+                    k: cast(req[k]) for k, cast in field_casts.items() if k in req
+                }
+                if "greedy" in req:
+                    gen_kwargs["do_sample"] = not req["greedy"]
+                seed = int(req.get("seed", 0))
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            gen = GenerationConfig(**gen_kwargs)
+            messages = [
+                {
+                    "role": "system",
+                    "content": req.get("system_prompt", WILDERNESS_EXPERT_SYSTEM_PROMPT),
+                },
+                {"role": "user", "content": question},
+            ]
+            try:
+                answer = generator.chat(messages, gen, seed=seed)
+            except Exception as e:  # surface generation errors as 500s
+                self._send(500, {"error": str(e)})
+                return
+            self._send(200, {"answer": answer})
+
+        def log_message(self, fmt, *args):
+            print(f"[serve] {self.address_string()} {fmt % args}", flush=True)
+
+    httpd = HTTPServer((host, port), Handler)
+    print(f"Serving on {host}:{port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(description="Serve the tuned model over HTTP")
+    parser.add_argument(
+        "--model-dir", default=os.environ.get("MODEL_DIR", "outputs/best_model")
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.model_dir):
+        print(f"Error: model directory not found: {args.model_dir!r}")
+        return 1
+    serve(args.model_dir, args.host, args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,8 +33,9 @@ def trainable_predicate(config: ModelConfig, train: TrainConfig) -> Callable[[st
     if strategy == "none":
         return lambda path: True
     if strategy == "lora":
-        # Only adapter params train; base weights frozen.
-        return lambda path: "lora_" in path
+        # Only adapter matrices train; base weights AND the (constant)
+        # alpha/r scale stay frozen.
+        return lambda path: path.endswith(("lora_a", "lora_b"))
     if strategy == "last_n_and_head":
         cutoff = config.num_layers - train.unfreeze_last_n_layers
 

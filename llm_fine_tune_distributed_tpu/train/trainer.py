@@ -173,6 +173,13 @@ class SFTTrainer:
     def _prepare_state(self) -> None:
         cfg, mc = self.config, self.model_config
         params = self._load_or_init_params()
+        if cfg.freeze_strategy == "lora":
+            # Attach adapters (A kaiming, B zero: step-0 model == base model);
+            # only lora_a/lora_b train (parallel/freeze.py), so optimizer
+            # state shrinks to the adapter footprint.
+            from llm_fine_tune_distributed_tpu.parallel.lora import add_lora_from_config
+
+            params = add_lora_from_config(params, self.rng, cfg)
         mask = trainable_mask(params, mc, cfg)
         self.trainable_report = describe_trainable(params, mask)
         if is_primary_host():
@@ -502,6 +509,17 @@ class SFTTrainer:
             {k: np.asarray(v) for k, v in self.state.trainable.items()},
             {k: np.asarray(v) for k, v in self.state.frozen.items()},
         )
+        if cfg.freeze_strategy == "lora":
+            # Export both forms: standalone PEFT adapter (small, composable)
+            # and the merged model (what the serving path actually loads —
+            # rank-16 side matmuls would waste MXU occupancy at inference).
+            from llm_fine_tune_distributed_tpu.parallel.lora import (
+                merge_lora,
+                save_lora_adapter,
+            )
+
+            save_lora_adapter(params, os.path.join(cfg.output_dir, "adapter"), cfg)
+            params = merge_lora(params)
         import ml_dtypes
 
         save_hf_checkpoint(

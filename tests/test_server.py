@@ -1,0 +1,103 @@
+"""HTTP serving (infer/server.py): healthz + /v1/generate against a tiny
+model dir — the serving capability the reference only templates
+(examples/openshift-deploy.yaml, SURVEY.md C21)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.hf_io import save_hf_checkpoint
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    d = tmp_path_factory.mktemp("serve") / "best_model"
+    save_hf_checkpoint(params, str(d))
+    ByteChatMLTokenizer().save_pretrained(str(d))
+    with open(d / "config.json", "w") as f:
+        json.dump(
+            {
+                "model_type": mc.name,
+                "vocab_size": mc.vocab_size,
+                "hidden_size": mc.hidden_size,
+                "intermediate_size": mc.intermediate_size,
+                "num_hidden_layers": mc.num_layers,
+                "num_attention_heads": mc.num_heads,
+                "num_key_value_heads": mc.num_kv_heads,
+                "rope_theta": mc.rope_theta,
+                "max_position_embeddings": mc.max_position_embeddings,
+                "rms_norm_eps": mc.rms_norm_eps,
+                "tie_word_embeddings": mc.tie_word_embeddings,
+                "no_rope_layers": list(mc.no_rope_layers),
+            },
+            f,
+        )
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def server(model_dir):
+    from llm_fine_tune_distributed_tpu.infer.server import serve
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    t = threading.Thread(
+        target=serve, args=(model_dir, "127.0.0.1", port), daemon=True
+    )
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"{base}/healthz", timeout=2) as r:
+                if r.status == 200:
+                    return base
+        except OSError:
+            time.sleep(0.25)
+    raise RuntimeError("server did not become healthy")
+
+
+def test_healthz(server):
+    with urllib.request.urlopen(f"{server}/healthz") as r:
+        assert r.read() == b"ok"
+
+
+def test_generate(server):
+    req = urllib.request.Request(
+        f"{server}/v1/generate",
+        data=json.dumps(
+            {"question": "How many cups in a gallon?", "max_new_tokens": 8, "greedy": True}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        payload = json.loads(r.read())
+    assert isinstance(payload["answer"], str)
+
+
+def test_bad_request(server):
+    req = urllib.request.Request(
+        f"{server}/v1/generate", data=b'{"nope": 1}',
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+
+
+def test_unknown_path_404(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(f"{server}/nope", timeout=10)
+    assert e.value.code == 404
