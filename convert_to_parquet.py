@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""JSONL -> Parquet dataset conversion CLI (reference ``convert_to_parquet.py``).
+
+Usage: python convert_to_parquet.py [data/final_qa_data_unique.jsonl] [out.parquet]
+"""
+
+import sys
+
+from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
+
+if __name__ == "__main__":
+    jsonl = sys.argv[1] if len(sys.argv) > 1 else "data/final_qa_data_unique.jsonl"
+    out = sys.argv[2] if len(sys.argv) > 2 else None
+    convert_jsonl_to_parquet(jsonl, out)

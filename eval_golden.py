@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Golden-question eval CLI: the programmatic version of the reference's
+manual 5-question tuned-vs-original comparison (reference README.md:15-21).
+
+Usage:
+  python eval_golden.py --tuned-dir outputs/best_model \\
+                        [--original-dir <base model dir>] \\
+                        [--report golden_report.json] [--max-new-tokens 256]
+
+With only --tuned-dir, answers the questions with the tuned model. With both
+dirs, prints the side-by-side diff report and writes it as JSON.
+"""
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tuned-dir", default=os.environ.get("MODEL_DIR", "outputs/best_model"))
+    parser.add_argument("--original-dir", default=None)
+    parser.add_argument("--report", default="golden_report.json")
+    parser.add_argument("--max-new-tokens", type=int, default=256)
+    args = parser.parse_args(argv)
+
+    from llm_fine_tune_distributed_tpu.infer import Generator, load_model_dir, load_tokenizer_dir
+    from llm_fine_tune_distributed_tpu.infer.golden import (
+        compare_golden,
+        print_report,
+        run_golden_eval,
+        save_report,
+    )
+
+    def make_generator(path):
+        params, mc = load_model_dir(path)
+        return Generator(params, mc, load_tokenizer_dir(path))
+
+    if not os.path.isdir(args.tuned_dir):
+        print(f"Error: model directory not found: {args.tuned_dir!r}")
+        return 1
+
+    print(f"Evaluating tuned model: {args.tuned_dir}")
+    tuned = run_golden_eval(
+        make_generator(args.tuned_dir), max_new_tokens=args.max_new_tokens
+    )
+    if args.original_dir is None:
+        for a in tuned:
+            print("=" * 72)
+            print(f"Q: {a.question}\nA: {a.answer[:400]}")
+        return 0
+
+    print(f"Evaluating original model: {args.original_dir}")
+    original = run_golden_eval(
+        make_generator(args.original_dir),
+        max_new_tokens=args.max_new_tokens,
+        # reference passes enable_thinking=False only for the base model
+        # (ask_original_model.py:44)
+        template_kwargs={"enable_thinking": False},
+    )
+    report = compare_golden(tuned, original)
+    print_report(report)
+    save_report(report, args.report)
+    print(f"Report written to {args.report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

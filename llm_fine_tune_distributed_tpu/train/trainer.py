@@ -352,9 +352,11 @@ class SFTTrainer:
             except RuntimeError as e:
                 if is_primary_host():
                     print(f"[runtime] heartbeat unavailable: {e}")
+        from llm_fine_tune_distributed_tpu.observe.profiler import StepProfiler
         from llm_fine_tune_distributed_tpu.runtime.desync import DesyncMonitor
 
         desync = DesyncMonitor(cfg.desync_check_steps)
+        profiler = StepProfiler(cfg.profile_dir)
 
         t_start = time.perf_counter()
         step = int(self.state.step)
@@ -372,6 +374,7 @@ class SFTTrainer:
                     self.state, metrics = self.train_step(self.state, dev_batch)
                     step += 1
                     meter.update(samples_per_step)
+                    profiler.step(step)
 
                     desync.maybe_check(step, self.state.trainable)
                     if detector is not None and not detector.all_alive():
@@ -421,6 +424,7 @@ class SFTTrainer:
                     if do_save:
                         ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
         finally:
+            profiler.close()
             if detector is not None:
                 detector.stop()
 

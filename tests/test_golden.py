@@ -1,0 +1,60 @@
+"""Golden-question harness (infer/golden.py): the programmatic form of the
+reference's manual 5-question comparison (reference README.md:15-21)."""
+
+import jax
+import jax.numpy as jnp
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import Generator
+from llm_fine_tune_distributed_tpu.infer.golden import (
+    GOLDEN_QUESTIONS,
+    compare_golden,
+    run_golden_eval,
+    save_report,
+)
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+
+
+def _generator(seed):
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(seed), mc, dtype=jnp.float32)
+    return Generator(params, mc, ByteChatMLTokenizer(), compute_dtype=jnp.float32)
+
+
+def test_golden_questions_are_the_reference_five():
+    assert len(GOLDEN_QUESTIONS) == 5
+    assert any("gallon" in q for q in GOLDEN_QUESTIONS)
+    assert any("bear" in q for q in GOLDEN_QUESTIONS)
+
+
+def test_run_and_compare(tmp_path):
+    tuned = run_golden_eval(
+        _generator(0), questions=GOLDEN_QUESTIONS[:2], max_new_tokens=6
+    )
+    assert len(tuned) == 2
+    assert all(a.n_chars == len(a.answer) for a in tuned)
+    assert all(a.question in GOLDEN_QUESTIONS for a in tuned)
+
+    report = compare_golden(tuned, tuned)
+    assert report["n_questions"] == 2
+    save_report(report, str(tmp_path / "r.json"))
+    assert (tmp_path / "r.json").exists()
+
+
+def test_compare_flags_divergence():
+    from llm_fine_tune_distributed_tpu.infer.golden import GoldenAnswer
+
+    a = [GoldenAnswer("q1", "tuned answer", 2, 12), GoldenAnswer("q2", "same", 1, 4)]
+    b = [GoldenAnswer("q1", "base answer", 2, 11), GoldenAnswer("q2", "same", 1, 4)]
+    report = compare_golden(a, b)
+    assert report["n_answers_differ"] == 1
+    assert report["rows"][0]["answers_differ"] is True
+    assert report["rows"][1]["answers_differ"] is False
+
+
+def test_same_model_answers_identical():
+    a = run_golden_eval(_generator(0), questions=GOLDEN_QUESTIONS[:1], max_new_tokens=6)
+    b = run_golden_eval(_generator(0), questions=GOLDEN_QUESTIONS[:1], max_new_tokens=6)
+    report = compare_golden(a, b)
+    assert report["n_answers_differ"] == 0
