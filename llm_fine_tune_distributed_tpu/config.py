@@ -143,6 +143,8 @@ class TrainConfig:
     dataset_file: str = "qa_dataset.parquet"
     output_dir: str = "outputs"
     tokenizer_path: Optional[str] = None  # defaults to model_name
+    # None = the wilderness-survival persona (reference C7, training.py:176-186)
+    system_prompt: Optional[str] = None
 
     # optimization
     epochs: int = 4
@@ -172,6 +174,12 @@ class TrainConfig:
     # [batch, seq, vocab] float32 logits tensor never materializes (HBM saver
     # for large-vocab models; None = single full-sequence unembed).
     loss_chunk_size: Optional[int] = None
+
+    # objective: "sft" (the reference recipe) or "dpo" (preference pairs,
+    # BASELINE.json config #4 — the TRL DPOTrainer capability, first-party)
+    objective: str = "sft"
+    dpo_beta: float = 0.1              # TRL DPOConfig default
+    dpo_label_smoothing: float = 0.0   # conservative-DPO eps
 
     # freezing policy (reference training.py:113-149)
     freeze_strategy: str = "last_n_and_head"  # or "none" / "lora"
@@ -249,6 +257,8 @@ class TrainConfig:
         "ATTENTION_IMPL": ("attention_impl", str),
         "LOSS_CHUNK_SIZE": ("loss_chunk_size", int),
         "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
+        "OBJECTIVE": ("objective", str),
+        "DPO_BETA": ("dpo_beta", float),
     }
 
     def apply_env_overrides(self, environ=None) -> "TrainConfig":

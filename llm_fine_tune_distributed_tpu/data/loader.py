@@ -34,7 +34,7 @@ class SFTBatchLoader:
         shuffle: bool = True,
     ):
         self.arrays = arrays
-        self.n = arrays["input_ids"].shape[0]
+        self.n = next(iter(arrays.values())).shape[0]
         self.per_device_batch_size = per_device_batch_size
         self.grad_accum = grad_accum_steps
         self.dp = data_parallel_size
@@ -82,11 +82,9 @@ class SFTBatchLoader:
             lo = self.process_index * self.per_host_batch
             hi = lo + self.per_host_batch
             idx = idx[:, lo:hi]
-            yield {
-                "input_ids": self.arrays["input_ids"][idx],
-                "loss_mask": self.arrays["loss_mask"][idx],
-                "attention_mask": self.arrays["attention_mask"][idx],
-            }
+            # every array keyed by example index rides along (SFT:
+            # input_ids/loss_mask/attention_mask; DPO: chosen_*/rejected_*)
+            yield {k: v[idx] for k, v in self.arrays.items() if k != "lengths"}
 
     def __len__(self) -> int:
         return self.steps_per_epoch

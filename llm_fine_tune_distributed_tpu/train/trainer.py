@@ -76,6 +76,9 @@ class SFTTrainer:
             config.tokenizer_path or config.model_name
         )
         self.rng = jax.random.PRNGKey(config.seed if rng_seed is None else rng_seed)
+        # subclasses (DPO) stash extra eval-time scalars here; merged into the
+        # metric sinks whenever an eval fires
+        self.extra_eval_logs: Dict[str, float] = {}
         self.metrics = MetricLogger(
             config.output_dir,
             aim_repo=config.aim_repo,
@@ -91,6 +94,25 @@ class SFTTrainer:
 
     # ------------------------------------------------------------------ data
 
+    def _prompt_kwargs(self) -> Dict[str, Any]:
+        """system_prompt override for the array builders (shared SFT/DPO)."""
+        if self.config.system_prompt is not None:
+            return {"system_prompt": self.config.system_prompt}
+        return {}
+
+    def _loader_kwargs(self) -> Dict[str, Any]:
+        """Batch-loader kwargs (shared SFT/DPO so sharding semantics can't drift)."""
+        cfg = self.config
+        return dict(
+            per_device_batch_size=cfg.per_device_batch_size,
+            grad_accum_steps=cfg.gradient_accumulation_steps,
+            data_parallel_size=self.dp_size,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            seed=cfg.seed,
+            drop_last=cfg.drop_last,
+        )
+
     def _prepare_data(self) -> None:
         cfg = self.config
         dataset_path = os.path.join(cfg.data_dir, cfg.dataset_file)
@@ -105,21 +127,16 @@ class SFTTrainer:
             print(f"Training samples: {self.n_train:,}")
             print(f"Validation samples: {self.n_val:,}")
 
+        prompt_kw = self._prompt_kwargs()
         self.train_arrays = build_sft_arrays(
-            train_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss
+            train_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
+            **prompt_kw,
         )
         self.val_arrays = build_sft_arrays(
-            val_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss
+            val_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
+            **prompt_kw,
         )
-        loader_kw = dict(
-            per_device_batch_size=cfg.per_device_batch_size,
-            grad_accum_steps=cfg.gradient_accumulation_steps,
-            data_parallel_size=self.dp_size,
-            process_index=jax.process_index(),
-            process_count=jax.process_count(),
-            seed=cfg.seed,
-            drop_last=cfg.drop_last,
-        )
+        loader_kw = self._loader_kwargs()
         self.loader = None
         if cfg.use_native_loader:
             # C++ prefetch pipeline (native/loader.cc): batch assembly overlaps
@@ -247,15 +264,27 @@ class SFTTrainer:
 
     # ----------------------------------------------------------------- steps
 
-    def _prepare_steps(self) -> None:
-        # Sequence parallelism: when a seq axis is live and ring attention is
-        # selected, activations and batches shard the sequence dim too — the
-        # ring (parallel/ring_attention.py) then rotates K/V over that axis.
+    def _make_shardings(self) -> NamedSharding:
+        """Set batch/eval shardings; return the activation sharding.
+
+        Sequence parallelism: when a seq axis is live and ring attention is
+        selected, activations and batches shard the sequence dim too — the
+        ring (parallel/ring_attention.py) then rotates K/V over that axis.
+        Shared by the SFT and DPO step builders so the rules can't drift.
+        """
         seq_sharded = self.config.attention_impl == "ring" and self.mesh.shape["seq"] > 1
         seq_ax = "seq" if seq_sharded else None
         act = NamedSharding(self.mesh, P(("data", "fsdp"), seq_ax, None))
         self._batch_sharding = NamedSharding(self.mesh, P(None, ("data", "fsdp"), seq_ax))
         self._eval_sharding = NamedSharding(self.mesh, P(("data", "fsdp"), seq_ax))
+        return act
+
+    def _tokens_per_sample(self) -> int:
+        """Data tokens one 'sample' consumes (DPO overrides: a pair is 2 seqs)."""
+        return self.config.max_seq_length
+
+    def _prepare_steps(self) -> None:
+        act = self._make_shardings()
         train_step = build_train_step(
             self.model_config, self.config, self.optimizer, activation_sharding=act
         )
@@ -265,7 +294,8 @@ class SFTTrainer:
         )
 
     def _device_batch(self, batch: Dict[str, np.ndarray], sharding) -> Dict[str, jax.Array]:
-        return {k: jax.device_put(v, sharding) for k, v in batch.items() if k != "lengths"}
+        # "lengths" never reaches here: the loader strips it before yielding
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
     # ------------------------------------------------------------------ eval
 
@@ -324,7 +354,7 @@ class SFTTrainer:
         best_trainable = None
         last_eval: Optional[float] = None
         meter = ThroughputMeter(
-            n_chips=self.mesh.size, tokens_per_sample=cfg.max_seq_length
+            n_chips=self.mesh.size, tokens_per_sample=self._tokens_per_sample()
         )
         samples_per_step = cfg.per_device_batch_size * cfg.gradient_accumulation_steps * self.dp_size
 
@@ -413,12 +443,17 @@ class SFTTrainer:
                         final_loss = float(metrics["loss"])
                         logs = {
                             "loss": final_loss,
-                            "grad_norm": float(metrics["grad_norm"]),
                             "learning_rate": float(self.lr_schedule(step - 1)),
                             **meter.snapshot(),
                         }
+                        # every scalar the step emits (grad_norm always;
+                        # rewards_* for DPO) rides into the metric sinks
+                        for k, v in metrics.items():
+                            if k != "loss" and getattr(v, "ndim", 0) == 0:
+                                logs[k] = float(v)
                         if do_eval:
                             logs["eval_loss"] = last_eval
+                            logs.update(self.extra_eval_logs)
                         self.metrics.log(step, step / self.steps_per_epoch, logs)
 
                     if do_save:

@@ -30,7 +30,34 @@ def main() -> int:
         "--resume", nargs="?", const="latest", default=None,
         help="resume from checkpoint ('latest' or a step number)",
     )
+    parser.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. 'cpu' for simulation runs; overrides "
+             "any sitecustomize/env pinning)",
+    )
+    parser.add_argument(
+        "--virtual-devices", type=int, default=None,
+        help="with --platform cpu: number of virtual host devices "
+             "(XLA_FLAGS --xla_force_host_platform_device_count)",
+    )
     args = parser.parse_args()
+
+    if args.virtual_devices:
+        import re
+
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={args.virtual_devices}"
+        ).strip()
+    if args.platform:
+        import jax
+
+        # config.update (not the env var) wins even when a sitecustomize
+        # registered a hardware plugin at interpreter startup
+        jax.config.update("jax_platforms", args.platform)
 
     # Multi-host bootstrap MUST run before any jax backend use
     # (reference analog: setup_distributed, training.py:16-42).
@@ -65,9 +92,17 @@ def main() -> int:
         print(f"  data={config.data_dir} output={config.output_dir}")
         print("=" * 60)
 
-    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+    if config.objective not in ("sft", "dpo"):
+        raise SystemExit(
+            f"unknown OBJECTIVE {config.objective!r}; expected 'sft' or 'dpo'"
+        )
+    if config.objective == "dpo":
+        # preference-pair path (OBJECTIVE=dpo): BASELINE.json config #4
+        from llm_fine_tune_distributed_tpu.train.dpo import DPOTrainer as Trainer
+    else:
+        from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer as Trainer
 
-    trainer = SFTTrainer(config)
+    trainer = Trainer(config)
     summary = trainer.train()
 
     if is_primary_host():
