@@ -182,8 +182,13 @@ class TrainConfig:
     dpo_label_smoothing: float = 0.0   # conservative-DPO eps
 
     # freezing policy (reference training.py:113-149)
-    freeze_strategy: str = "last_n_and_head"  # or "none" / "lora"
+    freeze_strategy: str = "last_n_and_head"  # or "none" / "lora" / "qlora"
     unfreeze_last_n_layers: int = 2
+
+    # QLoRA quantization (freeze_strategy="qlora": NF4 frozen base)
+    quant_block_size: int = 64        # NF4 scale block (QLoRA paper default)
+    quant_double_quant: bool = True   # int8-compress the absmax scales
+    quant_matmul_impl: str = "auto"   # "auto" | "xla" | "pallas"
 
     # LoRA (external-doc config: r=16, alpha=8, dropout=0.05, 7 proj targets)
     lora_rank: int = 16

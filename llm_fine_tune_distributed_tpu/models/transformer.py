@@ -100,14 +100,26 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def _linear(x, p, compute_dtype):
+def _linear(x, p, compute_dtype, quant_impl: str = "auto"):
     """x @ kernel (+ bias), with optional additive LoRA branch.
 
     LoRA params, when present (parallel/lora.py), live beside the kernel as
     ``lora_a [in, r]`` / ``lora_b [r, out]`` and contribute
     ``(alpha/r) * x @ A @ B`` (external-doc LoRA config: r=16, alpha=8).
+
+    NF4-quantized kernels (QLoRA frozen base, ops/nf4.py) replace ``kernel``
+    with sibling leaves ``kernel_nf4`` (+ absmax scales); the matmul then
+    runs through the fused Pallas decode kernel or the XLA dequant path.
     """
-    y = x @ p["kernel"].astype(compute_dtype)
+    if "kernel_nf4" in p:
+        from llm_fine_tune_distributed_tpu.ops.nf4 import QUANT_SUFFIXES, nf4_matmul
+
+        q = {s: p[f"kernel_{s}"] for s in QUANT_SUFFIXES if f"kernel_{s}" in p}
+        y = nf4_matmul(
+            x.astype(compute_dtype), q, impl=quant_impl, compute_dtype=compute_dtype
+        )
+    else:
+        y = x @ p["kernel"].astype(compute_dtype)
     if "lora_a" in p:
         a = p["lora_a"].astype(compute_dtype)
         b = p["lora_b"].astype(compute_dtype)
@@ -132,6 +144,7 @@ def _block(
     attention_impl: str,
     compute_dtype,
     mesh=None,
+    quant_impl: str = "auto",
 ):
     """One transformer block. Returns (x, new_cache_entry)."""
     b, s, h = x.shape
@@ -140,9 +153,9 @@ def _block(
     attn_p = lp["self_attn"]
 
     hid = rms_norm(x, lp["input_layernorm"]["weight"], eps)
-    q = _linear(hid, attn_p["q_proj"], compute_dtype).reshape(b, s, config.num_heads, d)
-    k = _linear(hid, attn_p["k_proj"], compute_dtype).reshape(b, s, config.num_kv_heads, d)
-    v = _linear(hid, attn_p["v_proj"], compute_dtype).reshape(b, s, config.num_kv_heads, d)
+    q = _linear(hid, attn_p["q_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_heads, d)
+    k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
+    v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
 
     if config.uses_rope(layer_idx):
         q, k = apply_rope(q, k, cos, sin)
@@ -170,12 +183,12 @@ def _block(
         )
 
     out = out.reshape(b, s, config.num_heads * d)
-    x = x + _linear(out, attn_p["o_proj"], compute_dtype)
+    x = x + _linear(out, attn_p["o_proj"], compute_dtype, quant_impl)
 
     hid = rms_norm(x, lp["post_attention_layernorm"]["weight"], eps)
-    gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype)
-    up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype)
-    x = x + _linear(jax.nn.silu(gate) * up, lp["mlp"]["down_proj"], compute_dtype)
+    gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl)
+    up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype, quant_impl)
+    x = x + _linear(jax.nn.silu(gate) * up, lp["mlp"]["down_proj"], compute_dtype, quant_impl)
     return x, new_entry
 
 
@@ -194,6 +207,7 @@ def forward(
     logits_dtype=jnp.float32,
     activation_sharding=None,
     output_hidden: bool = False,
+    quant_impl: str = "auto",
 ) -> Tuple[jax.Array, Optional[Dict[str, Any]]]:
     """Run the model.
 
@@ -272,6 +286,7 @@ def forward(
             attention_impl=attention_impl,
             compute_dtype=compute_dtype,
             mesh=mesh,
+            quant_impl=quant_impl,
         )
         if remat and cache is None:
             block_fn = jax.checkpoint(block_fn)

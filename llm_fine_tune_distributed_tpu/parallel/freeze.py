@@ -32,9 +32,10 @@ def trainable_predicate(config: ModelConfig, train: TrainConfig) -> Callable[[st
     strategy = train.freeze_strategy
     if strategy == "none":
         return lambda path: True
-    if strategy == "lora":
+    if strategy in ("lora", "qlora"):
         # Only adapter matrices train; base weights AND the (constant)
-        # alpha/r scale stay frozen.
+        # alpha/r scale stay frozen. For qlora the frozen base is additionally
+        # NF4-quantized after the split (parallel/qlora.py).
         return lambda path: path.endswith(("lora_a", "lora_b"))
     if strategy == "last_n_and_head":
         cutoff = config.num_layers - train.unfreeze_last_n_layers

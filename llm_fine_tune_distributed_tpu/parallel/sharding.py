@@ -32,13 +32,17 @@ from llm_fine_tune_distributed_tpu.utils.tree import map_with_path
 # (path regex, spec builder) — first match wins. Specs are (dim0, dim1) for
 # matrices, (dim0,) for vectors. None = replicated on that dim.
 # "tensor-column": output dim over tensor; "tensor-row": input dim over tensor.
+# NF4-quantized kernels (ops/nf4.py) keep the base kernel's orientation:
+# packed [in/8, out] and absmax [in/block, out] shard like kernel [in, out]
+# (_validate_spec drops any axis the smaller dims no longer divide).
+_QK = r"kernel(_nf4|_absmax|_absmax_q)?$"
 _MATRIX_RULES = [
     # attention projections
-    (re.compile(r".*self_attn/(q_proj|k_proj|v_proj)/kernel$"), ("fsdp", "tensor")),
-    (re.compile(r".*self_attn/o_proj/kernel$"), ("tensor", "fsdp")),
+    (re.compile(r".*self_attn/(q_proj|k_proj|v_proj)/" + _QK), ("fsdp", "tensor")),
+    (re.compile(r".*self_attn/o_proj/" + _QK), ("tensor", "fsdp")),
     # MLP
-    (re.compile(r".*mlp/(gate_proj|up_proj)/kernel$"), ("fsdp", "tensor")),
-    (re.compile(r".*mlp/down_proj/kernel$"), ("tensor", "fsdp")),
+    (re.compile(r".*mlp/(gate_proj|up_proj)/" + _QK), ("fsdp", "tensor")),
+    (re.compile(r".*mlp/down_proj/" + _QK), ("tensor", "fsdp")),
     # embeddings: [vocab, hidden] — shard vocab over tensor, hidden over fsdp
     (re.compile(r".*embed_tokens/weight$"), ("tensor", "fsdp")),
     (re.compile(r".*lm_head/kernel$"), ("fsdp", "tensor")),

@@ -79,6 +79,7 @@ def make_dpo_loss_fn(
     model_config: ModelConfig,
     train_config: TrainConfig,
     activation_sharding=None,
+    quant_impl=None,
 ) -> Callable:
     """Returns loss_fn(trainable, ref_trainable, frozen, batch) -> (loss, aux).
 
@@ -89,6 +90,7 @@ def make_dpo_loss_fn(
     """
     compute_dtype = str_to_dtype(train_config.compute_dtype)
     chunk = train_config.loss_chunk_size
+    quant_impl = quant_impl or train_config.quant_matmul_impl
     beta = train_config.dpo_beta
     eps = train_config.dpo_label_smoothing
 
@@ -103,6 +105,7 @@ def make_dpo_loss_fn(
             remat=train_config.gradient_checkpointing,
             activation_sharding=activation_sharding,
             output_hidden=True,
+            quant_impl=quant_impl,
         )
         per_token = _target_logprobs(
             params, hidden[:, :-1], input_ids[:, 1:], model_config, chunk, compute_dtype
@@ -153,6 +156,7 @@ def build_dpo_train_step(
     train_config: TrainConfig,
     optimizer: optax.GradientTransformation,
     activation_sharding=None,
+    quant_impl=None,
 ) -> Callable:
     """train_step(state, ref_trainable, batch) -> (state, metrics).
 
@@ -160,7 +164,7 @@ def build_dpo_train_step(
     accumulation loop is a lax.scan compiled into one XLA program (same shape
     as the SFT step, train/step.py:96).
     """
-    loss_fn = make_dpo_loss_fn(model_config, train_config, activation_sharding)
+    loss_fn = make_dpo_loss_fn(model_config, train_config, activation_sharding, quant_impl)
     accum = train_config.gradient_accumulation_steps
     aux_keys = ("rewards_chosen", "rewards_rejected", "rewards_margin", "rewards_accuracy")
 
@@ -202,13 +206,14 @@ def build_dpo_eval_step(
     model_config: ModelConfig,
     train_config: TrainConfig,
     activation_sharding=None,
+    quant_impl=None,
 ) -> Callable:
     """eval_step(state, ref_trainable, batch) -> (loss_sum, acc_sum, n_real).
 
     ``batch["pair_mask"]`` is 1 for real rows, 0 for tail padding; sums are
     taken over real rows only so the caller aggregates exact means.
     """
-    loss_fn = make_dpo_loss_fn(model_config, train_config, activation_sharding)
+    loss_fn = make_dpo_loss_fn(model_config, train_config, activation_sharding, quant_impl)
 
     def eval_step(state: TrainState, ref_trainable, batch):
         batch = dict(batch)
@@ -305,13 +310,16 @@ class DPOTrainer(SFTTrainer):
         act = self._make_shardings()
         self._pair_mask_sharding = NamedSharding(self.mesh, P(("data", "fsdp")))
 
+        quant_impl = self._resolved_quant_impl()
         step = build_dpo_train_step(
-            self.model_config, self.config, self.optimizer, activation_sharding=act
+            self.model_config, self.config, self.optimizer, activation_sharding=act,
+            quant_impl=quant_impl,
         )
         jitted = jax.jit(step, donate_argnums=(0,))
         self.train_step = lambda state, batch: jitted(state, self.ref_trainable, batch)
         self._dpo_eval = jax.jit(
-            build_dpo_eval_step(self.model_config, self.config, activation_sharding=act)
+            build_dpo_eval_step(self.model_config, self.config, activation_sharding=act,
+                                quant_impl=quant_impl)
         )
 
     # ------------------------------------------------------------------ eval

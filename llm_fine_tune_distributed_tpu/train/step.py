@@ -56,9 +56,11 @@ def chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfig, chu
     return jax.lax.map(one_chunk, (hc, tc, mc)).sum()
 
 
-def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activation_sharding=None):
+def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activation_sharding=None,
+                 quant_impl: Optional[str] = None):
     compute_dtype = str_to_dtype(train_config.compute_dtype)
     chunk = train_config.loss_chunk_size
+    quant_impl = quant_impl or train_config.quant_matmul_impl
 
     def loss_fn(trainable, frozen, batch):
         """Masked next-token cross-entropy (token-mean within the batch) —
@@ -76,6 +78,7 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             activation_sharding=activation_sharding,
             logits_dtype=jnp.float32,
             output_hidden=chunk is not None,
+            quant_impl=quant_impl,
         )
         targets = batch["input_ids"][:, 1:]
         mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
@@ -98,6 +101,7 @@ def build_train_step(
     train_config: TrainConfig,
     optimizer: optax.GradientTransformation,
     activation_sharding=None,
+    quant_impl: Optional[str] = None,
 ) -> Callable:
     """Returns train_step(state, batch) -> (state, metrics).
 
@@ -106,7 +110,7 @@ def build_train_step(
     the accumulation factor (reference ``gradient_accumulation_steps=4``,
     ``training.py:262``).
     """
-    loss_fn = make_loss_fn(model_config, train_config, activation_sharding)
+    loss_fn = make_loss_fn(model_config, train_config, activation_sharding, quant_impl)
     accum = train_config.gradient_accumulation_steps
 
     def train_step(state: TrainState, batch):
@@ -147,13 +151,14 @@ def build_eval_step(
     model_config: ModelConfig,
     train_config: TrainConfig,
     activation_sharding=None,
+    quant_impl: Optional[str] = None,
 ) -> Callable:
     """eval_step(state, batch[b, s]) -> (sum_ce, token_count).
 
     Returns sums (not means) so the caller aggregates a token-weighted eval
     loss over the whole validation set — the quantity behind
     ``eval_loss``/best-model tracking (reference ``training.py:273-275``)."""
-    loss_fn = make_loss_fn(model_config, train_config, activation_sharding)
+    loss_fn = make_loss_fn(model_config, train_config, activation_sharding, quant_impl)
 
     def eval_step(state: TrainState, batch):
         loss, tokens = loss_fn(state.trainable, state.frozen, batch)

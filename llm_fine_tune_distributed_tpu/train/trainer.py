@@ -190,7 +190,7 @@ class SFTTrainer:
     def _prepare_state(self) -> None:
         cfg, mc = self.config, self.model_config
         params = self._load_or_init_params()
-        if cfg.freeze_strategy == "lora":
+        if cfg.freeze_strategy in ("lora", "qlora"):
             # Attach adapters (A kaiming, B zero: step-0 model == base model);
             # only lora_a/lora_b train (parallel/freeze.py), so optimizer
             # state shrinks to the adapter footprint.
@@ -213,7 +213,30 @@ class SFTTrainer:
         # Master copies: trainable in f32, frozen in compute dtype (bf16) —
         # frozen params carry no optimizer state and need no f32 master.
         trainable = {k: jnp.asarray(v, param_dtype) for k, v in trainable.items()}
-        frozen = {k: jnp.asarray(v, compute_dtype) for k, v in frozen.items()}
+        if cfg.freeze_strategy == "qlora":
+            # NF4-quantize the frozen block linears (from full precision —
+            # quantizing an already-bf16 cast would double the rounding).
+            from llm_fine_tune_distributed_tpu.parallel.qlora import (
+                quantize_frozen,
+                quantized_fraction,
+            )
+
+            frozen = quantize_frozen(
+                frozen, cfg.quant_block_size, cfg.quant_double_quant
+            )
+            if is_primary_host():
+                print(
+                    f"QLoRA: {100 * quantized_fraction(frozen):.1f}% of frozen "
+                    f"bytes in NF4 (block {cfg.quant_block_size}, "
+                    f"double_quant={cfg.quant_double_quant})"
+                )
+        frozen = {
+            k: jnp.asarray(v, compute_dtype)
+            # scales stay f32; packed codes / int8 absmax keep their dtype
+            if jnp.issubdtype(v.dtype, jnp.floating) and "absmax" not in k
+            else jnp.asarray(v)
+            for k, v in frozen.items()
+        }
 
         # Shard onto the mesh per path rules.
         def put(flat):
@@ -283,14 +306,25 @@ class SFTTrainer:
         """Data tokens one 'sample' consumes (DPO overrides: a pair is 2 seqs)."""
         return self.config.max_seq_length
 
+    def _resolved_quant_impl(self) -> str:
+        """The fused Pallas decode kernel is not SPMD-partitionable by the
+        sharding propagator; sharded runs take the XLA dequant path (still
+        4-bit at rest in HBM, one layer decoded at a time under remat)."""
+        if self.config.quant_matmul_impl == "auto" and self.mesh.size > 1:
+            return "xla"
+        return self.config.quant_matmul_impl
+
     def _prepare_steps(self) -> None:
         act = self._make_shardings()
+        quant_impl = self._resolved_quant_impl()
         train_step = build_train_step(
-            self.model_config, self.config, self.optimizer, activation_sharding=act
+            self.model_config, self.config, self.optimizer, activation_sharding=act,
+            quant_impl=quant_impl,
         )
         self.train_step = jit_train_step(train_step)
         self.eval_step = jax.jit(
-            build_eval_step(self.model_config, self.config, activation_sharding=act)
+            build_eval_step(self.model_config, self.config, activation_sharding=act,
+                            quant_impl=quant_impl)
         )
 
     def _device_batch(self, batch: Dict[str, np.ndarray], sharding) -> Dict[str, jax.Array]:
@@ -544,11 +578,22 @@ class SFTTrainer:
             return summary
 
         best_dir = os.path.join(cfg.output_dir, "best_model")
+        frozen_flat = {k: np.asarray(v) for k, v in self.state.frozen.items()}
+        if cfg.freeze_strategy == "qlora":
+            # Export contract is plain safetensors (reference training.py:310):
+            # decode the NF4 base back to bf16 so the inference CLI / HF
+            # loaders see ordinary kernels.
+            from llm_fine_tune_distributed_tpu.parallel.qlora import dequantize_frozen
+
+            frozen_flat = {
+                k: np.asarray(v)
+                for k, v in dequantize_frozen(frozen_flat, jnp.float32).items()
+            }
         params = merge_flat(
             {k: np.asarray(v) for k, v in self.state.trainable.items()},
-            {k: np.asarray(v) for k, v in self.state.frozen.items()},
+            frozen_flat,
         )
-        if cfg.freeze_strategy == "lora":
+        if cfg.freeze_strategy in ("lora", "qlora"):
             # Export both forms: standalone PEFT adapter (small, composable)
             # and the merged model (what the serving path actually loads —
             # rank-16 side matmuls would waste MXU occupancy at inference).

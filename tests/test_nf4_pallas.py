@@ -1,0 +1,70 @@
+"""Fused NF4 Pallas matmul numerics under the Pallas TPU interpreter
+(hardware-free CI analog; the same kernel runs compiled on the real chip —
+see bench.py / the verify drives)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+from llm_fine_tune_distributed_tpu.ops.nf4 import dequantize_nf4, quantize_nf4
+from llm_fine_tune_distributed_tpu.ops.nf4_pallas import nf4_matmul_pallas
+
+
+@pytest.mark.parametrize("double_quant", [False, True])
+def test_pallas_matches_xla_dequant(double_quant):
+    rng = np.random.RandomState(0)
+    K, N, M = 512, 256, 24  # M deliberately not a multiple of 16 (pad path)
+    w = rng.randn(K, N).astype(np.float32)
+    x = (rng.randn(M, K) * 0.5).astype(np.float32)
+    q = {k: jnp.asarray(v) for k, v in quantize_nf4(w, 64, double_quant).items()}
+
+    with pltpu.force_tpu_interpret_mode():
+        y = nf4_matmul_pallas(jnp.asarray(x), q, compute_dtype=jnp.float32)
+
+    ref = np.asarray(x).astype(np.float32) @ np.asarray(dequantize_nf4(q, jnp.float32))
+    assert y.shape == (M, N)
+    # kernel computes in bf16 operands + f32 accumulate
+    rel = np.abs(np.asarray(y) - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.03, rel
+
+
+def test_pallas_batched_leading_dims():
+    rng = np.random.RandomState(1)
+    K, N = 512, 128
+    w = rng.randn(K, N).astype(np.float32)
+    x = rng.randn(2, 8, K).astype(np.float32)
+    q = {k: jnp.asarray(v) for k, v in quantize_nf4(w, 64, True).items()}
+    with pltpu.force_tpu_interpret_mode():
+        y = nf4_matmul_pallas(jnp.asarray(x), q, compute_dtype=jnp.float32)
+    assert y.shape == (2, 8, N)
+    ref = np.asarray(x).reshape(16, K) @ np.asarray(dequantize_nf4(q, jnp.float32))
+    rel = np.abs(np.asarray(y).reshape(16, N) - ref).max() / np.abs(ref).max()
+    assert rel < 0.03, rel
+
+
+def test_pallas_grad_through_x():
+    """QLoRA training differentiates THROUGH frozen quantized matmuls (dx must
+    reach upstream adapters); the kernel's custom_vjp supplies g @ W^T."""
+    rng = np.random.RandomState(3)
+    K, N = 512, 128
+    w = rng.randn(K, N).astype(np.float32)
+    x = jnp.asarray(rng.randn(16, K).astype(np.float32))
+    q = {k: jnp.asarray(v) for k, v in quantize_nf4(w, 64, False).items()}
+
+    with pltpu.force_tpu_interpret_mode():
+        g = jax.grad(lambda x: nf4_matmul_pallas(x, q, compute_dtype=jnp.float32).sum())(x)
+    ref = np.ones((16, N), np.float32) @ np.asarray(dequantize_nf4(q, jnp.float32)).T
+    rel = np.abs(np.asarray(g) - ref).max() / np.abs(ref).max()
+    assert rel < 0.03, rel
+
+
+def test_unsupported_shapes_raise():
+    rng = np.random.RandomState(2)
+    w = rng.randn(256, 128).astype(np.float32)  # K=256 not divisible by 512
+    q = {k: jnp.asarray(v) for k, v in quantize_nf4(w, 64, False).items()}
+    with pytest.raises(ValueError, match="512"):
+        with pltpu.force_tpu_interpret_mode():
+            nf4_matmul_pallas(jnp.ones((16, 256)), q)
