@@ -22,6 +22,14 @@ import json
 import os
 import time
 
+# The flash-attention backward can exceed the default 16M scoped-vmem budget
+# at larger microbatches; raise it before the TPU backend initializes.
+if "xla_tpu_scoped_vmem_limit_kib" not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "")
+        + " --xla_tpu_scoped_vmem_limit_kib=32768"
+    ).strip()
+
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 6.78
 
 
@@ -51,6 +59,7 @@ def build(model_preset, per_device_batch_size, grad_accum, seq_len, attention_im
         gradient_checkpointing=True,
         attention_impl=attention_impl,
         loss_chunk_size=loss_chunk,
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots_no_batch") or None,
     )
     mesh = make_mesh(MeshConfig(data=1, fsdp=-1, tensor=1, seq=1))
     dp = data_parallel_size(mesh)
@@ -107,11 +116,14 @@ def main():
     on_accelerator = platform != "cpu"
     preset = os.environ.get("BENCH_PRESET", "smollm3_3b" if on_accelerator else "tiny")
     if on_accelerator:
-        bs = int(os.environ.get("BENCH_BATCH", "4"))
-        accum = int(os.environ.get("BENCH_ACCUM", "8"))
+        # Best single-chip v5e recipe found by sweep: microbatch 1 with the
+        # matmul-saving remat policy beats bigger microbatches under full
+        # remat (v5e is compute-bound; recompute FLOPs dominate).
+        bs = int(os.environ.get("BENCH_BATCH", "1"))
+        accum = int(os.environ.get("BENCH_ACCUM", "32"))
         seq = int(os.environ.get("BENCH_SEQ", "1024"))
         warmup, timed = 2, int(os.environ.get("BENCH_STEPS", "6"))
-        loss_chunk = 512
+        loss_chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "512"))
     else:  # CPU smoke fallback so the harness always gets its JSON line
         bs, accum, seq, warmup, timed, loss_chunk = 2, 2, 128, 1, 2, 64
     attention_impl = os.environ.get("BENCH_ATTENTION", "flash")
@@ -126,10 +138,13 @@ def main():
         state, metrics = step_fn(state, batch)
     jax.block_until_ready(metrics)
 
+    # Force a host sync EVERY step: on remote-tunnel platforms
+    # block_until_ready on the final future alone has produced bogus
+    # sub-millisecond timings for multi-second step chains.
     t0 = time.perf_counter()
     for _ in range(timed):
         state, metrics = step_fn(state, batch)
-    jax.block_until_ready(metrics)
+        _ = float(metrics["loss"])
     elapsed = time.perf_counter() - t0
 
     sps_chip = samples_per_step * timed / elapsed / n_chips

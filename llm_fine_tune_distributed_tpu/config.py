@@ -167,6 +167,9 @@ class TrainConfig:
     param_dtype: str = "float32"     # master weights
     compute_dtype: str = "bfloat16"  # activations / matmuls
     gradient_checkpointing: bool = True
+    # remat granularity: "full" (recompute whole block — min memory),
+    # "dots" / "dots_no_batch" (save matmul outputs — less recompute, more HBM)
+    remat_policy: Optional[str] = None
     # loss on completion tokens only? TRL SFTTrainer default (packing=False,
     # no completion_only flag in the reference) trains on the full sequence.
     completion_only_loss: bool = False
@@ -260,6 +263,7 @@ class TrainConfig:
         "GRAD_ACCUM_STEPS": ("gradient_accumulation_steps", int),
         "SEED": ("seed", int),
         "ATTENTION_IMPL": ("attention_impl", str),
+        "REMAT_POLICY": ("remat_policy", str),
         "LOSS_CHUNK_SIZE": ("loss_chunk_size", int),
         "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
         "OBJECTIVE": ("objective", str),

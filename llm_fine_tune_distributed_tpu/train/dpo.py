@@ -103,6 +103,7 @@ def make_dpo_loss_fn(
             attention_impl=train_config.attention_impl,
             compute_dtype=compute_dtype,
             remat=train_config.gradient_checkpointing,
+            remat_policy=train_config.remat_policy,
             activation_sharding=activation_sharding,
             output_hidden=True,
             quant_impl=quant_impl,

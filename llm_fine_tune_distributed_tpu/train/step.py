@@ -75,6 +75,7 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             attention_impl=train_config.attention_impl,
             compute_dtype=compute_dtype,
             remat=train_config.gradient_checkpointing,
+            remat_policy=train_config.remat_policy,
             activation_sharding=activation_sharding,
             logits_dtype=jnp.float32,
             output_hidden=chunk is not None,
