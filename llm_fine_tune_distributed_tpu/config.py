@@ -163,6 +163,9 @@ class TrainConfig:
 
     # sequence / precision
     max_seq_length: int = 1024
+    # packing=True packs multiple examples per row with an exact
+    # block-diagonal segment mask (data/packing.py). Attention runs through
+    # the explicit-mask XLA path (flash/ring impls apply to unpacked runs).
     packing: bool = False
     param_dtype: str = "float32"     # master weights
     compute_dtype: str = "bfloat16"  # activations / matmuls

@@ -116,6 +116,26 @@ def tokenize_example(
     return TokenizedExample(input_ids=input_ids, loss_mask=loss_mask, length=length)
 
 
+def tokenize_rows(
+    rows: List[dict],
+    tokenizer,
+    max_seq_length: int,
+    completion_only: bool = False,
+    system_prompt: str = WILDERNESS_EXPERT_SYSTEM_PROMPT,
+) -> List[TokenizedExample]:
+    """Tokenize a whole split (shared by the padded and packed array builders
+    so the two paths cannot diverge in tokenization)."""
+    return [
+        tokenize_example(
+            format_chat_example(r, system_prompt)["messages"],
+            tokenizer,
+            max_seq_length,
+            completion_only,
+        )
+        for r in rows
+    ]
+
+
 def build_sft_arrays(
     rows: List[dict],
     tokenizer,
@@ -125,16 +145,8 @@ def build_sft_arrays(
 ) -> Dict[str, np.ndarray]:
     """Tokenize a whole split into stacked arrays (the dataset is tiny —
     2,845 rows, reference ``claude.md:98`` — so host RAM tokenization upfront
-    beats streaming; large corpora use data/packing.py + grain instead)."""
-    examples = [
-        tokenize_example(
-            format_chat_example(r, system_prompt)["messages"],
-            tokenizer,
-            max_seq_length,
-            completion_only,
-        )
-        for r in rows
-    ]
+    beats streaming; packing=True uses data/packing.py instead)."""
+    examples = tokenize_rows(rows, tokenizer, max_seq_length, completion_only, system_prompt)
     input_ids = np.stack([e.input_ids for e in examples])
     lengths = np.asarray([e.length for e in examples], dtype=np.int32)
     # attention_mask: 1 where the token is real (not right-padding) — the

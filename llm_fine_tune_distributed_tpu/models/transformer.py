@@ -205,6 +205,7 @@ def forward(
     *,
     positions=None,
     padding_mask=None,
+    segment_ids=None,
     cache: Optional[Dict[str, Any]] = None,
     cache_pos: int | jax.Array = 0,
     attention_impl: str = "xla",
@@ -263,7 +264,21 @@ def forward(
     cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
 
     explicit_mask = None
-    if cache is not None:
+    if segment_ids is not None:
+        if cache is not None:
+            raise ValueError("segment_ids (packing) and KV cache are exclusive")
+        # Packed batch (data/packing.py): block-diagonal causal mask — token i
+        # attends to j iff same segment and j <= i. Padding tail is segment 0
+        # and masks itself out via the same-segment test against real tokens;
+        # pad rows still see themselves (j == i) so softmax stays finite.
+        idx = jnp.arange(s, dtype=jnp.int32)
+        causal = idx[None, None, :] <= idx[None, :, None]
+        same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
+        explicit_mask = causal & same_seg
+        if config.sliding_window is not None:
+            q_pos, k_pos = positions[:, :, None], positions[:, None, :]
+            explicit_mask &= k_pos > q_pos - config.sliding_window
+    elif cache is not None:
         # Mask over the fixed-size buffer: key j visible to query i iff
         # j <= position(i), and within the sliding window if configured.
         kv_len = cache["layers"]["0"]["k"].shape[1]

@@ -67,11 +67,18 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
         the SFT objective TRL computes for packing=False full-sequence LM
         loss (reference ``training.py:282-283``). Returns (loss, token_count)."""
         params = merge_flat(trainable, frozen)
+        packed_kw = {}
+        if "segment_ids" in batch:  # packing=True path (data/packing.py)
+            packed_kw = {
+                "segment_ids": batch["segment_ids"],
+                "positions": batch["positions"],
+            }
         out, _ = forward(
             params,
             batch["input_ids"],
             model_config,
             padding_mask=batch["attention_mask"],
+            **packed_kw,
             attention_impl=train_config.attention_impl,
             compute_dtype=compute_dtype,
             remat=train_config.gradient_checkpointing,

@@ -128,17 +128,48 @@ class SFTTrainer:
             print(f"Validation samples: {self.n_val:,}")
 
         prompt_kw = self._prompt_kwargs()
-        self.train_arrays = build_sft_arrays(
-            train_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
-            **prompt_kw,
-        )
-        self.val_arrays = build_sft_arrays(
-            val_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
-            **prompt_kw,
-        )
+        if cfg.packing:
+            # packing=True: multiple examples per fixed-length row with
+            # segment ids / per-segment positions (data/packing.py). Rows
+            # shrink, so steps_per_epoch and the sample counters reflect
+            # PACKED rows, matching TRL's packing accounting.
+            from llm_fine_tune_distributed_tpu.data.packing import (
+                build_packed_sft_arrays,
+                packing_efficiency,
+            )
+
+            self.train_arrays = build_packed_sft_arrays(
+                train_rows, self.tokenizer, cfg.max_seq_length,
+                cfg.completion_only_loss, **prompt_kw,
+            )
+            self.val_arrays = build_packed_sft_arrays(
+                val_rows, self.tokenizer, cfg.max_seq_length,
+                cfg.completion_only_loss, **prompt_kw,
+            )
+            self.n_train = self.train_arrays["input_ids"].shape[0]
+            self.n_val = self.val_arrays["input_ids"].shape[0]
+            if is_primary_host():
+                print(
+                    f"Packing: {len(train_rows):,} examples -> {self.n_train:,} "
+                    f"rows ({100 * packing_efficiency(self.train_arrays):.1f}% "
+                    f"token occupancy)"
+                )
+        else:
+            self.train_arrays = build_sft_arrays(
+                train_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
+                **prompt_kw,
+            )
+            self.val_arrays = build_sft_arrays(
+                val_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
+                **prompt_kw,
+            )
         loader_kw = self._loader_kwargs()
         self.loader = None
-        if cfg.use_native_loader:
+        if cfg.use_native_loader and cfg.packing:
+            if is_primary_host():
+                print("[data] packing=True uses the Python loader (the C++ "
+                      "pipeline assembles the unpacked key triplet)")
+        elif cfg.use_native_loader:
             # C++ prefetch pipeline (native/loader.cc): batch assembly overlaps
             # device step time. Falls back to the Python loader without g++.
             # The two engines use different (each deterministic) permutations,
@@ -344,17 +375,20 @@ class SFTTrainer:
         total_ce, total_tokens = 0.0, 0.0
         for lo in range(0, n, bs):
             batch = {
-                "input_ids": self.val_arrays["input_ids"][lo : lo + bs],
-                "loss_mask": self.val_arrays["loss_mask"][lo : lo + bs],
-                "attention_mask": self.val_arrays["attention_mask"][lo : lo + bs],
+                k: v[lo : lo + bs]
+                for k, v in self.val_arrays.items()
+                if k != "lengths"
             }
             short = bs - batch["input_ids"].shape[0]
             if short > 0:
                 # pad the tail batch; padded rows carry zero loss_mask so they
-                # contribute no tokens to the token-weighted loss
+                # contribute no tokens to the token-weighted loss. Pad rows
+                # must not produce fully-masked attention rows: attention_mask
+                # is set real, and (packing) segment_ids nonzero so each pad
+                # token still attends to itself.
                 for key in batch:
                     pad_block = np.zeros((short,) + batch[key].shape[1:], batch[key].dtype)
-                    if key == "attention_mask":
+                    if key in ("attention_mask", "segment_ids"):
                         pad_block[:] = 1
                     batch[key] = np.concatenate([batch[key], pad_block])
             batch = self._device_batch(batch, self._eval_sharding)
