@@ -327,6 +327,20 @@ class SFTTrainer:
         Shared by the SFT and DPO step builders so the rules can't drift.
         """
         seq_sharded = self.config.attention_impl == "ring" and self.mesh.shape["seq"] > 1
+        if (
+            seq_sharded
+            and jax.process_count() > 1
+            and self.mesh.shape["seq"] * self.mesh.shape["tensor"]
+            > jax.local_device_count()
+        ):
+            # The loader hands each process host-complete sequences; a seq
+            # axis crossing process boundaries would need seq-sliced host
+            # data too. Keep the ring within a host (ICI) for now.
+            raise NotImplementedError(
+                "multi-host runs require the seq axis to fit within one "
+                f"host's devices (seq*tensor={self.mesh.shape['seq'] * self.mesh.shape['tensor']}"
+                f" > local devices {jax.local_device_count()}); reshape the mesh"
+            )
         seq_ax = "seq" if seq_sharded else None
         act = NamedSharding(self.mesh, P(("data", "fsdp"), seq_ax, None))
         self._batch_sharding = NamedSharding(self.mesh, P(None, ("data", "fsdp"), seq_ax))
@@ -358,8 +372,32 @@ class SFTTrainer:
                             quant_impl=quant_impl)
         )
 
-    def _device_batch(self, batch: Dict[str, np.ndarray], sharding) -> Dict[str, jax.Array]:
-        # "lengths" never reaches here: the loader strips it before yielding
+    def _device_batch(
+        self, batch: Dict[str, np.ndarray], sharding, local_shards: bool = False
+    ) -> Dict[str, jax.Array]:
+        # "lengths" never reaches here: the loader strips it before yielding.
+        #
+        # Two multi-process cases:
+        # - local_shards=True (training): each process holds only ITS column
+        #   of the global batch (data/loader.py shards by process_index), so
+        #   the global array is assembled from per-process pieces.
+        # - local_shards=False (eval): every process builds the identical full
+        #   batch, and device_put's global semantics take each host's shard.
+        if local_shards and jax.process_count() > 1:
+            # Global shape is the loader contract — batch dim (axis 1 of
+            # [accum, per_host_batch, seq]) is split contiguously by process
+            # index, everything else host-complete. Passing it explicitly
+            # (instead of letting inference guess from the sharding) keeps
+            # this correct for meshes whose batch axes do not span every
+            # process uniformly.
+            return {
+                k: jax.make_array_from_process_local_data(
+                    sharding,
+                    v,
+                    (v.shape[0], v.shape[1] * jax.process_count(), *v.shape[2:]),
+                )
+                for k, v in batch.items()
+            }
         return {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
     # ------------------------------------------------------------------ eval
@@ -468,7 +506,9 @@ class SFTTrainer:
 
                     batches = itertools.islice(batches, skip_batches, None)
                 for batch in batches:
-                    dev_batch = self._device_batch(batch, self._batch_sharding)
+                    dev_batch = self._device_batch(
+                        batch, self._batch_sharding, local_shards=True
+                    )
                     self.state, metrics = self.train_step(self.state, dev_batch)
                     step += 1
                     meter.update(samples_per_step)
@@ -503,9 +543,18 @@ class SFTTrainer:
                         if improved:
                             best_eval = last_eval
                             if cfg.load_best_model_at_end:
-                                best_trainable = jax.tree.map(
-                                    lambda x: np.asarray(x), self.state.trainable
-                                )
+                                # single-process: snapshot to host RAM (free
+                                # HBM). Multi-process: param shards are not
+                                # host-fetchable — keep an on-device copy
+                                # with the same shardings instead.
+                                if jax.process_count() == 1:
+                                    best_trainable = jax.tree.map(
+                                        lambda x: np.asarray(x), self.state.trainable
+                                    )
+                                else:
+                                    best_trainable = jax.tree.map(
+                                        jnp.copy, self.state.trainable
+                                    )
 
                     if do_log or do_eval:
                         final_loss = float(metrics["loss"])
@@ -578,6 +627,28 @@ class SFTTrainer:
 
     # -------------------------------------------------------------- artifacts
 
+    def _host_fetch(self, flat: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
+        """Flat param dict -> host numpy, correct under multi-process.
+
+        Sharded leaves of a multi-process mesh are not host-fetchable
+        directly; reshard them to fully-replicated first (an all-gather
+        collective — so when process_count > 1 EVERY host must call this,
+        see _save_artifacts).
+        """
+        if jax.process_count() == 1:
+            return {k: np.asarray(v) for k, v in flat.items()}
+        replicated = NamedSharding(self.mesh, P())
+        out = {}
+        primary = is_primary_host()
+        for k, v in flat.items():
+            if not v.sharding.is_fully_replicated:
+                v = jax.device_put(v, replicated)
+            if primary:
+                # only the writing host pays the device->host transfer and
+                # host RAM; the others just participated in the collective
+                out[k] = np.asarray(v)
+        return out
+
     def _save_artifacts(
         self,
         final_loss: Optional[float],
@@ -608,11 +679,14 @@ class SFTTrainer:
             "mesh": dict(self.mesh.shape),
             **{k: round(v, 4) for k, v in throughput.items()},
         }
+        # Host fetch runs on EVERY host: resharding a multi-process array to
+        # replicated is a collective, and a host-0-only collective deadlocks.
+        frozen_flat = self._host_fetch(self.state.frozen)
+        trainable_flat = self._host_fetch(self.state.trainable)
         if not is_primary_host():
             return summary
 
         best_dir = os.path.join(cfg.output_dir, "best_model")
-        frozen_flat = {k: np.asarray(v) for k, v in self.state.frozen.items()}
         if cfg.freeze_strategy == "qlora":
             # Export contract is plain safetensors (reference training.py:310):
             # decode the NF4 base back to bf16 so the inference CLI / HF
@@ -623,10 +697,7 @@ class SFTTrainer:
                 k: np.asarray(v)
                 for k, v in dequantize_frozen(frozen_flat, jnp.float32).items()
             }
-        params = merge_flat(
-            {k: np.asarray(v) for k, v in self.state.trainable.items()},
-            frozen_flat,
-        )
+        params = merge_flat(trainable_flat, frozen_flat)
         if cfg.freeze_strategy in ("lora", "qlora"):
             # Export both forms: standalone PEFT adapter (small, composable)
             # and the merged model (what the serving path actually loads —

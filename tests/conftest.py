@@ -21,6 +21,10 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running integration test")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()
