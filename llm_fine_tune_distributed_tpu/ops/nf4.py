@@ -214,3 +214,24 @@ DEQUANT_MARKERS = ("_absmax_offset", "_absmax_scale", "_absmax_q", "_absmax", "_
 def quantized_keys(prefix: str) -> tuple:
     """The sibling leaf names a quantized ``{prefix}`` may occupy."""
     return tuple(f"{prefix}_{s}" for s in QUANT_SUFFIXES)
+
+
+def quantized_layout(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
+    """suffix -> (shape, dtype) for quantize_nf4's output arrays.
+
+    The single source of truth for the storage layout — used by shape-level
+    planners (parallel/qlora.quantize_frozen_abstract) so the abstract and
+    real quantizers cannot drift.
+    """
+    import math
+
+    k, n = shape
+    out = {"nf4": ((k // 8, n), jnp.int32)}
+    if double_quant:
+        n_scales = (k // block_size) * n
+        out["absmax_q"] = ((k // block_size, n), jnp.int8)
+        out["absmax_scale"] = ((math.ceil(n_scales / ABSMAX_GROUP),), jnp.float32)
+        out["absmax_offset"] = ((), jnp.float32)
+    else:
+        out["absmax"] = ((k // block_size, n), jnp.float32)
+    return out

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -27,6 +28,7 @@ from llm_fine_tune_distributed_tpu.ops.nf4 import (
     DEQUANT_MARKERS,
     dequantize_nf4,
     quantize_nf4,
+    quantized_layout,
 )
 
 
@@ -78,6 +80,30 @@ def dequantize_frozen(frozen: Dict, dtype=jnp.bfloat16) -> Dict:
             out[path] = leaf
     for base, q in groups.items():
         out[base] = dequantize_nf4(q, dtype=dtype)
+    return out
+
+
+def quantize_frozen_abstract(
+    frozen: Dict,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    double_quant: bool = True,
+) -> Dict:
+    """Shape-level ``quantize_frozen``: ShapeDtypeStructs in, structs out.
+
+    Lets planners (and the big-config trace tests) compute the exact
+    post-quantization memory layout of a 70B model without touching weights.
+    The layout comes from ops/nf4.quantized_layout — the same source the
+    real quantizer encodes — so the two cannot drift.
+    """
+    out: Dict = {}
+    for path, leaf in frozen.items():
+        if not _is_quantizable(path, leaf) or leaf.shape[0] % block_size:
+            out[path] = leaf
+            continue
+        for suffix, (shape, dtype) in quantized_layout(
+            leaf.shape, block_size, double_quant
+        ).items():
+            out[f"{path}_{suffix}"] = jax.ShapeDtypeStruct(shape, dtype)
     return out
 
 
