@@ -136,6 +136,7 @@ def _block(
     cos,
     sin,
     padding_mask,
+    segment_ids,
     explicit_mask,
     cache_entry,
     cache_pos,
@@ -178,6 +179,7 @@ def _block(
             v,
             impl=attention_impl,
             padding_mask=padding_mask,
+            segment_ids=segment_ids,
             causal=True,
             sliding_window=config.sliding_window,
             mesh=mesh,
@@ -267,17 +269,19 @@ def forward(
     if segment_ids is not None:
         if cache is not None:
             raise ValueError("segment_ids (packing) and KV cache are exclusive")
-        # Packed batch (data/packing.py): block-diagonal causal mask — token i
-        # attends to j iff same segment and j <= i. Padding tail is segment 0
-        # and masks itself out via the same-segment test against real tokens;
-        # pad rows still see themselves (j == i) so softmax stays finite.
-        idx = jnp.arange(s, dtype=jnp.int32)
-        causal = idx[None, None, :] <= idx[None, :, None]
-        same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
-        explicit_mask = causal & same_seg
+        # Packed batch (data/packing.py): attention is restricted to equal
+        # segment ids (block-diagonal causal). The segment ids flow into the
+        # attention dispatch so the Pallas flash kernel (which masks by
+        # segment natively) stays usable; only the sliding-window case needs
+        # an explicit mask (window distance uses per-segment positions).
         if config.sliding_window is not None:
+            idx = jnp.arange(s, dtype=jnp.int32)
+            causal = idx[None, None, :] <= idx[None, :, None]
+            same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
+            explicit_mask = causal & same_seg
             q_pos, k_pos = positions[:, :, None], positions[:, None, :]
             explicit_mask &= k_pos > q_pos - config.sliding_window
+            segment_ids = None  # consumed into the explicit mask
     elif cache is not None:
         # Mask over the fixed-size buffer: key j visible to query i iff
         # j <= position(i), and within the sliding window if configured.
@@ -334,6 +338,7 @@ def forward(
             cos,
             sin,
             padding_mask,
+            segment_ids,
             explicit_mask,
             entry,
             cache_pos,

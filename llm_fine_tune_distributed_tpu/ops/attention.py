@@ -43,6 +43,7 @@ def xla_attention(
     v,
     *,
     padding_mask=None,
+    segment_ids=None,
     causal: bool = True,
     sliding_window: Optional[int] = None,
     mask=None,
@@ -50,6 +51,8 @@ def xla_attention(
     """Reference masked attention with GQA, f32 softmax.
 
     padding_mask: optional [batch, kv_len] bool/int, 1 = real token.
+    segment_ids: optional [batch, kv_len] int32 packing segments — attention
+      is restricted to equal ids (block-diagonal; 0 = pad tail).
     mask: optional explicit [batch, q_len, kv_len] bool mask (True = attend);
       when given it replaces the causal mask (used by the KV-cache decode path).
     """
@@ -72,6 +75,13 @@ def xla_attention(
     if padding_mask is not None:
         pm = padding_mask.astype(bool)[:, None, None, None, :]
         scores = jnp.where(pm, scores, _NEG_INF)
+    if segment_ids is not None:
+        same = segment_ids[:, None, :] == segment_ids[:, :, None]  # [b, q, kv]
+        scores = jnp.where(same[:, None, None], scores, _NEG_INF)
+        # keep every softmax row finite: pad rows (seg 0) attend themselves
+        eye = jnp.eye(q_len, kv_len, dtype=bool) if q_len == kv_len else None
+        if eye is not None:
+            scores = jnp.where(eye[None, None, None], jnp.maximum(scores, -1e9), scores)
 
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
@@ -85,6 +95,7 @@ def attention(
     *,
     impl: str = "xla",
     padding_mask=None,
+    segment_ids=None,
     causal: bool = True,
     sliding_window: Optional[int] = None,
     mesh=None,
@@ -94,6 +105,21 @@ def attention(
     ``mesh`` is only consulted by the ring path (sequence parallelism); the
     trainer passes the active mesh whenever ``attention_impl="ring"``.
     """
+    if impl == "ring" and segment_ids is not None:
+        # the ring rotation has no segment support; packed batches take the
+        # flash kernel (which masks by segment natively) or XLA. Be loud:
+        # a user who provisioned a seq axis should know it is being bypassed
+        # (and beyond the flash kernel's max length this degrades to
+        # quadratic XLA attention).
+        import warnings
+
+        warnings.warn(
+            "packing (segment_ids) disables ring attention; falling back to "
+            f"flash/XLA for seq {q.shape[1]} — disable packing for "
+            "sequence-parallel long-context runs",
+            stacklevel=2,
+        )
+        impl = "flash"
     if impl == "ring":
         from llm_fine_tune_distributed_tpu.parallel.ring_attention import (
             ring_attention,
@@ -113,10 +139,13 @@ def attention(
         )
 
         if flash_attention_supported(q, k, v, sliding_window=sliding_window, causal=causal):
-            return pallas_flash_attention(q, k, v, padding_mask=padding_mask)
+            return pallas_flash_attention(
+                q, k, v, padding_mask=padding_mask, segment_ids=segment_ids
+            )
         impl = "xla"
     if impl == "xla":
         return xla_attention(
-            q, k, v, padding_mask=padding_mask, causal=causal, sliding_window=sliding_window
+            q, k, v, padding_mask=padding_mask, segment_ids=segment_ids,
+            causal=causal, sliding_window=sliding_window,
         )
     raise ValueError(f"unknown attention impl {impl!r}")

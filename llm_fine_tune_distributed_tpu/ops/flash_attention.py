@@ -20,9 +20,10 @@ and dk/dv stay at KV-head width. Decode uses the XLA cache path, not this
 kernel.
 
 Layout contract (matches ops/attention.py): q [b, sq, hq, d], k/v
-[b, sk, hkv, d], output [b, sq, hq, d] in q.dtype. Padding is expressed as
-per-row ``lengths`` (right-padding, the only padding the data pipeline
-produces); softmax runs in float32.
+[b, sk, hkv, d], output [b, sq, hq, d] in q.dtype. Masking is expressed as
+per-position ``segments`` [b, s] int32 — attention flows within equal ids
+only (0 = padding tail; sequence packing passes its real segment ids, plain
+right-padded batches pass the 1/0 padding mask); softmax runs in float32.
 """
 
 from __future__ import annotations
@@ -45,12 +46,14 @@ _MAX_KERNEL_SEQ = 4096  # whole K/V/Q reside in VMEM per program; ring
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, groups):
+def _fwd_kernel(seg_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, groups):
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)  # [BQ, d]
     bq, d = q.shape
     q_start = iq * bq
-    length = len_ref[pl.program_id(0)]
+    # 0 = padding, >0 = packed segment id; ref-indexed with pl.ds (Mosaic
+    # has no dynamic_slice on loaded arrays)
+    q_seg = seg_ref[0, pl.ds(q_start, bq), 0]
 
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
@@ -68,7 +71,11 @@ def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
         ) * scale  # [BQ, BK]
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        mask = (k_pos <= q_pos) & (k_pos < length)
+        k_seg = seg_ref[0, pl.ds(j * block_k, block_k), 0]
+        # same-segment test subsumes padding: pad queries (seg 0) attend only
+        # the pad tail (incl. themselves at k==q, keeping softmax finite),
+        # real queries never see pad keys or other segments
+        mask = (k_pos <= q_pos) & (q_seg[:, None] == k_seg[None, :])
         s = jnp.where(mask, s, _NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
@@ -87,7 +94,7 @@ def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
     lse_ref[0, 0, :, 0] = m + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, lengths, *, scale, block_q, block_k, groups, interpret):
+def _fwd(q, k, v, segments, *, scale, block_q, block_k, groups, interpret):
     b, hq, sq, d = q.shape
     sk = k.shape[2]
     grid = (b, hq, sq // block_q)
@@ -102,7 +109,7 @@ def _fwd(q, k, v, lengths, *, scale, block_q, block_k, groups, interpret):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec(lengths.shape, lambda b_, h, i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, sk, 1), lambda b_, h, i: (b_, 0, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
             pl.BlockSpec((1, 1, sk, d), lambda b_, h, i: (b_, h // groups, 0, 0)),
             pl.BlockSpec((1, 1, sk, d), lambda b_, h, i: (b_, h // groups, 0, 0)),
@@ -113,7 +120,7 @@ def _fwd(q, k, v, lengths, *, scale, block_q, block_k, groups, interpret):
         ),
         out_shape=out_shape,
         interpret=interpret,
-    )(lengths, q, k, v)
+    )(segments[:, :, None], q, k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -121,7 +128,7 @@ def _fwd(q, k, v, lengths, *, scale, block_q, block_k, groups, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_k):
+def _dq_kernel(seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_k):
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
@@ -129,7 +136,7 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     delta = delta_ref[0, 0, :, 0]
     bq, d = q.shape
     q_start = iq * bq
-    length = len_ref[pl.program_id(0)]
+    q_seg = seg_ref[0, pl.ds(q_start, bq), 0]
     n_blocks = (q_start + bq + block_k - 1) // block_k
 
     def body(j, dq_acc):
@@ -140,7 +147,8 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         ) * scale
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        mask = (k_pos <= q_pos) & (k_pos < length)
+        k_seg = seg_ref[0, pl.ds(j * block_k, block_k), 0]
+        mask = (k_pos <= q_pos) & (q_seg[:, None] == k_seg[None, :])
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -154,7 +162,7 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q, groups):
+def _dkv_kernel(seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q, groups):
     """Per (batch, KV head, k_block): accumulate dk/dv over this KV head's
     ``groups`` query heads and all causal q blocks — dk/dv stay at KV-head
     width (no group-factor HBM inflation)."""
@@ -164,7 +172,7 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref
     bk, d = k_blk.shape
     sq = q_ref.shape[2]
     k_start = jk * bk
-    length = len_ref[pl.program_id(0)]
+    k_seg = seg_ref[0, pl.ds(k_start, bk), 0]
     # causal: only q blocks at/after this k block contribute
     start_block = k_start // block_q
     n_blocks = sq // block_q
@@ -181,7 +189,8 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref
             ) * scale  # [BQ, BK]
             q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            mask = (k_pos <= q_pos) & (k_pos < length)
+            q_seg = seg_ref[0, pl.ds(i * block_q, block_q), 0]
+            mask = (k_pos <= q_pos) & (q_seg[:, None] == k_seg[None, :])
             p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
             dv_acc = dv_acc + jax.lax.dot_general(
                 p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -205,7 +214,7 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, lengths, o, lse, do, *, scale, block_q, block_k, groups, interpret):
+def _bwd(q, k, v, segments, o, lse, do, *, scale, block_q, block_k, groups, interpret):
     """Head-major inputs: q/o/do/lse [b, hq, ...], k/v [b, hkv, s, d]."""
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
@@ -215,7 +224,7 @@ def _bwd(q, k, v, lengths, o, lse, do, *, scale, block_q, block_k, groups, inter
         functools.partial(_dq_kernel, scale=scale, block_k=block_k),
         grid=(b, hq, sq // block_q),
         in_specs=[
-            pl.BlockSpec(lengths.shape, lambda b_, h, i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, sq, 1), lambda b_, h, i: (b_, 0, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
             pl.BlockSpec((1, 1, sq, d), lambda b_, h, i: (b_, h // groups, 0, 0)),
             pl.BlockSpec((1, 1, sq, d), lambda b_, h, i: (b_, h // groups, 0, 0)),
@@ -226,14 +235,14 @@ def _bwd(q, k, v, lengths, o, lse, do, *, scale, block_q, block_k, groups, inter
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         interpret=interpret,
-    )(lengths, q, k, v, do, lse, delta)
+    )(segments[:, :, None], q, k, v, do, lse, delta)
 
     # grid over KV heads; q/do/lse/delta blocks span the head's query group
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block_q=block_q, groups=groups),
         grid=(b, hkv, sq // block_k),
         in_specs=[
-            pl.BlockSpec(lengths.shape, lambda b_, h, j: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, sq, 1), lambda b_, h, j: (b_, 0, 0)),
             pl.BlockSpec((1, groups, sq, d), lambda b_, h, j: (b_, h, 0, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j: (b_, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j: (b_, h, j, 0)),
@@ -250,7 +259,7 @@ def _bwd(q, k, v, lengths, o, lse, do, *, scale, block_q, block_k, groups, inter
             jax.ShapeDtypeStruct((b, hkv, sq, d), v.dtype),
         ),
         interpret=interpret,
-    )(lengths, q, k, v, do, lse, delta)
+    )(segments[:, :, None], q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -264,31 +273,31 @@ def _make_flash_fn(scale: float, block_q: int, block_k: int, groups: int, interp
     """One custom_vjp closure per static configuration."""
 
     @jax.custom_vjp
-    def fn(q, k, v, lengths):
+    def fn(q, k, v, segments):
         o, _ = _fwd(
-            q, k, v, lengths,
+            q, k, v, segments,
             scale=scale, block_q=block_q, block_k=block_k, groups=groups,
             interpret=interpret,
         )
         return o
 
-    def fn_fwd(q, k, v, lengths):
+    def fn_fwd(q, k, v, segments):
         o, lse = _fwd(
-            q, k, v, lengths,
+            q, k, v, segments,
             scale=scale, block_q=block_q, block_k=block_k, groups=groups,
             interpret=interpret,
         )
-        return o, (q, k, v, lengths, o, lse)
+        return o, (q, k, v, segments, o, lse)
 
     def fn_bwd(res, do):
-        q, k, v, lengths, o, lse = res
+        q, k, v, segments, o, lse = res
         dq, dk, dv = _bwd(
-            q, k, v, lengths, o, lse, do,
+            q, k, v, segments, o, lse, do,
             scale=scale, block_q=block_q, block_k=block_k, groups=groups,
             interpret=interpret,
         )
-        dlengths = np.zeros(lengths.shape, jax.dtypes.float0)
-        return dq, dk, dv, dlengths
+        dsegments = np.zeros(segments.shape, jax.dtypes.float0)
+        return dq, dk, dv, dsegments
 
     fn.defvjp(fn_fwd, fn_bwd)
     return fn
@@ -320,19 +329,26 @@ def flash_attention_supported(
     return hq % k.shape[2] == 0
 
 
-def pallas_flash_attention(q, k, v, *, padding_mask=None, interpret: bool = False):
+def pallas_flash_attention(
+    q, k, v, *, padding_mask=None, segment_ids=None, interpret: bool = False
+):
     """q [b, sq, hq, d], k/v [b, sk, hkv, d] -> [b, sq, hq, d] (q.dtype).
 
-    ``padding_mask`` [b, sk] (1 = real token, right-padding) is converted to
-    per-row lengths; softmax in f32; causal.
+    Masking is expressed as per-position segments [b, sk] int32: attention
+    flows only within equal segment ids (plus causal). ``segment_ids`` comes
+    from the packing pipeline (data/packing.py, 0 = pad tail); without it,
+    ``padding_mask`` (1 = real) degenerates to the two-segment real/pad case.
+    Softmax in f32; causal.
     """
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     groups = hq // hkv
-    if padding_mask is not None:
-        lengths = padding_mask.astype(jnp.int32).sum(axis=-1)
+    if segment_ids is not None:
+        segments = segment_ids.astype(jnp.int32)
+    elif padding_mask is not None:
+        segments = padding_mask.astype(jnp.int32)
     else:
-        lengths = jnp.full((b,), sq, jnp.int32)
+        segments = jnp.ones((b, sq), jnp.int32)
 
     block = _pick_block(sq)
     if block == 0:
@@ -345,5 +361,5 @@ def pallas_flash_attention(q, k, v, *, padding_mask=None, interpret: bool = Fals
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = fn(qt, kt, vt, lengths)
+    out = fn(qt, kt, vt, segments)
     return out.transpose(0, 2, 1, 3)

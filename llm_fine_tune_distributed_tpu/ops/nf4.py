@@ -27,6 +27,7 @@ Effective bits/param at block 64: 4 + 32/64 = 4.5 (single quant) or
 
 from __future__ import annotations
 
+import math
 from typing import Dict, Optional
 
 import jax
@@ -221,11 +222,14 @@ def quantized_layout(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: 
 
     The single source of truth for the storage layout — used by shape-level
     planners (parallel/qlora.quantize_frozen_abstract) so the abstract and
-    real quantizers cannot drift.
+    real quantizers cannot drift. Rejects exactly the shapes quantize_nf4
+    rejects, so a planner cannot produce a layout the quantizer won't.
     """
-    import math
-
     k, n = shape
+    if k % 8:
+        raise ValueError(f"in-dim {k} not divisible by the int32 pack factor 8")
+    if k % block_size:
+        raise ValueError(f"in-dim {k} not divisible by block_size {block_size}")
     out = {"nf4": ((k // 8, n), jnp.int32)}
     if double_quant:
         n_scales = (k // block_size) * n
