@@ -76,12 +76,10 @@ def xla_attention(
         pm = padding_mask.astype(bool)[:, None, None, None, :]
         scores = jnp.where(pm, scores, _NEG_INF)
     if segment_ids is not None:
+        # note: a fully-masked row is safe — _NEG_INF is finite, so softmax
+        # degrades to uniform garbage on pad rows, which the loss mask drops
         same = segment_ids[:, None, :] == segment_ids[:, :, None]  # [b, q, kv]
         scores = jnp.where(same[:, None, None], scores, _NEG_INF)
-        # keep every softmax row finite: pad rows (seg 0) attend themselves
-        eye = jnp.eye(q_len, kv_len, dtype=bool) if q_len == kv_len else None
-        if eye is not None:
-            scores = jnp.where(eye[None, None, None], jnp.maximum(scores, -1e9), scores)
 
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))

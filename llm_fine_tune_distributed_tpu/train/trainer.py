@@ -326,6 +326,13 @@ class SFTTrainer:
         ring (parallel/ring_attention.py) then rotates K/V over that axis.
         Shared by the SFT and DPO step builders so the rules can't drift.
         """
+        if self.config.packing and self.config.attention_impl == "ring":
+            raise ValueError(
+                "packing=True is incompatible with attention_impl='ring' "
+                "(the ring rotation has no segment support); use flash/xla "
+                "attention for packed runs, or disable packing for "
+                "sequence-parallel long-context runs"
+            )
         seq_sharded = self.config.attention_impl == "ring" and self.mesh.shape["seq"] > 1
         if (
             seq_sharded
