@@ -1,0 +1,201 @@
+"""Pipeline parallelism (GPipe schedule) over a ``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 marks it absent;
+the MPMD-pipeline paper in PAPERS.md is its design pointer). This is the
+TPU-native expression: not MPMD processes with send/recv, but ONE SPMD
+program over a ``pipe`` mesh axis where
+
+- each stage device holds a contiguous slice of the transformer blocks
+  (stacked layer-major, so the per-stage compute is a ``lax.scan`` over its
+  own layers — one compiled block body regardless of depth);
+- activations move stage-to-stage with ``jax.lax.ppermute`` (ICI
+  neighbor-exchange, the cheapest collective on a TPU torus);
+- the GPipe timetable is a ``lax.scan`` over ``M + S - 1`` ticks: stage ``s``
+  processes microbatch ``t - s`` at tick ``t`` (bubble ticks compute on
+  zeros and are masked out);
+- the BACKWARD pipeline is not hand-written at all: ``jax.grad`` through the
+  scan + ppermute yields the reversed schedule automatically — the
+  correctness-by-construction benefit of a functional pipeline.
+
+Embedding/unembedding and the final norm live outside the pipelined blocks:
+embedding is applied to all microbatches up front (host of stage 0 data),
+the last stage's outputs are collected, and the loss closes over them. The
+embedding table is replicated across stages (it is ~3% of SmolLM3's params).
+
+Scope: first-class building block with exact-parity tests against the plain
+``forward`` path (tests/test_pipeline.py). Not yet wired into SFTTrainer's
+mesh config — TP/FSDP/SP cover the BASELINE.json configs; the pipeline axis
+targets models whose layer count, not width, is the scaling constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+import optax
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+from llm_fine_tune_distributed_tpu.models.transformer import _block, unembed
+from llm_fine_tune_distributed_tpu.ops.norms import rms_norm
+from llm_fine_tune_distributed_tpu.ops.rope import rope_cos_sin
+
+
+def stack_stage_params(params: Dict, config: ModelConfig, num_stages: int) -> Dict:
+    """Layer dicts -> leaves stacked [num_layers, ...] (layer-major).
+
+    Sharding the leading dim over ``pipe`` gives each stage its contiguous
+    block of layers; within a stage the compute scans over the local slice.
+    """
+    if config.num_layers % num_stages:
+        raise ValueError(
+            f"{config.num_layers} layers not divisible by {num_stages} stages"
+        )
+    layers = params["model"]["layers"]
+    return jax.tree.map(
+        lambda *leaves: jnp.stack(leaves),
+        *[layers[str(i)] for i in range(config.num_layers)],
+    )
+
+
+def stage_sharding(mesh: Mesh):
+    """Stacked layer leaves: leading (layer) dim sharded over ``pipe``."""
+    return NamedSharding(mesh, P("pipe"))
+
+
+def pipeline_forward(
+    params: Dict,
+    stacked_layers: Dict,
+    input_ids,
+    config: ModelConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    *,
+    padding_mask=None,
+    compute_dtype=jnp.bfloat16,
+    remat_blocks: bool = True,
+):
+    """Pipelined forward: logits for ``input_ids [M * mb, seq]``.
+
+    ``params`` holds the non-pipelined leaves (embedding, final norm, lm_head
+    if untied), replicated; ``stacked_layers`` are the transformer blocks
+    stacked [L, ...] and sharded over ``pipe``. ``padding_mask [M*mb, seq]``
+    (1 = real token) travels the schedule alongside each microbatch.
+    """
+    if config.no_rope_layers and not all(config.no_rope_layers):
+        raise NotImplementedError(
+            "pipeline v1 requires a uniform RoPE pattern (the per-stage layer "
+            "scan compiles ONE block body; NoPE-interleaved models need "
+            "per-layer branching)"
+        )
+    S = mesh.shape["pipe"]
+    M = num_microbatches
+    B, seq = input_ids.shape
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    L_local = config.num_layers // S
+
+    embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
+    x0 = embed[input_ids].reshape(M, mb, seq, -1)  # all microbatches, embedded
+    if padding_mask is None:
+        padding_mask = jnp.ones((B, seq), jnp.float32)
+    pm = padding_mask.reshape(M, mb, seq)
+    positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None], (mb, seq))
+    cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
+
+    def run_stage(stage_layers, x, mask):
+        """Scan my L_local blocks over x [mb, seq, h]."""
+
+        def one_block(h, layer_params):
+            h, _ = _block(
+                layer_params, h, cos, sin, mask, None, None, None, 0,
+                config=config, layer_idx=0, attention_impl="xla",
+                compute_dtype=compute_dtype,
+            )
+            return h, None
+
+        body = jax.checkpoint(one_block) if remat_blocks else one_block
+        x, _ = jax.lax.scan(body, x, stage_layers)
+        return x
+
+    def spmd(stacked_local, x0_local, pm_local):
+        # stacked_local: this stage's layers [L_local, ...]; x0_local/pm_local:
+        # the full embedded microbatch stack + padding masks (replicated).
+        s = jax.lax.axis_index("pipe")
+        T = M + S - 1
+
+        def tick(carry, t):
+            buf = carry  # [mb, seq, h] activation arriving at my stage
+            m = t - s    # microbatch index my stage works on this tick
+            m_safe = jnp.clip(m, 0, M - 1)
+            # stage 0 reads its own input; others use the received buffer
+            x_in = jnp.where(
+                s == 0,
+                jax.lax.dynamic_index_in_dim(
+                    x0_local, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+                ),
+                buf,
+            )
+            # my microbatch's padding mask rides the same timetable
+            mask = jax.lax.dynamic_index_in_dim(pm_local, m_safe, axis=0, keepdims=False)
+            y = run_stage(stacked_local, x_in, mask)
+            # mask bubble ticks so garbage never enters the ring
+            valid = (m >= 0) & (m < M)
+            y = jnp.where(valid, y, jnp.zeros_like(y))
+            # pass to the next stage (last stage's output falls off the end)
+            y_next = jax.lax.ppermute(
+                y, "pipe", [(i, (i + 1) % S) for i in range(S)]
+            )
+            # last stage emits microbatch m_out = t - (S - 1)
+            out = jnp.where(s == S - 1, y, jnp.zeros_like(y))
+            return y_next, out
+
+        _, outs = jax.lax.scan(tick, jnp.zeros((mb, seq, x0_local.shape[-1]),
+                                               x0_local.dtype), jnp.arange(T))
+        # outs [T, mb, seq, h]: last stage's real outputs live at ticks
+        # t = m + S - 1; drop the S-1 bubble rows BEFORE the psum so the
+        # all-reduce (and its transpose on backward) moves only real data.
+        outs = jax.lax.psum(outs[S - 1 :], "pipe")
+        return outs
+
+    outs = shard_map(
+        spmd,
+        mesh=mesh,
+        in_specs=(P("pipe"), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_layers, x0, pm)
+
+    # [M, mb, seq, h] -> final norm + unembed (replicated, off-pipeline;
+    # same code path as the plain forward for exact parity)
+    h = outs.reshape(B, seq, -1)
+    h = rms_norm(h, params["model"]["norm"]["weight"], config.rms_norm_eps)
+    return unembed(params, h, config, compute_dtype=compute_dtype, logits_dtype=jnp.float32)
+
+
+def pipeline_loss_fn(
+    params: Dict,
+    stacked_layers: Dict,
+    batch: Dict,
+    config: ModelConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    compute_dtype=jnp.bfloat16,
+):
+    """Masked next-token CE through the pipeline (same objective as
+    train/step.py's make_loss_fn). Differentiable: jax.grad through this
+    yields the reverse-schedule backward pipeline automatically."""
+    logits = pipeline_forward(
+        params, stacked_layers, batch["input_ids"], config, mesh,
+        num_microbatches, padding_mask=batch.get("attention_mask"),
+        compute_dtype=compute_dtype,
+    )
+    targets = batch["input_ids"][:, 1:]
+    mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
+    return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
