@@ -147,8 +147,14 @@ def _block(
     compute_dtype,
     mesh=None,
     quant_impl: str = "auto",
+    rope_flag=None,
 ):
-    """One transformer block. Returns (x, new_cache_entry)."""
+    """One transformer block. Returns (x, new_cache_entry).
+
+    ``rope_flag`` (traced bool scalar) overrides the static
+    ``config.uses_rope(layer_idx)`` decision — used by the pipeline's
+    layer-scan, where the absolute layer index is data, not Python.
+    """
     b, s, h = x.shape
     d = config.resolved_head_dim
     eps = config.rms_norm_eps
@@ -159,7 +165,11 @@ def _block(
     k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
     v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
 
-    if config.uses_rope(layer_idx):
+    if rope_flag is not None:
+        qr, kr = apply_rope(q, k, cos, sin)
+        q = jnp.where(rope_flag, qr, q)
+        k = jnp.where(rope_flag, kr, k)
+    elif config.uses_rope(layer_idx):
         q, k = apply_rope(q, k, cos, sin)
 
     new_entry = None

@@ -27,6 +27,7 @@ Effective bits/param at block 64: 4 + 32/64 = 4.5 (single quant) or
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Dict, Optional
 
@@ -74,7 +75,10 @@ def quantize_nf4(
     block_size: int = DEFAULT_BLOCK_SIZE,
     double_quant: bool = True,
 ) -> Dict[str, np.ndarray]:
-    """Quantize ``w [in, out]`` to NF4. Host-side (numpy), one-shot at load.
+    """Quantize ``w [in, out]`` to NF4 (one-shot at load/startup).
+
+    Large leaves on an accelerator backend quantize on-device and return the
+    packed codes as device arrays; small leaves / CPU take a numpy path.
 
     Returns a flat dict of arrays (ready to live as sibling param-tree leaves):
       ``nf4``            int32 [in/8, out]   — packed 4-bit codes
@@ -83,28 +87,38 @@ def quantize_nf4(
       ``absmax_scale``   f32   [n_groups]
       ``absmax_offset``  f32   []
     """
-    w = np.asarray(w, dtype=np.float32)
-    if w.ndim != 2:
-        raise ValueError(f"quantize_nf4 expects a 2-D weight, got {w.shape}")
+    if getattr(w, "ndim", None) != 2:
+        raise ValueError(f"quantize_nf4 expects a 2-D weight, got {np.shape(w)}")
     k, n = w.shape
     if k % 8:
         raise ValueError(f"in-dim {k} not divisible by the int32 pack factor 8")
     if k % block_size:
         raise ValueError(f"in-dim {k} not divisible by block_size {block_size}")
 
-    # per-(block, column) absmax
-    blocks = w.reshape(k // block_size, block_size, n)
-    absmax = np.abs(blocks).max(axis=1)  # [k/block, n]
-    safe = np.where(absmax == 0.0, 1.0, absmax)
-    normalized = blocks / safe[:, None, :]
-    codes = _nearest_code(normalized.reshape(k, n))
+    if w.size >= 1 << 22 and jax.default_backend() != "cpu":
+        # Device-accelerated quantization: the numpy path takes ~10+ minutes
+        # for a 3B model's block linears; one jitted pass per leaf on the
+        # accelerator does the same in milliseconds. The packed codes STAY on
+        # device (they are about to live there as frozen params anyway); only
+        # the small absmax comes to host for the double-quant step.
+        packed, absmax = _quantize_codes_jax(jnp.asarray(w, jnp.float32), block_size)
+        absmax = np.asarray(absmax)
+    else:
+        w = np.asarray(w, dtype=np.float32)
+        # per-(block, column) absmax
+        blocks = w.reshape(k // block_size, block_size, n)
+        absmax = np.abs(blocks).max(axis=1)  # [k/block, n]
+        safe = np.where(absmax == 0.0, 1.0, absmax)
+        normalized = blocks / safe[:, None, :]
+        codes = _nearest_code(normalized.reshape(k, n))
 
-    # pack 8 consecutive rows per int32 word (nibble s = row 8r+s)
-    codes = codes.reshape(k // 8, 8, n).astype(np.uint32)
-    packed = np.zeros((k // 8, n), dtype=np.uint32)
-    for s in range(8):
-        packed |= codes[:, s, :] << np.uint32(4 * s)
-    out = {"nf4": packed.astype(np.int32)}
+        # pack 8 consecutive rows per int32 word (nibble s = row 8r+s)
+        codes = codes.reshape(k // 8, 8, n).astype(np.uint32)
+        packed = np.zeros((k // 8, n), dtype=np.uint32)
+        for s in range(8):
+            packed |= codes[:, s, :] << np.uint32(4 * s)
+        packed = packed.astype(np.int32)
+    out = {"nf4": packed}  # np (small path) or on-device jnp (jax path)
 
     if not double_quant:
         out["absmax"] = absmax.astype(np.float32)
@@ -122,6 +136,27 @@ def quantize_nf4(
     out["absmax_scale"] = gscale.astype(np.float32)
     out["absmax_offset"] = np.asarray(offset, np.float32)
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def _quantize_codes_jax(w, block_size: int):
+    """Device-side NF4 quantize: returns (packed int32 [k/8, n], absmax f32).
+
+    Bit-identical to the numpy path: same absmax grid, same midpoint
+    bucketing (searchsorted over the 15 code midpoints), same nibble layout.
+    """
+    k, n = w.shape
+    blocks = w.reshape(k // block_size, block_size, n)
+    absmax = jnp.abs(blocks).max(axis=1)
+    safe = jnp.where(absmax == 0.0, 1.0, absmax)
+    normalized = (blocks / safe[:, None, :]).reshape(k, n)
+    mids = jnp.asarray((NF4_CODEBOOK[1:] + NF4_CODEBOOK[:-1]) / 2.0)
+    codes = jnp.searchsorted(mids, normalized.reshape(-1)).reshape(k, n)
+    codes = codes.reshape(k // 8, 8, n).astype(jnp.uint32)
+    packed = jnp.zeros((k // 8, n), jnp.uint32)
+    for s in range(8):
+        packed = packed | (codes[:, s, :] << jnp.uint32(4 * s))
+    return packed.astype(jnp.int32), absmax
 
 
 def _dequant_absmax(q: Dict, dtype=jnp.float32):
@@ -169,24 +204,28 @@ def nf4_matmul(x, q: Dict, impl: str = "auto", compute_dtype=jnp.bfloat16):
       - "xla": dequantize then jnp.dot (XLA fuses decode into the operand
         read where it can; correct everywhere).
       - "pallas": fused Pallas kernel — decodes 4-bit tiles in VMEM so the
-        bf16 weight never round-trips HBM.
-      - "auto": pallas on TPU for small-M (decode-time) calls, else xla.
+        bf16 weight never round-trips HBM. Experimental: see measurements.
+      - "auto": currently always xla.
 
-    Measured on a v5e chip (K=N=2048): at M=8192 the fused kernel re-decodes
-    the weight tile once per M-tile and lands ~1.8x slower than XLA dequant
-    (which matches dense bf16 there); at M=16 the two are equal. So "auto"
-    uses the fused kernel only where the matmul is weight-bandwidth-bound
-    (autoregressive decode, M <= 1024) and the XLA path for training shapes.
+    Measured on a v5e chip: at training shapes (M=8192, K=N=2048) the fused
+    kernel re-decodes the weight tile once per M-tile and lands ~1.8x slower
+    than XLA dequant; at batch-1 3B decode (benchmarks/decode_bench.py) it
+    reaches 20 tokens/sec vs 73 for plain bf16 — the VPU shift/mask/select
+    decode, not HBM bandwidth, is the bottleneck on this chip. NF4's value
+    here is MEMORY (4.5 bits/param at rest, one layer decoded at a time
+    under remat/liveness), not speed, so "auto" resolves to the XLA path
+    everywhere until a faster decode (e.g. MXU one-hot lookup) lands.
     """
     if impl == "auto":
-        on_tpu = jax.default_backend() == "tpu"
-        m = 1
-        for d in x.shape[:-1]:
-            m *= int(d)
-        impl = (
-            "pallas" if on_tpu and m <= 1024 and _pallas_supported(x, q) else "xla"
-        )
+        impl = "xla"
     if impl == "pallas":
+        if not _pallas_supported(x, q):
+            raise ValueError(
+                "nf4 pallas kernel unsupported for this shape "
+                f"(out {q['nf4'].shape[1]} must tile by 128; in "
+                f"{q['nf4'].shape[0] * 8} by 512, covering whole scale "
+                "blocks); use impl='xla'"
+            )
         from llm_fine_tune_distributed_tpu.ops.nf4_pallas import nf4_matmul_pallas
 
         return nf4_matmul_pallas(x, q, compute_dtype=compute_dtype)
@@ -195,6 +234,7 @@ def nf4_matmul(x, q: Dict, impl: str = "auto", compute_dtype=jnp.bfloat16):
 
 
 def _pallas_supported(x, q) -> bool:
+    """Shape gate for explicit impl="pallas" calls (see nf4_matmul)."""
     k8, n = q["nf4"].shape
     k = k8 * 8
     am = q["absmax"] if "absmax" in q else q["absmax_q"]

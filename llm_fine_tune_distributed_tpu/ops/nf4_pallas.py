@@ -81,7 +81,8 @@ def _matmul_2d(x, packed, absmax, compute_dtype=jnp.bfloat16):
     bn = _tile(n, 256, 128)
     # Fixed K tile: 512 = whole absmax blocks (8 rows of it, the f32 sublane
     # minimum), whole int32 words (64 rows), and a 128-multiple lane count for
-    # the x tile. Callers gate on k % 512 == 0 (nf4._pallas_supported).
+    # the x tile. nf4_matmul gates impl="pallas" on these shapes
+    # (nf4._pallas_supported).
     bk = 512
     if k % bk or bk % block_rows:
         raise ValueError(

@@ -78,6 +78,7 @@ def pipeline_forward(
     padding_mask=None,
     compute_dtype=jnp.bfloat16,
     remat_blocks: bool = True,
+    output_hidden: bool = False,
 ):
     """Pipelined forward: logits for ``input_ids [M * mb, seq]``.
 
@@ -86,12 +87,6 @@ def pipeline_forward(
     stacked [L, ...] and sharded over ``pipe``. ``padding_mask [M*mb, seq]``
     (1 = real token) travels the schedule alongside each microbatch.
     """
-    if config.no_rope_layers and not all(config.no_rope_layers):
-        raise NotImplementedError(
-            "pipeline v1 requires a uniform RoPE pattern (the per-stage layer "
-            "scan compiles ONE block body; NoPE-interleaved models need "
-            "per-layer branching)"
-        )
     S = mesh.shape["pipe"]
     M = num_microbatches
     B, seq = input_ids.shape
@@ -101,49 +96,60 @@ def pipeline_forward(
     L_local = config.num_layers // S
 
     embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
-    x0 = embed[input_ids].reshape(M, mb, seq, -1)  # all microbatches, embedded
+    ids = input_ids.reshape(M, mb, seq)  # token ids, NOT embeddings: 4 bytes
+    # per position instead of 2*h — the schedule's replicated input stays tiny
     if padding_mask is None:
         padding_mask = jnp.ones((B, seq), jnp.float32)
     pm = padding_mask.reshape(M, mb, seq)
     positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None], (mb, seq))
     cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
+    # Per-layer RoPE flags as DATA: the layer scan compiles one block body,
+    # and NoPE-interleaved models (SmolLM3) select rope/no-rope per layer.
+    # Uniform patterns (every preset except NoPE ones) skip the
+    # rotate-then-select and keep the static branch.
+    flags_list = [config.uses_rope(i) for i in range(config.num_layers)]
+    uniform_rope = all(flags_list) or not any(flags_list)
+    rope_flags = jnp.asarray(flags_list, jnp.bool_)
 
-    def run_stage(stage_layers, x, mask):
+    def run_stage(stage_layers, x, mask, stage_flags):
         """Scan my L_local blocks over x [mb, seq, h]."""
 
-        def one_block(h, layer_params):
+        def one_block(h, args):
+            layer_params, flag = args
             h, _ = _block(
                 layer_params, h, cos, sin, mask, None, None, None, 0,
                 config=config, layer_idx=0, attention_impl="xla",
                 compute_dtype=compute_dtype,
+                rope_flag=None if uniform_rope else flag,
             )
             return h, None
 
         body = jax.checkpoint(one_block) if remat_blocks else one_block
-        x, _ = jax.lax.scan(body, x, stage_layers)
+        x, _ = jax.lax.scan(body, x, (stage_layers, stage_flags))
         return x
 
-    def spmd(stacked_local, x0_local, pm_local):
-        # stacked_local: this stage's layers [L_local, ...]; x0_local/pm_local:
-        # the full embedded microbatch stack + padding masks (replicated).
+    def spmd(stacked_local, embed_local, ids_local, pm_local, flags_local):
+        # stacked_local: this stage's layers [L_local, ...]; ids_local/
+        # pm_local: the full microbatch token ids + padding masks (replicated
+        # — int32/float32 [M, mb, seq], ~1000x smaller than embedded
+        # activations); embed_local: the embedding table (replicated, it is
+        # a param).
         s = jax.lax.axis_index("pipe")
         T = M + S - 1
+        h_dim = embed_local.shape[-1]
 
         def tick(carry, t):
             buf = carry  # [mb, seq, h] activation arriving at my stage
             m = t - s    # microbatch index my stage works on this tick
             m_safe = jnp.clip(m, 0, M - 1)
-            # stage 0 reads its own input; others use the received buffer
-            x_in = jnp.where(
-                s == 0,
-                jax.lax.dynamic_index_in_dim(
-                    x0_local, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
-                ),
-                buf,
+            # stage 0 embeds its own microbatch; others use the received buffer
+            my_ids = jax.lax.dynamic_index_in_dim(
+                ids_local, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
             )
+            x_in = jnp.where(s == 0, embed_local[my_ids].astype(buf.dtype), buf)
             # my microbatch's padding mask rides the same timetable
             mask = jax.lax.dynamic_index_in_dim(pm_local, m_safe, axis=0, keepdims=False)
-            y = run_stage(stacked_local, x_in, mask)
+            y = run_stage(stacked_local, x_in, mask, flags_local)
             # mask bubble ticks so garbage never enters the ring
             valid = (m >= 0) & (m < M)
             y = jnp.where(valid, y, jnp.zeros_like(y))
@@ -155,26 +161,36 @@ def pipeline_forward(
             out = jnp.where(s == S - 1, y, jnp.zeros_like(y))
             return y_next, out
 
-        _, outs = jax.lax.scan(tick, jnp.zeros((mb, seq, x0_local.shape[-1]),
-                                               x0_local.dtype), jnp.arange(T))
+        _, outs = jax.lax.scan(
+            tick,
+            jnp.zeros((mb, seq, h_dim), compute_dtype),
+            jnp.arange(T),
+        )
         # outs [T, mb, seq, h]: last stage's real outputs live at ticks
-        # t = m + S - 1; drop the S-1 bubble rows BEFORE the psum so the
-        # all-reduce (and its transpose on backward) moves only real data.
-        outs = jax.lax.psum(outs[S - 1 :], "pipe")
-        return outs
+        # t = m + S - 1; drop the S-1 bubble rows first so the collective
+        # moves only real data. When M divides S-ways, reduce-scatter leaves
+        # each stage 1/S of the output (sharded over pipe) instead of a full
+        # all-reduce copy per stage.
+        outs = outs[S - 1 :]
+        if M % S == 0:
+            return jax.lax.psum_scatter(outs, "pipe", scatter_dimension=0, tiled=True)
+        return jax.lax.psum(outs, "pipe")
 
+    out_spec = P("pipe") if M % S == 0 else P()
     outs = shard_map(
         spmd,
         mesh=mesh,
-        in_specs=(P("pipe"), P(), P()),
-        out_specs=P(),
+        in_specs=(P("pipe"), P(), P(), P(), P("pipe")),
+        out_specs=out_spec,
         check_vma=False,
-    )(stacked_layers, x0, pm)
+    )(stacked_layers, embed, ids, pm, rope_flags)
 
-    # [M, mb, seq, h] -> final norm + unembed (replicated, off-pipeline;
-    # same code path as the plain forward for exact parity)
+    # [M, mb, seq, h] -> final norm (+ unembed unless the caller chunks the
+    # loss; same code path as the plain forward for exact parity)
     h = outs.reshape(B, seq, -1)
     h = rms_norm(h, params["model"]["norm"]["weight"], config.rms_norm_eps)
+    if output_hidden:
+        return h.astype(compute_dtype)
     return unembed(params, h, config, compute_dtype=compute_dtype, logits_dtype=jnp.float32)
 
 
@@ -186,16 +202,34 @@ def pipeline_loss_fn(
     mesh: Mesh,
     num_microbatches: int,
     compute_dtype=jnp.bfloat16,
+    loss_chunk_size=None,
 ):
     """Masked next-token CE through the pipeline (same objective as
-    train/step.py's make_loss_fn). Differentiable: jax.grad through this
-    yields the reverse-schedule backward pipeline automatically."""
+    train/step.py's make_loss_fn, including the chunked large-vocab path).
+    Differentiable: jax.grad through this yields the reverse-schedule
+    backward pipeline automatically."""
+    targets = batch["input_ids"][:, 1:]
+    mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+    tokens = jnp.maximum(mask.sum(), 1.0)
+    if loss_chunk_size is not None:
+        # never materialize [B, seq, vocab] logits (128k-vocab models):
+        # unembed chunk-by-chunk exactly like train/step.py
+        from llm_fine_tune_distributed_tpu.train.step import chunked_ce_sum
+
+        hidden = pipeline_forward(
+            params, stacked_layers, batch["input_ids"], config, mesh,
+            num_microbatches, padding_mask=batch.get("attention_mask"),
+            compute_dtype=compute_dtype, output_hidden=True,
+        )
+        ce_sum = chunked_ce_sum(
+            params, hidden[:, :-1], targets, mask, config, loss_chunk_size,
+            compute_dtype,
+        )
+        return ce_sum / tokens
     logits = pipeline_forward(
         params, stacked_layers, batch["input_ids"], config, mesh,
         num_microbatches, padding_mask=batch.get("attention_mask"),
         compute_dtype=compute_dtype,
     )
-    targets = batch["input_ids"][:, 1:]
-    mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
-    return (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return (ce * mask).sum() / tokens

@@ -56,7 +56,9 @@ def quantize_frozen(
         if not _is_quantizable(path, leaf) or leaf.shape[0] % block_size:
             out[path] = leaf
             continue
-        q = quantize_nf4(np.asarray(leaf), block_size, double_quant)
+        # pass the leaf as-is: on-device arrays quantize on the accelerator
+        # (ops/nf4._quantize_codes_jax) with no host round-trip
+        q = quantize_nf4(leaf, block_size, double_quant)
         for suffix, arr in q.items():
             out[f"{path}_{suffix}"] = jnp.asarray(arr)
     return out

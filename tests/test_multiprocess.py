@@ -64,6 +64,9 @@ def test_two_process_training(tmp_path):
         "mesh": {"data": 1, "fsdp": 2, "tensor": 1, "seq": 1},
         "use_native_loader": False,
         "heartbeat": False,
+        # exercise the cross-host checksum exchange (runtime/desync.py) in a
+        # REAL multi-process world every few steps
+        "desync_check_steps": 4,
     }
     cfg_path = tmp_path / "cfg.json"
     cfg_path.write_text(json.dumps(cfg))

@@ -205,3 +205,25 @@ def _tree_items(tree, prefix=""):
             yield from _tree_items(v, key)
         else:
             yield key, v
+
+
+def test_jax_quantizer_matches_numpy():
+    """The device-side quantizer (_quantize_codes_jax) must be bit-identical
+    to the numpy path — CI runs CPU, so call the jitted fn directly."""
+    from llm_fine_tune_distributed_tpu.ops.nf4 import _quantize_codes_jax
+
+    rng = np.random.RandomState(7)
+    w = rng.randn(256, 128).astype(np.float32)
+    # numpy reference (the small-leaf path)
+    ref = quantize_nf4(w, 64, double_quant=False)
+    packed_j, absmax_j = _quantize_codes_jax(jnp.asarray(w), 64)
+    np.testing.assert_array_equal(np.asarray(packed_j), np.asarray(ref["nf4"]))
+    np.testing.assert_allclose(np.asarray(absmax_j), np.asarray(ref["absmax"]), rtol=1e-6)
+
+
+def test_explicit_pallas_rejects_bad_shapes():
+    rng = np.random.RandomState(8)
+    w = rng.randn(256, 128).astype(np.float32)  # K=256: not 512-divisible
+    q = {k: jnp.asarray(v) for k, v in quantize_nf4(w, 64, False).items()}
+    with pytest.raises(ValueError, match="pallas"):
+        nf4_matmul(jnp.ones((4, 256)), q, impl="pallas")

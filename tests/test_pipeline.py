@@ -23,7 +23,6 @@ B, SEQ = 8, 64
 
 @pytest.fixture(scope="module")
 def setup(eight_devices):
-    # uniform-RoPE tiny config (pipeline v1 rejects NoPE interleaving)
     config = get_preset("tiny").replace(no_rope_layers=(), num_layers=4)
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
     ids = jnp.asarray(
@@ -108,13 +107,25 @@ def test_pipeline_grads_match_plain(setup):
         )
 
 
-def test_pipeline_rejects_nope_models(setup):
+def test_pipeline_nope_interleaved_matches_plain(setup):
+    """SmolLM3-style NoPE interleaving: per-layer RoPE flags ride the layer
+    scan as data, so the pipelined model matches the plain one exactly."""
     config, params, ids = setup
-    nope = config.replace(no_rope_layers=(1, 1, 1, 0))
+    nope = config.replace(no_rope_layers=(1, 0, 1, 0))
     mesh = _mesh(2)
-    stacked = stack_stage_params(params, nope, 2)
-    with pytest.raises(NotImplementedError, match="RoPE"):
-        pipeline_forward(params, stacked, ids, nope, mesh, 2)
+    stacked = jax.device_put(
+        stack_stage_params(params, nope, 2), stage_sharding(mesh)
+    )
+    logits_pipe = pipeline_forward(
+        params, stacked, ids, nope, mesh, 2,
+        compute_dtype=jnp.float32, remat_blocks=False,
+    )
+    logits_plain, _ = forward(
+        params, ids, nope, compute_dtype=jnp.float32, logits_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_pipe), np.asarray(logits_plain), atol=2e-4, rtol=2e-4
+    )
 
 
 def test_stack_stage_params_layout(setup):
@@ -152,3 +163,18 @@ def test_pipeline_padded_batch_matches_plain(setup):
         np.asarray(logits_pipe)[real], np.asarray(logits_plain)[real],
         atol=2e-4, rtol=2e-4,
     )
+
+
+def test_pipeline_chunked_loss_matches_full(setup):
+    """loss_chunk_size path (large-vocab HBM saver) == full-unembed path."""
+    config, params, ids = setup
+    mesh = _mesh(2)
+    stacked = jax.device_put(
+        stack_stage_params(params, config, 2), stage_sharding(mesh)
+    )
+    batch = {"input_ids": ids, "loss_mask": jnp.ones((B, SEQ), jnp.float32)}
+    full = pipeline_loss_fn(params, stacked, batch, config, mesh, 2,
+                            compute_dtype=jnp.float32)
+    chunked = pipeline_loss_fn(params, stacked, batch, config, mesh, 2,
+                               compute_dtype=jnp.float32, loss_chunk_size=16)
+    assert float(full) == pytest.approx(float(chunked), rel=1e-5)
