@@ -58,7 +58,7 @@ def build(model_preset, per_device_batch_size, grad_accum, seq_len, attention_im
         per_device_batch_size=per_device_batch_size,
         gradient_accumulation_steps=grad_accum,
         max_seq_length=seq_len,
-        gradient_checkpointing=True,
+        gradient_checkpointing=os.environ.get("BENCH_REMAT", "1") != "0",
         attention_impl=attention_impl,
         loss_chunk_size=loss_chunk,
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots_no_batch") or None,

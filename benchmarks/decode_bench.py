@@ -3,10 +3,12 @@
 NF4-quantized base.
 
 Autoregressive decode is weight-bandwidth-bound at batch 1 — each token reads
-every matmul weight once — so the NF4 path (4.5 bits/param at rest, decoded
-in VMEM by the fused Pallas kernel, ops/nf4_pallas.py) trades a ~3.5x smaller
-HBM weight stream against VPU decode cost. This harness measures both paths
-on the same chip and prints one JSON line per variant.
+every matmul weight once — so the NF4 path (4.5 bits/param at rest) trades a
+~3.5x smaller HBM weight stream against dequantization cost. The NF4 matmuls
+run through the default XLA dequant path (``nf4_matmul(impl="auto")``
+resolves to ``"xla"`` — measured fastest on v5e; the fused Pallas VMEM-decode
+kernel of ops/nf4_pallas.py stays opt-in via ``impl="pallas"``). This harness
+measures both variants on the same chip and prints one JSON line per variant.
 
 The reference has no decode benchmark (its inference is an interactive CLI);
 this quantifies the serving-side half of the framework.
@@ -77,10 +79,9 @@ def main():
     if "bf16" in variants:
         results["bf16"] = measure(params_bf16, "bf16")
     if "nf4" in variants:
-        flat = flatten_dict(params_bf16)
-        qflat = quantize_frozen(
-            {k: np.asarray(v, np.float32) for k, v in flat.items()}
-        )
+        # leaves passed as-is: quantize_frozen's large-leaf path quantizes
+        # on-device, so no host round-trip of the full weight set
+        qflat = quantize_frozen(dict(flatten_dict(params_bf16)))
         # non-quantized leaves back to bf16 compute dtype
         qflat = {
             k: (jnp.asarray(v, jnp.bfloat16)

@@ -92,7 +92,8 @@ class MeshConfig:
       - ``fsdp``:  data parallelism with parameters sharded (ZeRO-3); batch is
                    sharded over data*fsdp jointly
       - ``tensor``: tensor parallelism (Megatron-style within attention/MLP)
-      - ``seq``  : sequence/context parallelism for ring attention (optional)
+      - ``seq``  : sequence/context parallelism — ring attention or Ulysses
+                   all-to-all, selected by ``attention_impl`` (optional)
 
     Sizes of -1 mean "absorb remaining devices" (at most one axis may be -1).
     This replaces the reference's implicit 1-D DDP world
@@ -221,7 +222,7 @@ class TrainConfig:
 
     # mesh / distributed
     mesh: MeshConfig = field(default_factory=MeshConfig)
-    # attention implementation: "xla" | "flash" (Pallas) | "ring"
+    # attention implementation: "xla" | "flash" (Pallas) | "ring" | "ulysses"
     attention_impl: str = "flash"
 
     # observability

@@ -265,10 +265,10 @@ def forward(
             return jax.lax.with_sharding_constraint(h, activation_sharding)
         return h
 
-    # Ring attention (sequence parallelism) needs the mesh to shard_map over;
+    # Sequence parallelism (ring / ulysses) needs the mesh to shard_map over;
     # recover it from the activation sharding so call sites stay unchanged.
     mesh = None
-    if attention_impl == "ring" and activation_sharding is not None:
+    if attention_impl in ("ring", "ulysses") and activation_sharding is not None:
         mesh = getattr(activation_sharding, "mesh", None)
 
     embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)

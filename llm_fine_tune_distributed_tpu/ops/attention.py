@@ -8,6 +8,9 @@ Replaces the reference's flash-attn-2 CUDA kernels
 - ``"flash"``: Pallas (Mosaic) blockwise flash attention kernel (ops/flash_attention.py).
 - ``"ring"``:  ring attention over a sequence-parallel mesh axis (parallel/ring_attention.py),
                selected by the trainer when mesh.seq > 1.
+- ``"ulysses"``: all-to-all sequence parallelism (parallel/ulysses.py) — heads
+               re-partitioned over the seq axis so each device runs full-sequence
+               flash attention on its head subset.
 
 All implementations take/return the same layout:
   q: [batch, q_len, num_heads, head_dim]
@@ -86,6 +89,29 @@ def xla_attention(
     return out.reshape(b, q_len, num_heads, head_dim).astype(q.dtype)
 
 
+def _seq_parallel_fallback(impl: str, q, mesh) -> str:
+    """Fallback target when a sequence-parallel impl cannot apply.
+
+    A missing/size-1 seq axis is the ordinary single-device case — fall back
+    quietly. A PROVISIONED seq axis with an unsupported shape (e.g. ulysses
+    capped by kv heads, or an indivisible seq length) means the user's
+    parallelism is silently dead — be loud, because at long-context shapes
+    the difference between the flash kernel and quadratic XLA attention is
+    an OOM. Either way prefer "flash" (linear memory), which itself degrades
+    to XLA attention only when truly unsupported."""
+    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        import warnings
+
+        warnings.warn(
+            f"attention_impl={impl!r} requested but unsupported for shape "
+            f"q={tuple(q.shape)} on mesh {dict(mesh.shape)} — the seq axis is "
+            "NOT being used; falling back to flash/XLA attention (check head/"
+            "kv-head divisibility by the seq axis and seq-length alignment)",
+            stacklevel=3,
+        )
+    return "flash"
+
+
 def attention(
     q,
     k,
@@ -100,10 +126,13 @@ def attention(
 ):
     """Dispatch to the selected attention implementation.
 
-    ``mesh`` is only consulted by the ring path (sequence parallelism); the
-    trainer passes the active mesh whenever ``attention_impl="ring"``.
+    ``mesh`` is consulted by the sequence-parallel paths (ring and ulysses);
+    the trainer passes the active mesh whenever ``attention_impl`` is one of
+    those. Without a mesh (or with an unsupported shape) they fall back to
+    the flash kernel, which itself degrades to XLA attention when it cannot
+    apply.
     """
-    if impl == "ring" and segment_ids is not None:
+    if impl in ("ring", "ulysses") and segment_ids is not None:
         # the ring rotation has no segment support; packed batches take the
         # flash kernel (which masks by segment natively) or XLA. Be loud:
         # a user who provisioned a seq axis should know it is being bypassed
@@ -112,12 +141,26 @@ def attention(
         import warnings
 
         warnings.warn(
-            "packing (segment_ids) disables ring attention; falling back to "
-            f"flash/XLA for seq {q.shape[1]} — disable packing for "
-            "sequence-parallel long-context runs",
+            f"packing (segment_ids) disables {impl} attention (sequence "
+            f"parallelism has no segment support); falling back to flash/XLA "
+            f"for seq {q.shape[1]} — disable packing for sequence-parallel "
+            "long-context runs",
             stacklevel=2,
         )
         impl = "flash"
+    if impl == "ulysses":
+        from llm_fine_tune_distributed_tpu.parallel.ulysses import (
+            ulysses_attention,
+            ulysses_attention_supported,
+        )
+
+        if ulysses_attention_supported(
+            q, k, mesh, sliding_window=sliding_window, causal=causal
+        ):
+            return ulysses_attention(
+                q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal
+            )
+        impl = _seq_parallel_fallback("ulysses", q, mesh)
     if impl == "ring":
         from llm_fine_tune_distributed_tpu.parallel.ring_attention import (
             ring_attention,
@@ -128,7 +171,7 @@ def attention(
             q, k, mesh, sliding_window=sliding_window, causal=causal
         ):
             return ring_attention(q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal)
-        impl = "xla"  # seq axis of 1 (or unsupported shape): plain attention
+        impl = _seq_parallel_fallback("ring", q, mesh)
     if impl == "flash":
         # Pallas kernel requires TPU, no sliding window (falls back otherwise).
         from llm_fine_tune_distributed_tpu.ops.flash_attention import (

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -74,7 +74,7 @@ def quantize_nf4(
     w,
     block_size: int = DEFAULT_BLOCK_SIZE,
     double_quant: bool = True,
-) -> Dict[str, np.ndarray]:
+) -> Dict[str, Any]:  # values: np.ndarray, or jax.Array ("nf4" on the device path)
     """Quantize ``w [in, out]`` to NF4 (one-shot at load/startup).
 
     Large leaves on an accelerator backend quantize on-device and return the

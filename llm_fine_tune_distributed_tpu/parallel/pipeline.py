@@ -142,11 +142,18 @@ def pipeline_forward(
             buf = carry  # [mb, seq, h] activation arriving at my stage
             m = t - s    # microbatch index my stage works on this tick
             m_safe = jnp.clip(m, 0, M - 1)
-            # stage 0 embeds its own microbatch; others use the received buffer
+            # stage 0 embeds its own microbatch; others use the received
+            # buffer. lax.cond (not where) so stages > 0 skip the [mb, seq, h]
+            # embedding gather at runtime — legal here because neither branch
+            # holds a collective.
             my_ids = jax.lax.dynamic_index_in_dim(
                 ids_local, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
             )
-            x_in = jnp.where(s == 0, embed_local[my_ids].astype(buf.dtype), buf)
+            x_in = jax.lax.cond(
+                s == 0,
+                lambda: embed_local[my_ids].astype(buf.dtype),
+                lambda: buf,
+            )
             # my microbatch's padding mask rides the same timetable
             mask = jax.lax.dynamic_index_in_dim(pm_local, m_safe, axis=0, keepdims=False)
             y = run_stage(stacked_local, x_in, mask, flags_local)

@@ -95,8 +95,14 @@ def _local_ring_attention(q, k, v, padding_mask, *, axis_name: str, axis_size: i
     return out.astype(q.dtype)
 
 
-def ring_attention_supported(q, k, mesh: Optional[Mesh], *, axis_name: str = "seq",
-                             sliding_window: Optional[int] = None, causal: bool = True) -> bool:
+def seq_parallel_preconditions(q, k, mesh: Optional[Mesh], *, axis_name: str = "seq",
+                               sliding_window: Optional[int] = None,
+                               causal: bool = True) -> bool:
+    """Checks shared by BOTH sequence-parallel strategies (ring here, Ulysses
+    in parallel/ulysses.py): a live seq axis, causal non-windowed training
+    attention (no decode q_len != kv_len), and shapes divisible by the mesh.
+    Keeping one source of truth stops the two ``*_supported`` predicates from
+    drifting apart."""
     if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] <= 1:
         return False
     if sliding_window is not None or not causal:
@@ -114,6 +120,13 @@ def ring_attention_supported(q, k, mesh: Optional[Mesh], *, axis_name: str = "se
         and num_heads % tensor == 0
         and num_kv % tensor == 0
         and (num_heads // tensor) % max(num_kv // tensor, 1) == 0
+    )
+
+
+def ring_attention_supported(q, k, mesh: Optional[Mesh], *, axis_name: str = "seq",
+                             sliding_window: Optional[int] = None, causal: bool = True) -> bool:
+    return seq_parallel_preconditions(
+        q, k, mesh, axis_name=axis_name, sliding_window=sliding_window, causal=causal
     )
 
 

@@ -321,19 +321,24 @@ class SFTTrainer:
     def _make_shardings(self) -> NamedSharding:
         """Set batch/eval shardings; return the activation sharding.
 
-        Sequence parallelism: when a seq axis is live and ring attention is
-        selected, activations and batches shard the sequence dim too — the
-        ring (parallel/ring_attention.py) then rotates K/V over that axis.
+        Sequence parallelism: when a seq axis is live and a sequence-parallel
+        attention impl ("ring" or "ulysses") is selected, activations and
+        batches shard the sequence dim too — the ring
+        (parallel/ring_attention.py) rotates K/V over that axis; ulysses
+        (parallel/ulysses.py) re-partitions heads with all_to_all.
         Shared by the SFT and DPO step builders so the rules can't drift.
         """
-        if self.config.packing and self.config.attention_impl == "ring":
+        if self.config.packing and self.config.attention_impl in ("ring", "ulysses"):
             raise ValueError(
-                "packing=True is incompatible with attention_impl='ring' "
-                "(the ring rotation has no segment support); use flash/xla "
-                "attention for packed runs, or disable packing for "
-                "sequence-parallel long-context runs"
+                f"packing=True is incompatible with attention_impl="
+                f"{self.config.attention_impl!r} (sequence parallelism has no "
+                "segment support); use flash/xla attention for packed runs, "
+                "or disable packing for sequence-parallel long-context runs"
             )
-        seq_sharded = self.config.attention_impl == "ring" and self.mesh.shape["seq"] > 1
+        seq_sharded = (
+            self.config.attention_impl in ("ring", "ulysses")
+            and self.mesh.shape["seq"] > 1
+        )
         if (
             seq_sharded
             and jax.process_count() > 1
