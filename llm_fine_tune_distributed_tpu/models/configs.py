@@ -1,10 +1,11 @@
-"""Model presets for the supported dense-decoder families.
+"""Model presets for the supported decoder families.
 
 The flagship is SmolLM3-3B (the reference's hard-coded model,
 reference ``training.py:54``); the other presets cover the configs named in
-BASELINE.json (Llama-3-8B FSDP, Mistral-7B DPO, Llama-3-70B QLoRA).
-Values verified against the HF ``transformers`` config classes
-(``SmolLM3Config``/``LlamaConfig``/``MistralConfig``).
+BASELINE.json (Llama-3-8B FSDP, Mistral-7B DPO, Llama-3-70B QLoRA) plus the
+Mixtral MoE family (expert parallelism, ops/moe.py). Values verified against
+the HF ``transformers`` config classes
+(``SmolLM3Config``/``LlamaConfig``/``MistralConfig``/``MixtralConfig``).
 """
 
 from __future__ import annotations
@@ -88,6 +89,36 @@ PRESETS = {
         max_position_embeddings=8192,
         rms_norm_eps=1e-5,
         tie_word_embeddings=False,
+    ),
+    # Tiny MoE config (Mixtral structure) for unit tests / EP mesh tests.
+    "tiny_moe": ModelConfig(
+        name="tiny_moe",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        rope_theta=10_000.0,
+        max_position_embeddings=512,
+        tie_word_embeddings=False,
+        num_experts=4,
+        num_experts_per_tok=2,
+    ),
+    "mixtral_8x7b": ModelConfig(
+        name="mixtral_8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=1_000_000.0,
+        max_position_embeddings=32768,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        num_experts=8,
+        num_experts_per_tok=2,
     ),
     "mistral_7b": ModelConfig(
         name="mistral_7b",

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Dict, Optional
 
 import numpy as np
@@ -51,6 +52,13 @@ def pytree_to_hf_state_dict(params) -> Dict[str, np.ndarray]:
     for path, leaf in _flatten(params).items():
         arr = np.asarray(leaf)
         leaf_name = path[-1]
+        if len(path) >= 2 and path[-2] == "experts" and leaf_name in ("w1", "w2", "w3"):
+            # Stacked MoE expert weights [E, in, out] (ops/moe.py) -> HF
+            # Mixtral's per-expert Linears `...experts.<i>.w<n>.weight [out, in]`
+            base = ".".join(path[:-1])
+            for i in range(arr.shape[0]):
+                state[f"{base}.{i}.{leaf_name}.weight"] = np.ascontiguousarray(arr[i].T)
+            continue
         if leaf_name == _KERNEL_LEAF:
             hf_name = ".".join(path[:-1]) + ".weight"
             arr = arr.T
@@ -77,20 +85,44 @@ def hf_state_dict_to_pytree(state: Dict[str, np.ndarray], config: ModelConfig, d
             for part in (
                 "q_proj", "k_proj", "v_proj", "o_proj",
                 "gate_proj", "up_proj", "down_proj", "lm_head",
+                "block_sparse_moe.gate",
             )
         )
 
+    expert_re = re.compile(r"^(.*\.experts)\.(\d+)\.(w[123])\.weight$")
+    experts: Dict[tuple, Dict[int, np.ndarray]] = {}
     flat: Dict[tuple, np.ndarray] = {}
     for name, arr in state.items():
         arr = np.asarray(arr)
         if dtype is not None:
             arr = arr.astype(dtype)
+        m = expert_re.match(name)
+        if m:
+            # HF Mixtral per-expert Linear [out, in] -> row of the stacked
+            # [E, in, out] leaf (ops/moe.py layout)
+            key = tuple(m.group(1).split(".")) + (m.group(3),)
+            experts.setdefault(key, {})[int(m.group(2))] = np.ascontiguousarray(arr.T)
+            continue
         if needs_transpose(name):
             path = tuple(name[: -len(".weight")].split(".")) + (_KERNEL_LEAF,)
             arr = np.ascontiguousarray(arr.T)
         else:
             path = tuple(name.split("."))
         flat[path] = arr
+    for key, rows in experts.items():
+        n = config.num_experts or (max(rows) + 1)
+        missing = [i for i in range(n) if i not in rows]
+        if missing:
+            raise ValueError(
+                f"checkpoint is missing expert tensors {missing} for "
+                f"{'.'.join(key)} (expected {n} experts)"
+            )
+        if max(rows) + 1 > n:
+            raise ValueError(
+                f"checkpoint has {max(rows) + 1} experts for {'.'.join(key)} "
+                f"but config.num_experts={n}"
+            )
+        flat[key] = np.stack([rows[i] for i in range(n)])
 
     if config.tie_word_embeddings:
         flat.pop(("lm_head", _KERNEL_LEAF), None)

@@ -68,21 +68,29 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
             attn["k_proj"]["bias"] = jnp.zeros((kvd,), dtype)
             attn["v_proj"]["bias"] = jnp.zeros((kvd,), dtype)
             attn["o_proj"]["bias"] = jnp.zeros((h,), dtype)
-        mlp = {
-            "gate_proj": {"kernel": dense(next(keys), (h, f))},
-            "up_proj": {"kernel": dense(next(keys), (h, f))},
-            "down_proj": {"kernel": dense(next(keys), (f, h))},
-        }
-        if config.mlp_bias:
-            mlp["gate_proj"]["bias"] = jnp.zeros((f,), dtype)
-            mlp["up_proj"]["bias"] = jnp.zeros((f,), dtype)
-            mlp["down_proj"]["bias"] = jnp.zeros((h,), dtype)
-        layers[str(i)] = {
+        layer = {
             "input_layernorm": {"weight": jnp.ones((h,), dtype)},
             "self_attn": attn,
             "post_attention_layernorm": {"weight": jnp.ones((h,), dtype)},
-            "mlp": mlp,
         }
+        if config.num_experts > 0:
+            from llm_fine_tune_distributed_tpu.ops.moe import init_moe_params
+
+            # consumes one key (split internally); a model is uniformly MoE
+            # or dense so per-layer key alignment needs no padding
+            layer["block_sparse_moe"] = init_moe_params(next(keys), config, dtype)
+        else:
+            mlp = {
+                "gate_proj": {"kernel": dense(next(keys), (h, f))},
+                "up_proj": {"kernel": dense(next(keys), (h, f))},
+                "down_proj": {"kernel": dense(next(keys), (f, h))},
+            }
+            if config.mlp_bias:
+                mlp["gate_proj"]["bias"] = jnp.zeros((f,), dtype)
+                mlp["up_proj"]["bias"] = jnp.zeros((f,), dtype)
+                mlp["down_proj"]["bias"] = jnp.zeros((h,), dtype)
+            layer["mlp"] = mlp
+        layers[str(i)] = layer
 
     params: Params = {
         "model": {
@@ -149,11 +157,13 @@ def _block(
     quant_impl: str = "auto",
     rope_flag=None,
 ):
-    """One transformer block. Returns (x, new_cache_entry).
+    """One transformer block. Returns (x, new_cache_entry, moe_aux).
 
     ``rope_flag`` (traced bool scalar) overrides the static
     ``config.uses_rope(layer_idx)`` decision — used by the pipeline's
     layer-scan, where the absolute layer index is data, not Python.
+    ``moe_aux`` is the layer's load-balancing loss (f32 scalar; 0.0 for
+    dense models — ``config.num_experts == 0``).
     """
     b, s, h = x.shape
     d = config.resolved_head_dim
@@ -199,15 +209,33 @@ def _block(
     x = x + _linear(out, attn_p["o_proj"], compute_dtype, quant_impl)
 
     hid = rms_norm(x, lp["post_attention_layernorm"]["weight"], eps)
-    gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl)
-    up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype, quant_impl)
-    # Named so remat_policy="mlp" can save JUST this [b, s, f] product: the
-    # gate/up matmuls are ~58% of a block's param FLOPs, so saving their
-    # fused output avoids most of full-remat's recompute at one tensor per
-    # layer of extra HBM (vs. two for saving gate and up separately).
-    prod = checkpoint_name(jax.nn.silu(gate) * up, "mlp_act")
-    x = x + _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl)
-    return x, new_entry
+    aux = jnp.float32(0.0)
+    if config.num_experts > 0:
+        from llm_fine_tune_distributed_tpu.ops.moe import moe_mlp
+
+        # token-level real/pad mask for routing: packed batches encode pads
+        # as segment 0; the cache path's padding_mask covers the KV buffer
+        # (wrong length for the current chunk) and is skipped
+        token_mask = None
+        if segment_ids is not None:
+            token_mask = segment_ids > 0
+        elif padding_mask is not None and padding_mask.shape[-1] == s:
+            token_mask = padding_mask
+        moe_out, aux = moe_mlp(
+            lp["block_sparse_moe"], hid, config, compute_dtype, mesh=mesh,
+            token_mask=token_mask,
+        )
+        x = x + moe_out
+    else:
+        gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl)
+        up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype, quant_impl)
+        # Named so remat_policy="mlp" can save JUST this [b, s, f] product: the
+        # gate/up matmuls are ~58% of a block's param FLOPs, so saving their
+        # fused output avoids most of full-remat's recompute at one tensor per
+        # layer of extra HBM (vs. two for saving gate and up separately).
+        prod = checkpoint_name(jax.nn.silu(gate) * up, "mlp_act")
+        x = x + _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl)
+    return x, new_entry, aux
 
 
 def forward(
@@ -228,6 +256,7 @@ def forward(
     activation_sharding=None,
     output_hidden: bool = False,
     quant_impl: str = "auto",
+    return_aux: bool = False,
 ) -> Tuple[jax.Array, Optional[Dict[str, Any]]]:
     """Run the model.
 
@@ -245,6 +274,9 @@ def forward(
         (in ``compute_dtype``) instead of logits — the chunked-loss path
         (train/step.py) unembeds chunk-by-chunk so the [batch, seq, vocab]
         float32 logits tensor never materializes in HBM.
+      return_aux: also return the summed MoE load-balancing loss as a third
+        element ``(out, cache, aux)`` — 0.0 for dense models. The train step
+        requests it when ``config.num_experts > 0``.
       activation_sharding: optional ``NamedSharding`` for the [batch, seq,
         hidden] activations (normally batch over (data, fsdp)). Constraining
         activations explicitly keeps XLA/Shardy propagation on the intended
@@ -265,10 +297,14 @@ def forward(
             return jax.lax.with_sharding_constraint(h, activation_sharding)
         return h
 
-    # Sequence parallelism (ring / ulysses) needs the mesh to shard_map over;
-    # recover it from the activation sharding so call sites stay unchanged.
+    # Sequence parallelism (ring / ulysses) shard_maps over the mesh, and the
+    # MoE dispatch constrains its expert blocks to it; recover the mesh from
+    # the activation sharding so call sites stay unchanged. (The attention
+    # dispatch ignores it for non-sequence-parallel impls.)
     mesh = None
-    if attention_impl in ("ring", "ulysses") and activation_sharding is not None:
+    if activation_sharding is not None and (
+        attention_impl in ("ring", "ulysses") or config.num_experts > 0
+    ):
         mesh = getattr(activation_sharding, "mesh", None)
 
     embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
@@ -313,6 +349,7 @@ def forward(
             explicit_mask &= padding_mask.astype(bool)[:, None, :]
 
     new_layers = {}
+    moe_aux = jnp.float32(0.0)
     for i in range(config.num_layers):
         entry = cache["layers"][str(i)] if cache is not None else None
         block_fn = partial(
@@ -342,7 +379,7 @@ def forward(
                         f"'full', {sorted(policies)}"
                     )
                 block_fn = jax.checkpoint(block_fn, policy=policies[remat_policy])
-        x, new_entry = block_fn(
+        x, new_entry, layer_aux = block_fn(
             params["model"]["layers"][str(i)],
             x,
             cos,
@@ -354,6 +391,7 @@ def forward(
             cache_pos,
         )
         x = constrain(x)
+        moe_aux = moe_aux + layer_aux
         if new_entry is not None:
             new_layers[str(i)] = new_entry
 
@@ -361,9 +399,12 @@ def forward(
 
     new_cache = {"layers": new_layers} if cache is not None else None
     if output_hidden:
-        return x.astype(compute_dtype), new_cache
-    logits = unembed(params, x, config, compute_dtype=compute_dtype, logits_dtype=logits_dtype)
-    return logits, new_cache
+        out = x.astype(compute_dtype)
+    else:
+        out = unembed(params, x, config, compute_dtype=compute_dtype, logits_dtype=logits_dtype)
+    if return_aux:
+        return out, new_cache, moe_aux
+    return out, new_cache
 
 
 def unembed(params: Params, hidden, config: ModelConfig, *, compute_dtype=jnp.bfloat16, logits_dtype=jnp.float32):

@@ -87,6 +87,12 @@ def pipeline_forward(
     stacked [L, ...] and sharded over ``pipe``. ``padding_mask [M*mb, seq]``
     (1 = real token) travels the schedule alongside each microbatch.
     """
+    if config.num_experts > 0:
+        raise NotImplementedError(
+            "MoE models are not supported in the pipeline schedule yet (the "
+            "layer scan cannot surface the per-layer router aux loss); use "
+            "fsdp/tensor/expert mesh axes for MoE training"
+        )
     S = mesh.shape["pipe"]
     M = num_microbatches
     B, seq = input_ids.shape
@@ -116,7 +122,7 @@ def pipeline_forward(
 
         def one_block(h, args):
             layer_params, flag = args
-            h, _ = _block(
+            h, _, _aux = _block(
                 layer_params, h, cos, sin, mask, None, None, None, 0,
                 config=config, layer_idx=0, attention_impl="xla",
                 compute_dtype=compute_dtype,

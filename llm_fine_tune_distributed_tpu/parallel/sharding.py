@@ -50,6 +50,12 @@ _MATRIX_RULES = [
     # B [r, out] shard out-dim. Conservative: fsdp only (r is tiny).
     (re.compile(r".*/lora_a$"), ("fsdp", None)),
     (re.compile(r".*/lora_b$"), (None, "fsdp")),
+    # MoE (ops/moe.py): stacked expert weights shard the expert dim over the
+    # "expert" axis (expert parallelism) plus the usual fsdp/tensor dims;
+    # the router gate [h, E] is tiny — fsdp on the input dim only.
+    (re.compile(r".*block_sparse_moe/experts/(w1|w3)$"), ("expert", "fsdp", "tensor")),
+    (re.compile(r".*block_sparse_moe/experts/w2$"), ("expert", "tensor", "fsdp")),
+    (re.compile(r".*block_sparse_moe/gate/kernel$"), ("fsdp", None)),
 ]
 
 
@@ -83,6 +89,12 @@ def _validate_spec(spec: P, shape, mesh: Mesh) -> P:
     fixed = []
     for i, axis in enumerate(spec):
         if axis is None:
+            fixed.append(None)
+            continue
+        if axis == "expert" and axis not in mesh.shape:
+            # the one axis that is legitimately optional (meshes built before
+            # MoE support have 4 axes): replicate the expert dim. Any OTHER
+            # unknown axis is a bug in the rules and raises below.
             fixed.append(None)
             continue
         size = mesh.shape[axis]

@@ -22,7 +22,7 @@ from jax.sharding import Mesh
 
 from llm_fine_tune_distributed_tpu.config import MeshConfig
 
-MESH_AXES = ("data", "fsdp", "tensor", "seq")
+MESH_AXES = ("data", "fsdp", "tensor", "seq", "expert")
 
 
 def make_mesh(
@@ -44,7 +44,8 @@ def make_mesh(
         # Fully-specified mesh smaller than the device pool: use a prefix of
         # the devices (tests / deliberate under-subscription).
         explicit = {"data": config.data, "fsdp": config.fsdp,
-                    "tensor": config.tensor, "seq": config.seq}
+                    "tensor": config.tensor, "seq": config.seq,
+                    "expert": config.expert}
         if -1 in explicit.values():
             raise
         product = 1

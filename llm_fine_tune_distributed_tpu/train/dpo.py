@@ -103,7 +103,7 @@ def make_dpo_loss_fn(
             attention_impl=train_config.attention_impl,
             compute_dtype=compute_dtype,
             remat=train_config.gradient_checkpointing,
-            remat_policy=train_config.remat_policy,
+            remat_policy=train_config.resolved_remat_policy(model_config),
             activation_sharding=activation_sharding,
             output_hidden=True,
             quant_impl=quant_impl,
@@ -239,6 +239,23 @@ class DPOTrainer(SFTTrainer):
     copy of the initial trainable leaves, so a resume rebuilds it bit-identically
     from the same base weights.
     """
+
+    def __init__(self, config, model_config=None, **kwargs):
+        from llm_fine_tune_distributed_tpu.models.configs import get_preset
+
+        mc = model_config or get_preset(config.model_preset)
+        if mc.num_experts > 0:
+            # batch_logprobs does not plumb the router aux loss, so DPO on an
+            # MoE model would train the router with no load-balancing
+            # pressure (silent routing collapse). Reject loudly, like
+            # pipeline_forward does — before the base init does any heavy
+            # lifting (tokenizer/mesh/model setup).
+            raise NotImplementedError(
+                "DPO on MoE models is not supported yet (the DPO objective "
+                "does not include the router load-balancing loss); use the "
+                "SFT objective for MoE presets"
+            )
+        super().__init__(config, model_config=model_config, **kwargs)
 
     # ------------------------------------------------------------------ data
 

@@ -57,10 +57,14 @@ def chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfig, chu
 
 
 def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activation_sharding=None,
-                 quant_impl: Optional[str] = None):
+                 quant_impl: Optional[str] = None, include_router_aux: bool = True):
     compute_dtype = str_to_dtype(train_config.compute_dtype)
     chunk = train_config.loss_chunk_size
     quant_impl = quant_impl or train_config.quant_matmul_impl
+    # MoE: add the load-balancing aux loss to the TRAIN objective only (eval
+    # loss stays pure CE so perplexity/best-model tracking is comparable with
+    # dense runs). Dense models skip the plumbing entirely.
+    want_aux = include_router_aux and model_config.num_experts > 0
 
     def loss_fn(trainable, frozen, batch):
         """Masked next-token cross-entropy (token-mean within the batch) —
@@ -73,7 +77,7 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
                 "segment_ids": batch["segment_ids"],
                 "positions": batch["positions"],
             }
-        out, _ = forward(
+        result = forward(
             params,
             batch["input_ids"],
             model_config,
@@ -82,12 +86,14 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             attention_impl=train_config.attention_impl,
             compute_dtype=compute_dtype,
             remat=train_config.gradient_checkpointing,
-            remat_policy=train_config.remat_policy,
+            remat_policy=train_config.resolved_remat_policy(model_config),
             activation_sharding=activation_sharding,
             logits_dtype=jnp.float32,
             output_hidden=chunk is not None,
             quant_impl=quant_impl,
+            return_aux=want_aux,
         )
+        out = result[0]
         targets = batch["input_ids"][:, 1:]
         mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
         tokens = jnp.maximum(mask.sum(), 1.0)
@@ -99,6 +105,8 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             ce = optax.softmax_cross_entropy_with_integer_labels(out[:, :-1], targets)
             ce_sum = (ce * mask).sum()
         loss = ce_sum / tokens
+        if want_aux:
+            loss = loss + model_config.router_aux_coef * result[2]
         return loss, tokens
 
     return loss_fn
@@ -166,7 +174,10 @@ def build_eval_step(
     Returns sums (not means) so the caller aggregates a token-weighted eval
     loss over the whole validation set — the quantity behind
     ``eval_loss``/best-model tracking (reference ``training.py:273-275``)."""
-    loss_fn = make_loss_fn(model_config, train_config, activation_sharding, quant_impl)
+    loss_fn = make_loss_fn(
+        model_config, train_config, activation_sharding, quant_impl,
+        include_router_aux=False,
+    )
 
     def eval_step(state: TrainState, batch):
         loss, tokens = loss_fn(state.trainable, state.frozen, batch)

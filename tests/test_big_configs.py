@@ -85,6 +85,7 @@ def test_llama3_8b_fsdp_step_traces():
     assert mc.num_params == pytest.approx(8.03e9, rel=0.01)
     tc = TrainConfig(
         model_preset="llama3_8b",
+        remat_policy="full",  # memory-limited recipe: minimum-HBM remat
         max_seq_length=1024,
         gradient_accumulation_steps=2,
         loss_chunk_size=512,
@@ -101,6 +102,7 @@ def test_llama3_70b_qlora_step_traces():
     assert mc.num_params == pytest.approx(70.55e9, rel=0.01)
     tc = TrainConfig(
         model_preset="llama3_70b",
+        remat_policy="full",  # memory-limited recipe: minimum-HBM remat
         max_seq_length=1024,
         gradient_accumulation_steps=2,
         loss_chunk_size=512,
@@ -130,6 +132,7 @@ def test_mistral_7b_dpo_step_traces():
     tc = TrainConfig(
         model_preset="mistral_7b",
         objective="dpo",
+        remat_policy="full",  # memory-limited recipe: minimum-HBM remat
         max_seq_length=512,
         gradient_accumulation_steps=2,
         loss_chunk_size=256,

@@ -1,0 +1,312 @@
+"""Mixture-of-experts: routing math against a per-token reference loop,
+expert-parallel sharding parity, aux-loss behavior, end-to-end train-step
+convergence, and the Mixtral-8x7B abstract trace.
+
+The reference is dense-only (SURVEY.md §2.4: EP absent); ops/moe.py extends
+the framework to the Mixtral family with GShard-style einsum dispatch."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llm_fine_tune_distributed_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.ops.moe import expert_capacity, init_moe_params, moe_mlp
+
+
+def _cfg(**kw):
+    base = dict(
+        name="t",
+        vocab_size=128,
+        hidden_size=16,
+        intermediate_size=32,
+        num_layers=1,
+        num_heads=2,
+        num_kv_heads=2,
+        num_experts=4,
+        num_experts_per_tok=2,
+        capacity_factor=8.0,  # big: no drops unless a test wants them
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _reference_moe(lp, x, config):
+    """Per-token numpy loop: top-k renormalized routing, no capacity."""
+    b, s, h = x.shape
+    gate = np.asarray(lp["gate"]["kernel"], np.float32)
+    w1 = np.asarray(lp["experts"]["w1"], np.float32)
+    w2 = np.asarray(lp["experts"]["w2"], np.float32)
+    w3 = np.asarray(lp["experts"]["w3"], np.float32)
+    y = np.zeros_like(np.asarray(x, np.float32))
+    for bi in range(b):
+        for si in range(s):
+            t = np.asarray(x[bi, si], np.float32)
+            logits = t @ gate
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            top = np.argsort(-p)[: config.num_experts_per_tok]
+            w = p[top] / p[top].sum()
+            for e, we in zip(top, w):
+                hidden = (t @ w1[e]) * (1 / (1 + np.exp(-(t @ w1[e])))) * (t @ w3[e])
+                y[bi, si] += we * (hidden @ w2[e])
+    return y
+
+
+def test_moe_matches_reference_loop():
+    config = _cfg()
+    lp = init_moe_params(jax.random.PRNGKey(0), config, jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16), jnp.float32)
+    y, aux = jax.jit(lambda lp, x: moe_mlp(lp, x, config, jnp.float32))(lp, x)
+    ref = _reference_moe(lp, x, config)
+    np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_capacity_drops_overflow_tokens():
+    """With capacity 1 per (row, expert), later tokens routed to a full
+    expert are dropped — output attenuates but stays finite."""
+    config = _cfg(capacity_factor=1e-6)  # floor -> cap = 1
+    assert expert_capacity(16, config) == 1
+    lp = init_moe_params(jax.random.PRNGKey(0), config, jnp.float32)
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 16, 16), jnp.float32)
+    y, aux = jax.jit(lambda lp, x: moe_mlp(lp, x, config, jnp.float32))(lp, x)
+    full = _reference_moe(lp, x, config)
+    y = np.asarray(y)
+    assert np.all(np.isfinite(y))
+    # 16 tokens x k=2 = 32 assignments compete for 4 expert slots: most
+    # tokens are FULLY dropped (exact-zero output rows)
+    zero_rows = np.abs(y[0]).sum(-1) == 0
+    assert zero_rows.sum() >= 8
+    # the first token wins position 0 in both of its experts' queues, so it
+    # is never dropped and matches the capacity-free reference exactly
+    np.testing.assert_allclose(y[0, 0], full[0, 0], atol=1e-5)
+
+
+def test_uniform_router_aux_is_one():
+    """A perfectly uniform router gives aux = 1.0 (the minimum)."""
+    config = _cfg()
+    lp = init_moe_params(jax.random.PRNGKey(0), config, jnp.float32)
+    lp["gate"]["kernel"] = jnp.zeros_like(lp["gate"]["kernel"])  # uniform probs
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 32, 16), jnp.float32)
+    _, aux = moe_mlp(lp, x, config, jnp.float32)
+    # top-k tie-breaking still dispatches k of E experts; probs are uniform
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-5)
+
+
+def test_expert_parallel_matches_unsharded(eight_devices):
+    """moe_mlp under an expert=4 mesh == the single-device result."""
+    config = _cfg()
+    lp = init_moe_params(jax.random.PRNGKey(0), config, jnp.float32)
+    x = jnp.asarray(np.random.RandomState(3).randn(4, 8, 16), jnp.float32)
+    ref, aux_ref = moe_mlp(lp, x, config, jnp.float32)
+
+    mesh = Mesh(
+        np.array(eight_devices).reshape(2, 1, 1, 1, 4),
+        ("data", "fsdp", "tensor", "seq", "expert"),
+    )
+    from llm_fine_tune_distributed_tpu.parallel.sharding import shard_params
+
+    # rules match on the full path, so shard under the real subtree name
+    lp_sharded = shard_params({"block_sparse_moe": lp}, mesh)["block_sparse_moe"]
+    x_sharded = jax.device_put(x, NamedSharding(mesh, P(("data", "fsdp"))))
+    y, aux = jax.jit(
+        lambda lp, x: moe_mlp(lp, x, config, jnp.float32, mesh=mesh)
+    )(lp_sharded, x_sharded)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_forward_tiny_moe_and_aux():
+    from llm_fine_tune_distributed_tpu.models.transformer import forward, init_params
+
+    config = get_preset("tiny_moe")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 16)), jnp.int32)
+    logits, _, aux = forward(
+        params, ids, config, compute_dtype=jnp.float32, return_aux=True
+    )
+    assert logits.shape == (2, 16, 512)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert float(aux) > 0  # 2 MoE layers contribute
+
+
+def test_moe_train_step_converges():
+    """Loss (CE + aux) decreases over a few steps on tiny_moe."""
+    from llm_fine_tune_distributed_tpu.parallel.freeze import trainable_mask
+    from llm_fine_tune_distributed_tpu.parallel.optimizer import build_optimizer
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.train.state import TrainState
+    from llm_fine_tune_distributed_tpu.train.step import build_train_step
+    from llm_fine_tune_distributed_tpu.utils.tree import split_by_mask
+
+    config = get_preset("tiny_moe")
+    tc = TrainConfig(
+        model_preset="tiny_moe",
+        per_device_batch_size=4,
+        gradient_accumulation_steps=1,
+        max_seq_length=32,
+        learning_rate=5e-3,
+        freeze_strategy="none",
+        gradient_checkpointing=False,
+        attention_impl="xla",
+    )
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    mask = trainable_mask(params, config, tc)
+    trainable, frozen = split_by_mask(params, mask)
+    optimizer = build_optimizer(tc, None, total_steps=20, data_parallel_size=1)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        trainable=trainable,
+        frozen=frozen,
+        opt_state=optimizer.init(trainable),
+    )
+    step = jax.jit(build_train_step(config, tc, optimizer))
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": jnp.asarray(rng.randint(0, 512, (1, 4, 32)), jnp.int32),
+        "loss_mask": jnp.ones((1, 4, 32), jnp.float32),
+        "attention_mask": jnp.ones((1, 4, 32), jnp.int32),
+    }
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], f"MoE loss did not decrease: {losses}"
+
+
+def test_hf_io_roundtrip_moe():
+    """Stacked expert leaves <-> HF Mixtral per-expert names, bit-exact."""
+    from llm_fine_tune_distributed_tpu.models.hf_io import (
+        hf_state_dict_to_pytree,
+        pytree_to_hf_state_dict,
+    )
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+    config = get_preset("tiny_moe")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    state = pytree_to_hf_state_dict(params)
+    # per-expert names exist with torch [out, in] layout
+    assert "model.layers.0.block_sparse_moe.experts.0.w1.weight" in state
+    assert state["model.layers.0.block_sparse_moe.experts.0.w1.weight"].shape == (128, 64)
+    assert "model.layers.0.block_sparse_moe.gate.weight" in state
+    back = hf_state_dict_to_pytree(state, config)
+    a, b = flatten_dict(params), flatten_dict(back)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+def test_mixtral_8x7b_traces():
+    """Config #6-style scale check: param count and a full abstract train
+    step on an fsdp x expert mesh (cf. tests/test_big_configs.py)."""
+    from llm_fine_tune_distributed_tpu.parallel.freeze import trainable_mask
+    from llm_fine_tune_distributed_tpu.parallel.optimizer import build_optimizer
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.train.state import TrainState
+    from llm_fine_tune_distributed_tpu.train.step import build_train_step
+    from llm_fine_tune_distributed_tpu.utils.tree import split_by_mask
+
+    mc = get_preset("mixtral_8x7b")
+    assert mc.num_params == pytest.approx(46.7e9, rel=0.01)
+    tc = TrainConfig(
+        model_preset="mixtral_8x7b",
+        remat_policy="full",  # memory-limited recipe: minimum-HBM remat
+        max_seq_length=1024,
+        gradient_accumulation_steps=2,
+        loss_chunk_size=512,
+        attention_impl="xla",
+        mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=1, expert=4),
+    )
+    params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    )
+    mask = trainable_mask(params, mc, tc)
+    trainable, frozen = split_by_mask(params, mask)
+    optimizer = build_optimizer(tc, None, total_steps=10, data_parallel_size=1)
+    opt_state = jax.eval_shape(optimizer.init, trainable)
+    state = TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        trainable=trainable,
+        frozen=frozen,
+        opt_state=opt_state,
+    )
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct((2, 2, 1024), jnp.int32),
+        "loss_mask": jax.ShapeDtypeStruct((2, 2, 1024), jnp.float32),
+        "attention_mask": jax.ShapeDtypeStruct((2, 2, 1024), jnp.int32),
+    }
+    step = build_train_step(mc, tc, optimizer)
+    new_state, metrics = jax.eval_shape(step, state, batch)
+    assert metrics["loss"].shape == ()
+
+
+def test_expert_weights_get_expert_axis_spec():
+    """Sharding rules give stacked expert leaves a leading expert axis."""
+    from llm_fine_tune_distributed_tpu.parallel.sharding import param_spec
+
+    spec = param_spec("model/layers/0/block_sparse_moe/experts/w1", 3)
+    assert spec[0] == "expert"
+    spec2 = param_spec("model/layers/0/block_sparse_moe/experts/w2", 3)
+    assert spec2[0] == "expert"
+
+
+def test_pipeline_rejects_moe():
+    from llm_fine_tune_distributed_tpu.parallel.pipeline import pipeline_forward
+
+    config = get_preset("tiny_moe")
+    with pytest.raises(NotImplementedError):
+        pipeline_forward(
+            {}, {}, jnp.zeros((2, 8), jnp.int32), config, None, 1
+        )
+
+
+def test_dpo_rejects_moe():
+    from llm_fine_tune_distributed_tpu.train.dpo import DPOTrainer
+
+    tc = TrainConfig(model_preset="tiny_moe", objective="dpo")
+    with pytest.raises(NotImplementedError):
+        DPOTrainer(tc)
+
+
+def test_padding_excluded_from_routing():
+    """Pad tokens get zero MoE output, hold no capacity, and the aux loss
+    equals the trimmed batch's aux exactly."""
+    config = _cfg()
+    lp = init_moe_params(jax.random.PRNGKey(0), config, jnp.float32)
+    real_len = 6
+    x_real = jnp.asarray(np.random.RandomState(4).randn(2, real_len, 16), jnp.float32)
+    x_pad = jnp.concatenate(
+        [x_real, jnp.asarray(np.random.RandomState(5).randn(2, 10, 16), jnp.float32)],
+        axis=1,
+    )
+    mask = jnp.concatenate(
+        [jnp.ones((2, real_len), jnp.int32), jnp.zeros((2, 10), jnp.int32)], axis=1
+    )
+    y_pad, aux_pad = moe_mlp(lp, x_pad, config, jnp.float32, token_mask=mask)
+    y_ref, aux_ref = moe_mlp(lp, x_real, config, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(y_pad)[:, :real_len], np.asarray(y_ref), atol=1e-5
+    )
+    assert np.abs(np.asarray(y_pad)[:, real_len:]).max() == 0.0  # pads untouched
+    np.testing.assert_allclose(float(aux_pad), float(aux_ref), rtol=1e-5)
+
+
+def test_chunked_dispatch_matches_unchunked():
+    """Grouped (chunked-sequence) routing == single-group routing when
+    capacity is ample — the long-context memory path changes nothing
+    numerically."""
+    import dataclasses
+
+    config = _cfg()  # moe_dispatch_chunk default 1024 >> s: single group
+    chunked = dataclasses.replace(config, moe_dispatch_chunk=16)
+    lp = init_moe_params(jax.random.PRNGKey(0), config, jnp.float32)
+    x = jnp.asarray(np.random.RandomState(6).randn(2, 64, 16), jnp.float32)
+    y_ref, aux_ref = moe_mlp(lp, x, config, jnp.float32)
+    y_chk, aux_chk = jax.jit(lambda lp, x: moe_mlp(lp, x, chunked, jnp.float32))(lp, x)
+    np.testing.assert_allclose(np.asarray(y_chk), np.asarray(y_ref), atol=1e-5)
+    np.testing.assert_allclose(float(aux_chk), float(aux_ref), rtol=1e-5)

@@ -8,7 +8,7 @@ Differences by design (TPU-first):
 - multi-host rendezvous is ``jax.distributed.initialize`` (coordinator =
   MASTER_ADDR analog), not NCCL (SURVEY.md §2.5);
 - parallelism is a device mesh (data/fsdp/tensor/seq) instead of flat DDP —
-  shape via MESH_DATA/MESH_FSDP/MESH_TENSOR/MESH_SEQ env vars;
+  shape via MESH_DATA/MESH_FSDP/MESH_TENSOR/MESH_SEQ/MESH_EXPERT env vars;
 - runs on TPU, CPU (simulation), or any JAX backend — no hard CUDA assert
   (reference hard-fails without CUDA at ``training.py:81-83``).
 
@@ -76,7 +76,10 @@ def main() -> int:
         config.model_preset = args.model_preset
     if args.resume is not None:
         config.resume_from_checkpoint = args.resume
-    mesh_env = {k: os.environ.get(f"MESH_{k.upper()}") for k in ("data", "fsdp", "tensor", "seq")}
+    mesh_env = {
+        k: os.environ.get(f"MESH_{k.upper()}")
+        for k in ("data", "fsdp", "tensor", "seq", "expert")
+    }
     if any(v is not None for v in mesh_env.values()):
         config.mesh = MeshConfig(
             **{k: int(v) for k, v in mesh_env.items() if v is not None}
