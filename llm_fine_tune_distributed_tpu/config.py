@@ -176,6 +176,10 @@ class TrainConfig:
     gradient_accumulation_steps: int = 4
     learning_rate: float = 5e-5
     scale_lr_by_data_parallel: bool = True  # lr x world_size rule, training.py:263
+    # "adamw" (HF Trainer default, reference parity) | "adafactor" (factored
+    # second moment — near-zero optimizer-state HBM, the classic TPU choice
+    # for big models) | "lion" (sign-momentum, one state slot)
+    optimizer: str = "adamw"
     weight_decay: float = 0.0
     adam_b1: float = 0.9
     adam_b2: float = 0.999
@@ -301,6 +305,7 @@ class TrainConfig:
         "GRAD_ACCUM_STEPS": ("gradient_accumulation_steps", int),
         "SEED": ("seed", int),
         "ATTENTION_IMPL": ("attention_impl", str),
+        "OPTIMIZER": ("optimizer", str),
         "PARAM_DTYPE": ("param_dtype", str),
         "FREEZE_STRATEGY": ("freeze_strategy", str),
         "REMAT_POLICY": ("remat_policy", str),

@@ -224,6 +224,9 @@ def _block(
         moe_out, aux = moe_mlp(
             lp["block_sparse_moe"], hid, config, compute_dtype, mesh=mesh,
             token_mask=token_mask,
+            # decode/prefill (KV cache live) is dropless like HF Mixtral:
+            # capacity drops would make outputs depend on batch/chunk shape
+            dropless=cache_entry is not None,
         )
         x = x + moe_out
     else:
@@ -257,7 +260,10 @@ def forward(
     output_hidden: bool = False,
     quant_impl: str = "auto",
     return_aux: bool = False,
-) -> Tuple[jax.Array, Optional[Dict[str, Any]]]:
+) -> (
+    Tuple[jax.Array, Optional[Dict[str, Any]]]
+    | Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]
+):
     """Run the model.
 
     Args:

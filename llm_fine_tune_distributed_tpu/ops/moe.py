@@ -45,7 +45,8 @@ def expert_capacity(seq_len: int, config: ModelConfig) -> int:
     return max(1, int(math.ceil(k * seq_len / e * config.capacity_factor)))
 
 
-def moe_mlp(lp, x, config: ModelConfig, compute_dtype, mesh=None, token_mask=None):
+def moe_mlp(lp, x, config: ModelConfig, compute_dtype, mesh=None, token_mask=None,
+            dropless=False):
     """Sparse MoE MLP. ``x [b, s, h] -> (y [b, s, h], aux scalar f32)``.
 
     ``lp`` is the ``block_sparse_moe`` params subtree. ``aux`` is the raw
@@ -54,6 +55,11 @@ def moe_mlp(lp, x, config: ModelConfig, compute_dtype, mesh=None, token_mask=Non
     ``token_mask [b, s]`` (1 = real token) excludes padding from routing:
     pad tokens get no dispatch (zero MoE output), consume no expert
     capacity, and do not pollute the load-balancing statistics.
+    ``dropless=True`` sizes the capacity at the worst case (every token to
+    one expert) so NO token is ever dropped — the inference semantics (HF
+    Mixtral decode is dropless); capacity drops are a training-efficiency
+    trade-off that would otherwise make decode output depend on how many
+    tokens share the forward pass.
     """
     b, s, h = x.shape
     e, k = config.num_experts, config.num_experts_per_tok
@@ -63,19 +69,27 @@ def moe_mlp(lp, x, config: ModelConfig, compute_dtype, mesh=None, token_mask=Non
     # instead of [b, s, E, C] whose C grows with s. The aux statistics are
     # token-means, so grouping leaves them unchanged.
     if s > config.moe_dispatch_chunk:
-        # largest divisor of s that fits the chunk budget, so every seq
-        # length gets SOME grouping (s=1536 @ budget 1024 -> chunks of 768)
-        chunk = next(
-            c for c in range(config.moe_dispatch_chunk, 0, -1) if s % c == 0
-        )
-        if chunk > 1:
-            n = s // chunk
-            xg = x.reshape(b * n, chunk, h)
-            mg = None if token_mask is None else token_mask.reshape(b * n, chunk)
-            y, aux = moe_mlp(lp, xg, config, compute_dtype, mesh=mesh, token_mask=mg)
-            return y.reshape(b, s, h), aux
+        # balanced grouping: n = ceil(s/budget) groups of ceil(s/n) tokens,
+        # padded+masked to a chunk multiple. Handles every length (incl.
+        # primes) with < n wasted positions — s=1030 @ budget 1024 becomes
+        # two 515-token groups with zero padding, not two padded 1024s.
+        n_groups = -(-s // config.moe_dispatch_chunk)
+        chunk = -(-s // n_groups)
+        pad = (-s) % chunk
+        xg = jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
+        mg = token_mask
+        if pad:
+            if mg is None:
+                mg = jnp.ones((b, s), jnp.int32)
+            mg = jnp.pad(mg.astype(jnp.int32), ((0, 0), (0, pad)))
+        n = (s + pad) // chunk
+        xg = xg.reshape(b * n, chunk, h)
+        mg = None if mg is None else mg.reshape(b * n, chunk)
+        y, aux = moe_mlp(lp, xg, config, compute_dtype, mesh=mesh, token_mask=mg,
+                         dropless=dropless)
+        return y.reshape(b, s + pad, h)[:, :s], aux
 
-    cap = expert_capacity(s, config)
+    cap = s if dropless else expert_capacity(s, config)
 
     gate_logits = x @ lp["gate"]["kernel"].astype(compute_dtype)  # [b, s, E]
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)

@@ -6,6 +6,10 @@ from HF Trainer's create_optimizer/scheduler inside TRL (C9):
   lr x data_parallel_size scaling (reference ``training.py:263``),
   frozen params get NO optimizer state (optax.multi_transform) — preserving
   the memory profile of the freezing policy (C5).
+
+Beyond reference parity, ``config.optimizer`` selects "adafactor" (factored
+second moment — near-zero optimizer-state HBM, the classic TPU choice for
+big models) or "lion" (sign momentum, one state slot) in the same chain.
 """
 
 from __future__ import annotations
@@ -57,15 +61,39 @@ def build_optimizer(
     so frozen leaves get no state (for callers that keep one joint pytree).
     """
     schedule = build_lr_schedule(config, total_steps, data_parallel_size)
-    inner = optax.chain(
-        optax.clip_by_global_norm(config.max_grad_norm),
-        optax.adamw(
+    if config.optimizer == "adamw":
+        core = optax.adamw(
             learning_rate=schedule,
             b1=config.adam_b1,
             b2=config.adam_b2,
             eps=config.adam_eps,
             weight_decay=config.weight_decay,
-        ),
+        )
+    elif config.optimizer == "adafactor":
+        # Factored second moment: optimizer state is O(rows + cols) per
+        # matrix instead of O(rows * cols) — the classic TPU big-model
+        # choice. Momentum off (that is Adafactor's memory win).
+        core = optax.adafactor(
+            learning_rate=schedule,
+            multiply_by_parameter_scale=False,
+            clipping_threshold=None,  # global-norm clip handles it below
+            weight_decay_rate=config.weight_decay or None,
+        )
+    elif config.optimizer == "lion":
+        core = optax.lion(
+            learning_rate=schedule,
+            b1=config.adam_b1,
+            b2=config.adam_b2,
+            weight_decay=config.weight_decay,
+        )
+    else:
+        raise ValueError(
+            f"unknown optimizer {config.optimizer!r}; expected "
+            "'adamw', 'adafactor', or 'lion'"
+        )
+    inner = optax.chain(
+        optax.clip_by_global_norm(config.max_grad_norm),
+        core,
     )
     if trainable_mask is None:
         return inner

@@ -106,7 +106,12 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             ce_sum = (ce * mask).sum()
         loss = ce_sum / tokens
         if want_aux:
-            loss = loss + model_config.router_aux_coef * result[2]
+            # layer-MEAN of the per-layer aux (forward returns the sum), so
+            # router_aux_coef is depth-independent — matching the effective
+            # scale of HF Mixtral's router_aux_loss_coef rather than growing
+            # the balancing pressure 32x on a 32-layer model
+            aux = result[2] / model_config.num_layers
+            loss = loss + model_config.router_aux_coef * aux
         return loss, tokens
 
     return loss_fn

@@ -100,3 +100,41 @@ def test_mistral_tiny_logit_parity_with_sliding_window():
     torch.manual_seed(2)
     model = transformers.MistralForCausalLM(hf_cfg).eval()
     _compare(model, hf_cfg, seq=16)
+
+
+def test_mixtral_tiny_logit_parity():
+    """MoE routing semantics vs HF Mixtral: softmax-then-top-k-renormalize,
+    per-expert SwiGLU, weighted combine. HF computes every selected expert
+    (dropless), so our forward runs with ample capacity to match."""
+    import dataclasses
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        sliding_window=None,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(3)
+    model = transformers.MixtralForCausalLM(hf_cfg).eval()
+
+    cfg = from_hf_config(hf_cfg)
+    assert cfg.num_experts == 4 and cfg.num_experts_per_tok == 2
+    cfg = dataclasses.replace(cfg, capacity_factor=8.0)  # dropless like HF
+    state = _torch_state_to_numpy(model)
+    params = hf_state_dict_to_pytree(state, cfg, dtype=np.float32)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(2, 12))
+    with torch.no_grad():
+        ref = model(torch.tensor(ids)).logits.to(torch.float32).numpy()
+    ours, _ = forward(params, jnp.asarray(ids, jnp.int32), cfg, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=2e-4)

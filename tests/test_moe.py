@@ -310,3 +310,69 @@ def test_chunked_dispatch_matches_unchunked():
     y_chk, aux_chk = jax.jit(lambda lp, x: moe_mlp(lp, x, chunked, jnp.float32))(lp, x)
     np.testing.assert_allclose(np.asarray(y_chk), np.asarray(y_ref), atol=1e-5)
     np.testing.assert_allclose(float(aux_chk), float(aux_ref), rtol=1e-5)
+
+    # non-divisible length: 60 pads to 64 (4 chunks of 16), tail masked out
+    x60 = x[:, :60]
+    y_ref60, aux_ref60 = moe_mlp(lp, x60, config, jnp.float32)
+    y60, aux60 = jax.jit(lambda lp, x: moe_mlp(lp, x, chunked, jnp.float32))(lp, x60)
+    assert y60.shape == x60.shape
+    np.testing.assert_allclose(np.asarray(y60), np.asarray(y_ref60), atol=1e-5)
+    np.testing.assert_allclose(float(aux60), float(aux_ref60), rtol=1e-5)
+
+
+def test_moe_dropless_matches_reference():
+    """dropless=True must ignore capacity entirely: even with a degenerate
+    capacity_factor the output equals the per-token reference loop."""
+    config = _cfg(capacity_factor=1e-6)
+    lp = init_moe_params(jax.random.PRNGKey(0), config, jnp.float32)
+    x = jnp.asarray(np.random.RandomState(7).randn(2, 12, 16), jnp.float32)
+    y, _ = jax.jit(
+        lambda lp, x: moe_mlp(lp, x, config, jnp.float32, dropless=True)
+    )(lp, x)
+    np.testing.assert_allclose(
+        np.asarray(y), _reference_moe(lp, x, config), atol=1e-5
+    )
+
+
+def test_moe_kv_cache_decode_matches_full_forward():
+    """Greedy KV-cache decode on tiny_moe == re-running the growing prefix
+    through the cache-free forward. The decode path is dropless (HF Mixtral
+    semantics), so the reference forward runs with ample capacity to be
+    dropless too — then the cache must be numerically transparent."""
+    import dataclasses
+
+    from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+    from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
+    from llm_fine_tune_distributed_tpu.models.transformer import forward, init_params
+
+    mc = get_preset("tiny_moe")
+    mc_ample = dataclasses.replace(mc, capacity_factor=4.0)  # cap >= s: dropless
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    tok = ByteChatMLTokenizer()
+    gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    prompt = tok.encode("water purification")
+    cfg = GenerationConfig(max_new_tokens=6, do_sample=False, repetition_penalty=1.0)
+    out = gen.generate_ids(prompt, cfg)
+    assert len(out) == 6
+
+    seq = list(prompt)
+    for tok_id in out:
+        logits, _ = forward(
+            params, jnp.asarray([seq], jnp.int32), mc_ample, compute_dtype=jnp.float32
+        )
+        assert int(jnp.argmax(logits[0, -1])) == tok_id
+        seq.append(tok_id)
+
+
+def test_qlora_rejects_moe(tmp_path):
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    tc = TrainConfig(
+        model_preset="tiny_moe",
+        model_name="tiny-random",
+        tokenizer_path="byte-chatml",
+        freeze_strategy="qlora",
+        output_dir=str(tmp_path),
+    )
+    with pytest.raises(NotImplementedError, match="QLoRA on MoE"):
+        SFTTrainer(tc)

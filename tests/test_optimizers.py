@@ -1,0 +1,98 @@
+"""Optimizer selection: adamw (reference parity) / adafactor / lion all
+converge on the tiny model, and adafactor's factored state actually delivers
+the optimizer-memory win it exists for."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from llm_fine_tune_distributed_tpu.config import TrainConfig
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.parallel.freeze import trainable_mask
+from llm_fine_tune_distributed_tpu.parallel.optimizer import build_optimizer
+from llm_fine_tune_distributed_tpu.train.state import TrainState
+from llm_fine_tune_distributed_tpu.train.step import build_train_step
+from llm_fine_tune_distributed_tpu.utils.tree import split_by_mask
+
+
+def _run_steps(optimizer_name, n_steps=6):
+    config = get_preset("tiny")
+    tc = TrainConfig(
+        model_preset="tiny",
+        optimizer=optimizer_name,
+        per_device_batch_size=4,
+        gradient_accumulation_steps=1,
+        max_seq_length=32,
+        learning_rate=3e-3,
+        lr_schedule="constant",
+        freeze_strategy="none",
+        gradient_checkpointing=False,
+        attention_impl="xla",
+    )
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    mask = trainable_mask(params, config, tc)
+    trainable, frozen = split_by_mask(params, mask)
+    optimizer = build_optimizer(tc, None, total_steps=n_steps, data_parallel_size=1)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        trainable=trainable,
+        frozen=frozen,
+        opt_state=optimizer.init(trainable),
+    )
+    step = jax.jit(build_train_step(config, tc, optimizer))
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": jnp.asarray(rng.randint(0, 512, (1, 4, 32)), jnp.int32),
+        "loss_mask": jnp.ones((1, 4, 32), jnp.float32),
+        "attention_mask": jnp.ones((1, 4, 32), jnp.int32),
+    }
+    losses = []
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses, state
+
+
+@pytest.mark.parametrize("name", ["adamw", "adafactor", "lion"])
+def test_optimizer_converges(name):
+    losses, _ = _run_steps(name)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], f"{name}: loss did not decrease: {losses}"
+
+
+def test_adafactor_state_is_factored():
+    """Adafactor's second-moment state must be much smaller than the params
+    (rows + cols per matrix, not rows * cols). Factoring engages at
+    dims >= 128, so check on a realistically-sized matrix."""
+
+    def state_bytes(tree):
+        return sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize
+            for l in jax.tree.leaves(tree)
+            if hasattr(l, "shape") and l.ndim > 0
+        )
+
+    params = {"w": jnp.zeros((512, 2048), jnp.float32)}
+    params_bytes = state_bytes(params)
+
+    ada = build_optimizer(
+        TrainConfig(optimizer="adafactor"), None, total_steps=10, data_parallel_size=1
+    )
+    adam = build_optimizer(
+        TrainConfig(optimizer="adamw"), None, total_steps=10, data_parallel_size=1
+    )
+    ada_bytes = state_bytes(ada.init(params))
+    adam_bytes = state_bytes(adam.init(params))
+    assert adam_bytes >= 2 * params_bytes * 0.9  # adamw: mu + nu, full size
+    assert ada_bytes < params_bytes * 0.05, (
+        f"adafactor state {ada_bytes}B not factored vs params {params_bytes}B"
+    )
+
+
+def test_unknown_optimizer_rejected():
+    tc = TrainConfig(optimizer="sgd")
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        build_optimizer(tc, None, total_steps=10, data_parallel_size=1)
