@@ -170,8 +170,11 @@ def from_hf_config(hf_config) -> ModelConfig:
         mlp_bias=bool(g("mlp_bias", False)),
         no_rope_layers=tuple(no_rope),
         sliding_window=g("sliding_window") if g("use_sliding_window", True) else None,
-        # MoE (HF MixtralConfig naming)
+        # MoE (HF MixtralConfig naming). router_aux_loss_coef=0.0 is a
+        # legitimate explicit choice (aux disabled) — only None falls back.
         num_experts=g("num_local_experts", 0) or 0,
         num_experts_per_tok=g("num_experts_per_tok", 2) or 2,
-        router_aux_coef=g("router_aux_loss_coef", 0.01) or 0.01,
+        router_aux_coef=(
+            0.01 if g("router_aux_loss_coef") is None else g("router_aux_loss_coef")
+        ),
     )

@@ -80,10 +80,11 @@ def build_optimizer(
             weight_decay_rate=config.weight_decay or None,
         )
     elif config.optimizer == "lion":
+        # Lion's published/optax defaults (b1=0.9, b2=0.99) — deliberately
+        # NOT config.adam_b1/b2: those tune the adamw baseline, and Lion's
+        # momentum horizon is a different animal (b2=0.999 would ~10x it).
         core = optax.lion(
             learning_rate=schedule,
-            b1=config.adam_b1,
-            b2=config.adam_b2,
             weight_decay=config.weight_decay,
         )
     else:

@@ -79,6 +79,7 @@ def pipeline_forward(
     compute_dtype=jnp.bfloat16,
     remat_blocks: bool = True,
     output_hidden: bool = False,
+    return_aux: bool = False,
 ):
     """Pipelined forward: logits for ``input_ids [M * mb, seq]``.
 
@@ -86,13 +87,15 @@ def pipeline_forward(
     if untied), replicated; ``stacked_layers`` are the transformer blocks
     stacked [L, ...] and sharded over ``pipe``. ``padding_mask [M*mb, seq]``
     (1 = real token) travels the schedule alongside each microbatch.
+
+    MoE models work too: each stage accumulates its layers' router aux loss
+    in the scan carry, bubble ticks are masked out, and the psum over the
+    pipe axis yields the total. With ``return_aux=True`` the result is
+    ``(out, aux)`` where aux is the layer-SUM averaged over microbatches —
+    the same scale ``models/transformer.forward`` returns per microbatch.
+    (Experts are replicated within a stage — the pipe axis does not compose
+    with expert parallelism.)
     """
-    if config.num_experts > 0:
-        raise NotImplementedError(
-            "MoE models are not supported in the pipeline schedule yet (the "
-            "layer scan cannot surface the per-layer router aux loss); use "
-            "fsdp/tensor/expert mesh axes for MoE training"
-        )
     S = mesh.shape["pipe"]
     M = num_microbatches
     B, seq = input_ids.shape
@@ -118,21 +121,22 @@ def pipeline_forward(
     rope_flags = jnp.asarray(flags_list, jnp.bool_)
 
     def run_stage(stage_layers, x, mask, stage_flags):
-        """Scan my L_local blocks over x [mb, seq, h]."""
+        """Scan my L_local blocks over x [mb, seq, h]; returns (x, aux_sum)."""
 
-        def one_block(h, args):
+        def one_block(carry, args):
+            h, aux = carry
             layer_params, flag = args
-            h, _, _aux = _block(
+            h, _, layer_aux = _block(
                 layer_params, h, cos, sin, mask, None, None, None, 0,
                 config=config, layer_idx=0, attention_impl="xla",
                 compute_dtype=compute_dtype,
                 rope_flag=None if uniform_rope else flag,
             )
-            return h, None
+            return (h, aux + layer_aux), None
 
         body = jax.checkpoint(one_block) if remat_blocks else one_block
-        x, _ = jax.lax.scan(body, x, (stage_layers, stage_flags))
-        return x
+        (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)), (stage_layers, stage_flags))
+        return x, aux
 
     def spmd(stacked_local, embed_local, ids_local, pm_local, flags_local):
         # stacked_local: this stage's layers [L_local, ...]; ids_local/
@@ -145,7 +149,7 @@ def pipeline_forward(
         h_dim = embed_local.shape[-1]
 
         def tick(carry, t):
-            buf = carry  # [mb, seq, h] activation arriving at my stage
+            buf, aux_sum = carry  # [mb, seq, h] activation arriving at my stage
             m = t - s    # microbatch index my stage works on this tick
             m_safe = jnp.clip(m, 0, M - 1)
             # stage 0 embeds its own microbatch; others use the received
@@ -162,23 +166,27 @@ def pipeline_forward(
             )
             # my microbatch's padding mask rides the same timetable
             mask = jax.lax.dynamic_index_in_dim(pm_local, m_safe, axis=0, keepdims=False)
-            y = run_stage(stacked_local, x_in, mask, flags_local)
-            # mask bubble ticks so garbage never enters the ring
+            y, aux_tick = run_stage(stacked_local, x_in, mask, flags_local)
+            # mask bubble ticks so garbage never enters the ring (or the aux)
             valid = (m >= 0) & (m < M)
             y = jnp.where(valid, y, jnp.zeros_like(y))
+            aux_sum = aux_sum + jnp.where(valid, aux_tick, 0.0)
             # pass to the next stage (last stage's output falls off the end)
             y_next = jax.lax.ppermute(
                 y, "pipe", [(i, (i + 1) % S) for i in range(S)]
             )
             # last stage emits microbatch m_out = t - (S - 1)
             out = jnp.where(s == S - 1, y, jnp.zeros_like(y))
-            return y_next, out
+            return (y_next, aux_sum), out
 
-        _, outs = jax.lax.scan(
+        (_, aux_local), outs = jax.lax.scan(
             tick,
-            jnp.zeros((mb, seq, h_dim), compute_dtype),
+            (jnp.zeros((mb, seq, h_dim), compute_dtype), jnp.float32(0.0)),
             jnp.arange(T),
         )
+        # total router aux over every (stage, microbatch), averaged over
+        # microbatches -> the per-microbatch layer-sum scale forward() uses
+        aux = jax.lax.psum(aux_local, "pipe") / M
         # outs [T, mb, seq, h]: last stage's real outputs live at ticks
         # t = m + S - 1; drop the S-1 bubble rows first so the collective
         # moves only real data. When M divides S-ways, reduce-scatter leaves
@@ -186,15 +194,18 @@ def pipeline_forward(
         # all-reduce copy per stage.
         outs = outs[S - 1 :]
         if M % S == 0:
-            return jax.lax.psum_scatter(outs, "pipe", scatter_dimension=0, tiled=True)
-        return jax.lax.psum(outs, "pipe")
+            return (
+                jax.lax.psum_scatter(outs, "pipe", scatter_dimension=0, tiled=True),
+                aux,
+            )
+        return jax.lax.psum(outs, "pipe"), aux
 
     out_spec = P("pipe") if M % S == 0 else P()
-    outs = shard_map(
+    outs, aux = shard_map(
         spmd,
         mesh=mesh,
         in_specs=(P("pipe"), P(), P(), P(), P("pipe")),
-        out_specs=out_spec,
+        out_specs=(out_spec, P()),
         check_vma=False,
     )(stacked_layers, embed, ids, pm, rope_flags)
 
@@ -203,8 +214,10 @@ def pipeline_forward(
     h = outs.reshape(B, seq, -1)
     h = rms_norm(h, params["model"]["norm"]["weight"], config.rms_norm_eps)
     if output_hidden:
-        return h.astype(compute_dtype)
-    return unembed(params, h, config, compute_dtype=compute_dtype, logits_dtype=jnp.float32)
+        out = h.astype(compute_dtype)
+    else:
+        out = unembed(params, h, config, compute_dtype=compute_dtype, logits_dtype=jnp.float32)
+    return (out, aux) if return_aux else out
 
 
 def pipeline_loss_fn(
@@ -218,31 +231,39 @@ def pipeline_loss_fn(
     loss_chunk_size=None,
 ):
     """Masked next-token CE through the pipeline (same objective as
-    train/step.py's make_loss_fn, including the chunked large-vocab path).
+    train/step.py's make_loss_fn, including the chunked large-vocab path and
+    the MoE router aux term at the same layer-mean scale).
     Differentiable: jax.grad through this yields the reverse-schedule
     backward pipeline automatically."""
     targets = batch["input_ids"][:, 1:]
     mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
     tokens = jnp.maximum(mask.sum(), 1.0)
+    want_aux = config.num_experts > 0
+
+    def add_aux(loss, aux):
+        if not want_aux:
+            return loss
+        return loss + config.router_aux_coef * aux / config.num_layers
+
     if loss_chunk_size is not None:
         # never materialize [B, seq, vocab] logits (128k-vocab models):
         # unembed chunk-by-chunk exactly like train/step.py
         from llm_fine_tune_distributed_tpu.train.step import chunked_ce_sum
 
-        hidden = pipeline_forward(
+        hidden, aux = pipeline_forward(
             params, stacked_layers, batch["input_ids"], config, mesh,
             num_microbatches, padding_mask=batch.get("attention_mask"),
-            compute_dtype=compute_dtype, output_hidden=True,
+            compute_dtype=compute_dtype, output_hidden=True, return_aux=True,
         )
         ce_sum = chunked_ce_sum(
             params, hidden[:, :-1], targets, mask, config, loss_chunk_size,
             compute_dtype,
         )
-        return ce_sum / tokens
-    logits = pipeline_forward(
+        return add_aux(ce_sum / tokens, aux)
+    logits, aux = pipeline_forward(
         params, stacked_layers, batch["input_ids"], config, mesh,
         num_microbatches, padding_mask=batch.get("attention_mask"),
-        compute_dtype=compute_dtype,
+        compute_dtype=compute_dtype, return_aux=True,
     )
     ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
-    return (ce * mask).sum() / tokens
+    return add_aux((ce * mask).sum() / tokens, aux)

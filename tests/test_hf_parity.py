@@ -138,3 +138,15 @@ def test_mixtral_tiny_logit_parity():
         ref = model(torch.tensor(ids)).logits.to(torch.float32).numpy()
     ours, _ = forward(params, jnp.asarray(ids, jnp.int32), cfg, compute_dtype=jnp.float32)
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=2e-4)
+
+
+def test_hf_zero_aux_coef_respected():
+    """An explicit router_aux_loss_coef=0.0 in the HF config must survive
+    import (0.0 is 'aux disabled', not 'use the default')."""
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        router_aux_loss_coef=0.0,
+    )
+    assert from_hf_config(hf_cfg).router_aux_coef == 0.0
