@@ -132,9 +132,27 @@ def moe_mlp(lp, x, config: ModelConfig, compute_dtype, mesh=None, token_mask=Non
         "bsec,bsh->bech", dispatch.astype(compute_dtype), x
     )                                                          # [b, E, C, h]
     xin = to_experts(xin)
-    w1 = lp["experts"]["w1"].astype(compute_dtype)             # [E, h, f]
-    w3 = lp["experts"]["w3"].astype(compute_dtype)             # [E, h, f]
-    w2 = lp["experts"]["w2"].astype(compute_dtype)             # [E, f, h]
+
+    def expert_weight(name):
+        """[E, in, out], dequantizing the NF4 (QLoRA) form when present.
+        Under remat only one layer's dequantized experts are live at a time,
+        same as the dense QLoRA path."""
+        ex = lp["experts"]
+        if f"{name}_nf4" in ex:
+            from llm_fine_tune_distributed_tpu.ops.nf4 import (
+                QUANT_SUFFIXES,
+                dequantize_nf4_stacked,
+            )
+
+            q = {
+                s: ex[f"{name}_{s}"] for s in QUANT_SUFFIXES if f"{name}_{s}" in ex
+            }
+            return dequantize_nf4_stacked(q, dtype=compute_dtype)
+        return ex[name].astype(compute_dtype)
+
+    w1 = expert_weight("w1")                                   # [E, h, f]
+    w3 = expert_weight("w3")                                   # [E, h, f]
+    w2 = expert_weight("w2")                                   # [E, f, h]
     # named like the dense path's product so remat_policy="mlp"
     # (save_only_these_names("mlp_act")) works for MoE models too
     act = checkpoint_name(

@@ -279,3 +279,54 @@ def quantized_layout(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: 
     else:
         out["absmax"] = ((k // block_size, n), jnp.float32)
     return out
+
+
+# ---------------------------------------------------------------------------
+# stacked (MoE expert) weights [E, in, out]
+# ---------------------------------------------------------------------------
+
+
+def quantize_nf4_stacked(w, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
+    """NF4-quantize a stacked expert weight ``[E, in, out]`` (ops/moe.py
+    layout). Internally reshapes to ``[E*in, out]`` — with ``in`` a multiple
+    of ``block_size`` no absmax block crosses an expert boundary, so each
+    expert quantizes exactly as it would standalone. The packed codes and
+    absmax keep the leading expert dim (``nf4 [E, in/8, out]``) so the
+    expert-parallel sharding rules apply unchanged.
+    """
+    e, k, n = w.shape
+    q = quantize_nf4(w.reshape(e * k, n), block_size, double_quant)
+    q["nf4"] = jnp.asarray(q["nf4"]).reshape(e, k // 8, n)
+    for key in ("absmax", "absmax_q"):
+        if key in q:
+            q[key] = jnp.asarray(q[key]).reshape(e, k // block_size, n)
+    return q
+
+
+def dequantize_nf4_stacked(q: Dict, dtype=jnp.bfloat16):
+    """Inverse of ``quantize_nf4_stacked``: NF4 leaves -> ``[E, in, out]``."""
+    e, k8, n = q["nf4"].shape
+    flat = {"nf4": q["nf4"].reshape(e * k8, n)}
+    for key in ("absmax", "absmax_q"):
+        if key in q:
+            arr = q[key]
+            flat[key] = arr.reshape(e * arr.shape[1], n)
+    for key in ("absmax_scale", "absmax_offset"):
+        if key in q:
+            flat[key] = q[key]
+    return dequantize_nf4(flat, dtype=dtype).reshape(e, k8 * 8, n)
+
+
+def quantized_layout_stacked(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
+    """``quantized_layout`` for a stacked ``[E, in, out]`` expert weight."""
+    e, k, n = shape
+    flat = quantized_layout((e * k, n), block_size, double_quant)
+    out = {"nf4": ((e, k // 8, n), jnp.int32)}
+    for key in ("absmax", "absmax_q"):
+        if key in flat:
+            (shape2, dtype) = flat[key]
+            out[key] = ((e, k // block_size, n), dtype)
+    for key in ("absmax_scale", "absmax_offset"):
+        if key in flat:
+            out[key] = flat[key]
+    return out

@@ -83,6 +83,16 @@ def build_optimizer(
         # Lion's published/optax defaults (b1=0.9, b2=0.99) — deliberately
         # NOT config.adam_b1/b2: those tune the adamw baseline, and Lion's
         # momentum horizon is a different animal (b2=0.999 would ~10x it).
+        # Be loud if the user tuned adam betas expecting them to apply here.
+        if (config.adam_b1, config.adam_b2) != (0.9, 0.999):
+            import warnings
+
+            warnings.warn(
+                "optimizer='lion' ignores adam_b1/adam_b2 "
+                f"({config.adam_b1}/{config.adam_b2}) and uses Lion's own "
+                "defaults (0.9/0.99)",
+                stacklevel=2,
+            )
         core = optax.lion(
             learning_rate=schedule,
             weight_decay=config.weight_decay,

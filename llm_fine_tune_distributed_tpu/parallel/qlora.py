@@ -27,18 +27,35 @@ from llm_fine_tune_distributed_tpu.ops.nf4 import (
     DEFAULT_BLOCK_SIZE,
     DEQUANT_MARKERS,
     dequantize_nf4,
+    dequantize_nf4_stacked,
     quantize_nf4,
+    quantize_nf4_stacked,
     quantized_layout,
+    quantized_layout_stacked,
 )
+
+# leaf names that quantize: dense block linears + stacked MoE expert weights
+_EXPERT_LEAVES = ("w1", "w2", "w3")
 
 
 def _is_quantizable(path: str, leaf) -> bool:
-    return (
-        path.endswith("/kernel")
-        and "/layers/" in path
-        and getattr(leaf, "ndim", 0) == 2
-        and leaf.shape[0] % 8 == 0
-    )
+    if "/layers/" not in path:
+        return False
+    if path.endswith("block_sparse_moe/gate/kernel"):
+        # the MoE router gate is tiny ([h, E] — ~0.01% of expert bytes) and
+        # NF4 rounding would perturb every routing decision: keep it exact
+        return False
+    if path.endswith("/kernel"):
+        return getattr(leaf, "ndim", 0) == 2 and leaf.shape[0] % 8 == 0
+    if path.endswith(tuple(f"/experts/{w}" for w in _EXPERT_LEAVES)):
+        # stacked [E, in, out]: packs along the per-expert in dim
+        return getattr(leaf, "ndim", 0) == 3 and leaf.shape[1] % 8 == 0
+    return False
+
+
+def _quant_in_dim(leaf) -> int:
+    """The dim the block grid runs along (per-expert in dim for 3-D)."""
+    return leaf.shape[1] if getattr(leaf, "ndim", 0) == 3 else leaf.shape[0]
 
 
 def quantize_frozen(
@@ -53,12 +70,15 @@ def quantize_frozen(
     """
     out: Dict[str, np.ndarray] = {}
     for path, leaf in frozen.items():
-        if not _is_quantizable(path, leaf) or leaf.shape[0] % block_size:
+        if not _is_quantizable(path, leaf) or _quant_in_dim(leaf) % block_size:
             out[path] = leaf
             continue
         # pass the leaf as-is: on-device arrays quantize on the accelerator
         # (ops/nf4._quantize_codes_jax) with no host round-trip
-        q = quantize_nf4(leaf, block_size, double_quant)
+        if getattr(leaf, "ndim", 0) == 3:
+            q = quantize_nf4_stacked(leaf, block_size, double_quant)
+        else:
+            q = quantize_nf4(leaf, block_size, double_quant)
         for suffix, arr in q.items():
             out[f"{path}_{suffix}"] = jnp.asarray(arr)
     return out
@@ -72,16 +92,20 @@ def dequantize_frozen(frozen: Dict, dtype=jnp.bfloat16) -> Dict:
     """
     out: Dict = {}
     groups: Dict[str, Dict] = {}
+    quant_bases = ("kernel",) + _EXPERT_LEAVES
     for path, leaf in frozen.items():
         for marker in DEQUANT_MARKERS:
-            if path.endswith(f"kernel{marker}"):
+            if path.endswith(tuple(f"{b}{marker}" for b in quant_bases)):
                 base = path[: -len(marker)]
                 groups.setdefault(base, {})[marker[1:]] = leaf
                 break
         else:
             out[path] = leaf
     for base, q in groups.items():
-        out[base] = dequantize_nf4(q, dtype=dtype)
+        if getattr(q["nf4"], "ndim", 2) == 3:  # stacked expert weight
+            out[base] = dequantize_nf4_stacked(q, dtype=dtype)
+        else:
+            out[base] = dequantize_nf4(q, dtype=dtype)
     return out
 
 
@@ -99,10 +123,13 @@ def quantize_frozen_abstract(
     """
     out: Dict = {}
     for path, leaf in frozen.items():
-        if not _is_quantizable(path, leaf) or leaf.shape[0] % block_size:
+        if not _is_quantizable(path, leaf) or _quant_in_dim(leaf) % block_size:
             out[path] = leaf
             continue
-        for suffix, (shape, dtype) in quantized_layout(
+        layout_fn = (
+            quantized_layout_stacked if getattr(leaf, "ndim", 0) == 3 else quantized_layout
+        )
+        for suffix, (shape, dtype) in layout_fn(
             leaf.shape, block_size, double_quant
         ).items():
             out[f"{path}_{suffix}"] = jax.ShapeDtypeStruct(shape, dtype)
@@ -115,6 +142,10 @@ def quantized_fraction(frozen: Dict) -> float:
     for path, leaf in frozen.items():
         nbytes = getattr(leaf, "nbytes", 0)
         total += nbytes
-        if "kernel_nf4" in path or "kernel_absmax" in path:
+        tail = path.rsplit("/", 1)[-1]
+        if any(
+            tail.startswith(f"{b}_nf4") or tail.startswith(f"{b}_absmax")
+            for b in ("kernel",) + _EXPERT_LEAVES
+        ):
             q_bytes += nbytes
     return q_bytes / total if total else 0.0

@@ -53,8 +53,13 @@ _MATRIX_RULES = [
     # MoE (ops/moe.py): stacked expert weights shard the expert dim over the
     # "expert" axis (expert parallelism) plus the usual fsdp/tensor dims;
     # the router gate [h, E] is tiny — fsdp on the input dim only.
-    (re.compile(r".*block_sparse_moe/experts/(w1|w3)$"), ("expert", "fsdp", "tensor")),
-    (re.compile(r".*block_sparse_moe/experts/w2$"), ("expert", "tensor", "fsdp")),
+    # NF4-quantized experts ([E, in/8, out] packed + [E, in/block, out]
+    # absmax) keep the same orientation; _validate_spec drops any dim the
+    # packed shapes no longer divide.
+    (re.compile(r".*block_sparse_moe/experts/(w1|w3)(_nf4|_absmax|_absmax_q)?$"),
+     ("expert", "fsdp", "tensor")),
+    (re.compile(r".*block_sparse_moe/experts/w2(_nf4|_absmax|_absmax_q)?$"),
+     ("expert", "tensor", "fsdp")),
     (re.compile(r".*block_sparse_moe/gate/kernel$"), ("fsdp", None)),
 ]
 

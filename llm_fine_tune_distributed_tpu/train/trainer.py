@@ -245,19 +245,10 @@ class SFTTrainer:
         # frozen params carry no optimizer state and need no f32 master.
         trainable = {k: jnp.asarray(v, param_dtype) for k, v in trainable.items()}
         if cfg.freeze_strategy == "qlora":
-            if self.model_config.num_experts > 0:
-                # the NF4 quantizer covers 2-D block linears only; stacked
-                # [E, h, f] expert leaves would silently stay bf16 — i.e.
-                # ~96% of a Mixtral's params get NO memory win while the
-                # router gate gets perturbed. Reject until expert
-                # quantization exists.
-                raise NotImplementedError(
-                    "QLoRA on MoE models is not supported yet (stacked "
-                    "expert weights are not NF4-quantized); use LoRA or "
-                    "last_n_and_head freezing for MoE presets"
-                )
             # NF4-quantize the frozen block linears (from full precision —
             # quantizing an already-bf16 cast would double the rounding).
+            # MoE models included: stacked [E, h, f] expert weights quantize
+            # per-expert (ops/nf4.quantize_nf4_stacked).
             from llm_fine_tune_distributed_tpu.parallel.qlora import (
                 quantize_frozen,
                 quantized_fraction,
