@@ -295,6 +295,10 @@ def quantize_nf4_stacked(w, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: 
     expert-parallel sharding rules apply unchanged.
     """
     e, k, n = w.shape
+    if k % 8:
+        raise ValueError(f"per-expert in-dim {k} not divisible by the pack factor 8")
+    if k % block_size:
+        raise ValueError(f"per-expert in-dim {k} not divisible by block_size {block_size}")
     q = quantize_nf4(w.reshape(e * k, n), block_size, double_quant)
     q["nf4"] = jnp.asarray(q["nf4"]).reshape(e, k // 8, n)
     for key in ("absmax", "absmax_q"):
@@ -318,8 +322,16 @@ def dequantize_nf4_stacked(q: Dict, dtype=jnp.bfloat16):
 
 
 def quantized_layout_stacked(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
-    """``quantized_layout`` for a stacked ``[E, in, out]`` expert weight."""
+    """``quantized_layout`` for a stacked ``[E, in, out]`` expert weight.
+
+    Rejects exactly the shapes ``quantize_nf4_stacked`` rejects (the
+    PER-EXPERT in-dim must divide the pack factor and block size — the
+    flattened e*in passing those checks is not sufficient)."""
     e, k, n = shape
+    if k % 8:
+        raise ValueError(f"per-expert in-dim {k} not divisible by the pack factor 8")
+    if k % block_size:
+        raise ValueError(f"per-expert in-dim {k} not divisible by block_size {block_size}")
     flat = quantized_layout((e * k, n), block_size, double_quant)
     out = {"nf4": ((e, k // 8, n), jnp.int32)}
     for key in ("absmax", "absmax_q"):

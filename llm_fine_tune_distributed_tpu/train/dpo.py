@@ -93,9 +93,13 @@ def make_dpo_loss_fn(
     quant_impl = quant_impl or train_config.quant_matmul_impl
     beta = train_config.dpo_beta
     eps = train_config.dpo_label_smoothing
+    # MoE: the POLICY forward contributes the router load-balancing loss to
+    # the train objective (layer-mean scale, same as SFT); the reference
+    # model is stop-gradient so its routers need no balancing pressure.
+    want_moe_aux = model_config.num_experts > 0
 
-    def batch_logprobs(params, input_ids, attention_mask, loss_mask):
-        hidden, _ = forward(
+    def batch_logprobs(params, input_ids, attention_mask, loss_mask, with_aux=False):
+        result = forward(
             params,
             input_ids,
             model_config,
@@ -107,11 +111,14 @@ def make_dpo_loss_fn(
             activation_sharding=activation_sharding,
             output_hidden=True,
             quant_impl=quant_impl,
+            return_aux=with_aux,
         )
+        hidden = result[0]
         per_token = _target_logprobs(
             params, hidden[:, :-1], input_ids[:, 1:], model_config, chunk, compute_dtype
         )
-        return masked_sequence_logprob(per_token, loss_mask)
+        lp = masked_sequence_logprob(per_token, loss_mask)
+        return (lp, result[2]) if with_aux else lp
 
     def loss_fn(trainable, ref_trainable, frozen, batch):
         # one [2B, S] forward per model: rows 0..B-1 chosen, B..2B-1 rejected
@@ -122,7 +129,12 @@ def make_dpo_loss_fn(
         mask = jnp.concatenate([batch["chosen_loss_mask"], batch["rejected_loss_mask"]])
         b = batch["chosen_input_ids"].shape[0]
 
-        policy_lp = batch_logprobs(merge_flat(trainable, frozen), ids, attn, mask)
+        if want_moe_aux:
+            policy_lp, moe_aux = batch_logprobs(
+                merge_flat(trainable, frozen), ids, attn, mask, with_aux=True
+            )
+        else:
+            policy_lp = batch_logprobs(merge_flat(trainable, frozen), ids, attn, mask)
         ref_params = merge_flat(
             {k: jax.lax.stop_gradient(v) for k, v in ref_trainable.items()}, frozen
         )
@@ -144,10 +156,14 @@ def make_dpo_loss_fn(
             "rewards_margin": (rewards_chosen - rewards_rejected).mean(),
             "rewards_accuracy": (rewards_chosen > rewards_rejected).mean(),
             # per-pair vectors for exact (pad-aware) eval aggregation
+            # (pure DPO loss — the router aux joins only the train scalar)
             "per_pair_loss": per_pair_loss,
             "per_pair_correct": (rewards_chosen > rewards_rejected).astype(jnp.float32),
         }
-        return per_pair_loss.mean(), aux
+        loss = per_pair_loss.mean()
+        if want_moe_aux:
+            loss = loss + model_config.router_aux_coef * moe_aux / model_config.num_layers
+        return loss, aux
 
     return loss_fn
 
@@ -240,22 +256,6 @@ class DPOTrainer(SFTTrainer):
     from the same base weights.
     """
 
-    def __init__(self, config, model_config=None, **kwargs):
-        from llm_fine_tune_distributed_tpu.models.configs import get_preset
-
-        mc = model_config or get_preset(config.model_preset)
-        if mc.num_experts > 0:
-            # batch_logprobs does not plumb the router aux loss, so DPO on an
-            # MoE model would train the router with no load-balancing
-            # pressure (silent routing collapse). Reject loudly, like
-            # pipeline_forward does — before the base init does any heavy
-            # lifting (tokenizer/mesh/model setup).
-            raise NotImplementedError(
-                "DPO on MoE models is not supported yet (the DPO objective "
-                "does not include the router load-balancing loss); use the "
-                "SFT objective for MoE presets"
-            )
-        super().__init__(config, model_config=model_config, **kwargs)
 
     # ------------------------------------------------------------------ data
 
