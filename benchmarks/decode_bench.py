@@ -43,7 +43,7 @@ def main():
     )
     max_new = int(os.environ.get("DECODE_NEW", "128" if on_accelerator else "16"))
     prompt_len = int(os.environ.get("DECODE_PROMPT", "64"))
-    variants = os.environ.get("DECODE_VARIANTS", "bf16,nf4").split(",")
+    variants = os.environ.get("DECODE_VARIANTS", "bf16,int8,nf4").split(",")
 
     mc = get_preset(preset)
     tok = load_tokenizer("byte-chatml")
@@ -75,27 +75,47 @@ def main():
         }))
         return tps
 
+    # Measure one variant at a time, freeing each quantized copy before the
+    # next is built — three resident 3B copies would exceed 16GB HBM.
+    import gc
+
     results = {}
     if "bf16" in variants:
         results["bf16"] = measure(params_bf16, "bf16")
+    if "int8" in variants:
+        from llm_fine_tune_distributed_tpu.ops.int8 import quantize_params_int8
+
+        # weight-only int8: half the HBM weight stream, dequant fused into
+        # the matmul read (ops/int8.py) — the decode-side sweet spot
+        params_int8 = quantize_params_int8(params_bf16)
+        results["int8"] = measure(params_int8, "int8")
+        del params_int8
+        gc.collect()
     if "nf4" in variants:
         # leaves passed as-is: quantize_frozen's large-leaf path quantizes
         # on-device, so no host round-trip of the full weight set
         qflat = quantize_frozen(dict(flatten_dict(params_bf16)))
-        # non-quantized leaves back to bf16 compute dtype
+        # non-quantized leaves back to bf16 compute dtype (no-op copies for
+        # already-bf16 leaves, so embeddings/norms stay SHARED with
+        # params_bf16 — which can then be dropped before the measure)
         qflat = {
             k: (jnp.asarray(v, jnp.bfloat16)
                 if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) and "absmax" not in k
                 else jnp.asarray(v))
             for k, v in qflat.items()
         }
+        del params_bf16
+        gc.collect()
         results["nf4"] = measure(unflatten_dict(qflat), "nf4")
-    if len(results) == 2:
-        print(json.dumps({
-            "metric": "decode_nf4_speedup_vs_bf16",
-            "value": round(results["nf4"] / results["bf16"], 3),
-            "unit": "x",
-        }))
+    if "bf16" in results:
+        for name, tps in results.items():
+            if name == "bf16":
+                continue
+            print(json.dumps({
+                "metric": f"decode_{name}_speedup_vs_bf16",
+                "value": round(tps / results["bf16"], 3),
+                "unit": "x",
+            }))
 
 
 if __name__ == "__main__":

@@ -35,6 +35,13 @@ def run_ask_cli(
     parser.add_argument("--repetition-penalty", type=float, default=1.1)
     parser.add_argument("--greedy", action="store_true", help="disable sampling")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--quantize",
+        choices=["none", "int8"],
+        default="none",
+        help="weight-only inference quantization: int8 halves the HBM weight "
+        "stream that bounds batch-1 decode (ops/int8.py)",
+    )
     args = parser.parse_args(argv)
     question = " ".join(args.question)
 
@@ -55,6 +62,11 @@ def run_ask_cli(
 
     print(f"Loading model from {args.model_dir} ...")
     params, model_config = load_model_dir(args.model_dir)
+    if args.quantize == "int8":
+        from llm_fine_tune_distributed_tpu.ops.int8 import quantize_params_int8
+
+        print("Quantizing block linears to int8 (weight-only) ...")
+        params = quantize_params_int8(params)
     tokenizer = load_tokenizer_dir(args.model_dir)
     generator = Generator(params, model_config, tokenizer)
 

@@ -119,8 +119,18 @@ def _linear(x, p, compute_dtype, quant_impl: str = "auto"):
     NF4-quantized kernels (QLoRA frozen base, ops/nf4.py) replace ``kernel``
     with sibling leaves ``kernel_nf4`` (+ absmax scales); the matmul then
     runs through the fused Pallas decode kernel or the XLA dequant path.
+    Int8 weight-only kernels (inference, ops/int8.py) replace it with
+    ``kernel_int8`` + ``kernel_int8_scale``.
     """
-    if "kernel_nf4" in p:
+    if "kernel_int8" in p:
+        from llm_fine_tune_distributed_tpu.ops.int8 import int8_matmul
+
+        y = int8_matmul(
+            x,
+            {"int8": p["kernel_int8"], "int8_scale": p["kernel_int8_scale"]},
+            compute_dtype=compute_dtype,
+        )
+    elif "kernel_nf4" in p:
         from llm_fine_tune_distributed_tpu.ops.nf4 import QUANT_SUFFIXES, nf4_matmul
 
         q = {s: p[f"kernel_{s}"] for s in QUANT_SUFFIXES if f"kernel_{s}" in p}

@@ -209,12 +209,14 @@ def nf4_matmul(x, q: Dict, impl: str = "auto", compute_dtype=jnp.bfloat16):
 
     Measured on a v5e chip: at training shapes (M=8192, K=N=2048) the fused
     kernel re-decodes the weight tile once per M-tile and lands ~1.8x slower
-    than XLA dequant; at batch-1 3B decode (benchmarks/decode_bench.py) it
-    reaches 20 tokens/sec vs 73 for plain bf16 — the VPU shift/mask/select
-    decode, not HBM bandwidth, is the bottleneck on this chip. NF4's value
-    here is MEMORY (4.5 bits/param at rest, one layer decoded at a time
-    under remat/liveness), not speed, so "auto" resolves to the XLA path
-    everywhere until a faster decode (e.g. MXU one-hot lookup) lands.
+    than XLA dequant; at batch-1 3B decode (benchmarks/decode_bench.py) the
+    NF4 path reaches ~35 tokens/sec vs ~101 for plain bf16 (and ~154 for
+    int8 weight-only, ops/int8.py) — the shift/mask/select nibble decode,
+    not HBM bandwidth, is the bottleneck on this chip. NF4's value here is
+    MEMORY (4.5 bits/param at rest, one layer decoded at a time under
+    remat/liveness), not speed, so "auto" resolves to the XLA path
+    everywhere until a faster decode (e.g. MXU one-hot lookup) lands; for
+    decode SPEED use int8.
     """
     if impl == "auto":
         impl = "xla"
@@ -286,6 +288,15 @@ def quantized_layout(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: 
 # ---------------------------------------------------------------------------
 
 
+def _validate_stacked_in_dim(k: int, block_size: int) -> None:
+    """Shared by quantize_nf4_stacked and quantized_layout_stacked so the
+    abstract layout rejects exactly the shapes the real quantizer rejects."""
+    if k % 8:
+        raise ValueError(f"per-expert in-dim {k} not divisible by the pack factor 8")
+    if k % block_size:
+        raise ValueError(f"per-expert in-dim {k} not divisible by block_size {block_size}")
+
+
 def quantize_nf4_stacked(w, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
     """NF4-quantize a stacked expert weight ``[E, in, out]`` (ops/moe.py
     layout). Internally reshapes to ``[E*in, out]`` — with ``in`` a multiple
@@ -295,10 +306,7 @@ def quantize_nf4_stacked(w, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: 
     expert-parallel sharding rules apply unchanged.
     """
     e, k, n = w.shape
-    if k % 8:
-        raise ValueError(f"per-expert in-dim {k} not divisible by the pack factor 8")
-    if k % block_size:
-        raise ValueError(f"per-expert in-dim {k} not divisible by block_size {block_size}")
+    _validate_stacked_in_dim(k, block_size)
     q = quantize_nf4(w.reshape(e * k, n), block_size, double_quant)
     q["nf4"] = jnp.asarray(q["nf4"]).reshape(e, k // 8, n)
     for key in ("absmax", "absmax_q"):
@@ -328,10 +336,7 @@ def quantized_layout_stacked(shape, block_size: int = DEFAULT_BLOCK_SIZE, double
     PER-EXPERT in-dim must divide the pack factor and block size — the
     flattened e*in passing those checks is not sufficient)."""
     e, k, n = shape
-    if k % 8:
-        raise ValueError(f"per-expert in-dim {k} not divisible by the pack factor 8")
-    if k % block_size:
-        raise ValueError(f"per-expert in-dim {k} not divisible by block_size {block_size}")
+    _validate_stacked_in_dim(k, block_size)
     flat = quantized_layout((e * k, n), block_size, double_quant)
     out = {"nf4": ((e, k // 8, n), jnp.int32)}
     for key in ("absmax", "absmax_q"):

@@ -763,6 +763,11 @@ class SFTTrainer:
                     "mlp_bias": mc.mlp_bias,
                     "no_rope_layers": list(mc.no_rope_layers),
                     "sliding_window": mc.sliding_window,
+                    # MoE round trip (HF MixtralConfig naming — consumed by
+                    # models/configs.from_hf_config at inference load time)
+                    "num_local_experts": mc.num_experts,
+                    "num_experts_per_tok": mc.num_experts_per_tok,
+                    "router_aux_loss_coef": mc.router_aux_coef,
                 },
                 f,
                 indent=2,

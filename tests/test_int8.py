@@ -1,0 +1,106 @@
+"""Int8 weight-only inference quantization (ops/int8.py): round-trip error
+bounds, matmul parity, selective param conversion, and generation through
+the quantized model."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import forward, init_params
+from llm_fine_tune_distributed_tpu.ops.int8 import (
+    dequantize_int8,
+    int8_matmul,
+    quantize_int8,
+    quantize_params_int8,
+)
+from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+
+def test_roundtrip_error_bounded():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(64, 32).astype(np.float32))
+    q = quantize_int8(w)
+    assert q["int8"].dtype == jnp.int8 and q["int8"].shape == (64, 32)
+    assert q["int8_scale"].shape == (32,)
+    back = np.asarray(dequantize_int8(q, dtype=jnp.float32))
+    # symmetric per-channel: error <= scale/2 per element
+    bound = np.asarray(q["int8_scale"])[None, :] / 2 + 1e-7
+    assert np.all(np.abs(back - np.asarray(w)) <= bound)
+
+
+def test_matmul_matches_dequant():
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.randn(32, 16).astype(np.float32))
+    x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+    q = quantize_int8(w)
+    ref = x @ dequantize_int8(q, dtype=jnp.float32)
+    out = int8_matmul(x, q, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_quantize_params_selective():
+    """Block linears convert; embeddings, norms, and lm_head stay exact."""
+    config = get_preset("tiny_mistral")  # untied -> has lm_head
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    qp = flatten_dict(quantize_params_int8(params))
+    assert "model/layers/0/self_attn/q_proj/kernel_int8" in qp
+    assert "model/layers/0/self_attn/q_proj/kernel" not in qp
+    assert "model/embed_tokens/weight" in qp  # full precision, untouched
+    assert "model/embed_tokens/weight_int8" not in qp
+    assert "model/layers/0/input_layernorm/weight" in qp  # 1-D untouched
+    assert "lm_head/kernel" in qp  # full precision
+
+
+def test_forward_close_to_full_precision():
+    """Logits through the int8 model stay close to full precision — close
+    enough that greedy decode rarely flips (tolerance, not bit-parity)."""
+    config = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 16)), jnp.int32)
+    ref, _ = forward(params, ids, config, compute_dtype=jnp.float32)
+    out, _ = forward(
+        quantize_params_int8(params), ids, config, compute_dtype=jnp.float32
+    )
+    err = np.abs(np.asarray(out) - np.asarray(ref)).max()
+    assert err < 0.15, f"int8 logit drift {err} too large"
+
+
+def test_generate_through_int8():
+    from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+    from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
+
+    config = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    gen = Generator(
+        quantize_params_int8(params),
+        config,
+        ByteChatMLTokenizer(),
+        compute_dtype=jnp.float32,
+        eos_token_ids=[],
+    )
+    out = gen.generate_ids(
+        ByteChatMLTokenizer().encode("hello"),
+        GenerationConfig(max_new_tokens=5, do_sample=False),
+    )
+    assert len(out) == 5 and all(0 <= t < 512 for t in out)
+
+
+def test_moe_int8_quantizes_attention_only_and_runs():
+    """On MoE models the default predicate quantizes the attention linears
+    but leaves stacked experts AND the router gate exact — and the quantized
+    model must actually execute (the gate is read directly by ops/moe.py, so
+    quantizing it would crash the forward)."""
+    config = get_preset("tiny_moe")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    qparams = quantize_params_int8(params)
+    qp = flatten_dict(qparams)
+    assert "model/layers/0/block_sparse_moe/experts/w1" in qp  # untouched
+    assert "model/layers/0/block_sparse_moe/gate/kernel" in qp  # exact router
+    assert "model/layers/0/self_attn/q_proj/kernel_int8" in qp
+
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 16)), jnp.int32)
+    ref, _ = forward(params, ids, config, compute_dtype=jnp.float32)
+    out, _ = forward(qparams, ids, config, compute_dtype=jnp.float32)
+    assert np.abs(np.asarray(out) - np.asarray(ref)).max() < 0.15
