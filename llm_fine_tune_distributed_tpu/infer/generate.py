@@ -52,8 +52,20 @@ class Generator:
 
     # ------------------------------------------------------------- jit build
 
-    def _build(self, prompt_bucket: int, gen: GenerationConfig):
-        """Compile one (prompt_bucket, generation-config) specialization."""
+    def _build_batch(self, batch: int, prompt_bucket: int, gen: GenerationConfig):
+        """Compile one (batch, prompt_bucket, generation-config)
+        specialization with per-row prompt lengths (ragged batches).
+
+        Right-padded prompts prefill the whole bucket; row *i*'s decoded
+        token *t* is written at cache slot ``len_i + t`` (vector ``cache_pos``
+        — progressively overwriting that row's pad slots), so the cache
+        slot == logical position invariant holds per row and un-overwritten
+        pad slots sit at positions > any query, hence always masked. Greedy
+        decode of a batched row is bit-identical to running that prompt
+        alone (the single-prompt path IS the batch-of-1 case); SAMPLED rows
+        draw from a batched RNG stream, so row i > 0 sees different (still
+        seeded/deterministic) noise than a solo run would.
+        """
         mc = self.config
         dtype = self.compute_dtype
         buf_len = prompt_bucket + gen.max_new_tokens
@@ -61,38 +73,27 @@ class Generator:
 
         def step_logits(params, token_ids, cache, cache_pos):
             hidden, cache = forward(
-                params,
-                token_ids,
-                mc,
-                cache=cache,
-                cache_pos=cache_pos,
-                compute_dtype=dtype,
-                output_hidden=True,
+                params, token_ids, mc, cache=cache, cache_pos=cache_pos,
+                compute_dtype=dtype, output_hidden=True,
             )
             logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype)
             return logits, cache
 
         @jax.jit
-        def run(params, prompt_ids, prompt_len, rng):
+        def run(params, prompt_ids, prompt_lens, rng):
             b, pb = prompt_ids.shape
             cache = init_cache(mc, b, buf_len, dtype=dtype)
 
-            # ---- prefill: all prompt positions in one pass
             hidden, cache = forward(
-                params,
-                prompt_ids,
-                mc,
-                cache=cache,
-                cache_pos=0,
-                compute_dtype=dtype,
-                output_hidden=True,
+                params, prompt_ids, mc, cache=cache, cache_pos=0,
+                compute_dtype=dtype, output_hidden=True,
             )
-            last_h = jax.lax.dynamic_index_in_dim(hidden, prompt_len - 1, axis=1)
-            logits0 = unembed(params, last_h[:, 0], mc, compute_dtype=dtype)
+            last_h = jnp.take_along_axis(
+                hidden, (prompt_lens - 1)[:, None, None], axis=1
+            )[:, 0]
+            logits0 = unembed(params, last_h, mc, compute_dtype=dtype)
 
-            # repetition-penalty memory: vocab-sized seen-set from the prompt
-            # (pad slots aliased onto the first real token so they add nothing)
-            valid = jnp.arange(pb)[None, :] < prompt_len
+            valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
             safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
             seen = jnp.zeros((b, mc.vocab_size), bool).at[
                 jnp.arange(b)[:, None], safe_ids
@@ -112,11 +113,12 @@ class Generator:
             def body(c):
                 t, cache, out, seen, done, rng = c
                 last = jax.lax.dynamic_index_in_dim(out, t - 1, axis=1)
-                logits, cache = step_logits(params, last, cache, prompt_len + t - 1)
+                logits, cache = step_logits(
+                    params, last, cache, prompt_lens + (t - 1)
+                )
                 rng, sub = jax.random.split(rng)
                 nxt = sample_token(sub, logits, seen, gen)
                 hit_eos = jnp.isin(nxt, eos) if eos is not None else jnp.zeros((b,), bool)
-                # finished rows keep emitting eos/pad, excluded by n_generated
                 nxt = jnp.where(done, nxt * 0 + (eos[0] if eos is not None else 0), nxt)
                 out = out.at[:, t].set(nxt)
                 seen = seen.at[jnp.arange(b), nxt].set(True)
@@ -129,6 +131,46 @@ class Generator:
 
         return run
 
+    def generate_batch(
+        self,
+        prompts: Sequence[Sequence[int]],
+        gen: Optional[GenerationConfig] = None,
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Generate continuations for a ragged batch of prompts in ONE device
+        program — the weight stream (the batch-1 decode bottleneck) is read
+        once per step for the whole batch."""
+        gen = gen or GenerationConfig()
+        prompts = [list(p) for p in prompts]
+        if not prompts or any(not p for p in prompts):
+            raise ValueError("generate_batch needs >= 1 non-empty prompt")
+        longest = max(len(p) for p in prompts)
+        bucket = -(-longest // _PROMPT_BUCKET) * _PROMPT_BUCKET
+        key = ("batch", len(prompts), bucket, gen)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_batch(len(prompts), bucket, gen)
+        run = self._jit_cache[key]
+
+        padded = np.zeros((len(prompts), bucket), np.int32)
+        lens = np.zeros((len(prompts),), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+            lens[i] = len(p)
+        out, _ = run(
+            self.params, jnp.asarray(padded), jnp.asarray(lens),
+            jax.random.PRNGKey(seed),
+        )
+        out = np.asarray(out)
+        results: List[List[int]] = []
+        for row in out:
+            toks = row.tolist()
+            for i, tok in enumerate(toks):
+                if tok in self.eos_token_ids:
+                    toks = toks[:i]
+                    break
+            results.append(toks)
+        return results
+
     # -------------------------------------------------------------- generate
 
     def generate_ids(
@@ -137,31 +179,8 @@ class Generator:
         gen: Optional[GenerationConfig] = None,
         seed: int = 0,
     ) -> List[int]:
-        """Generate continuation token ids for one prompt (batch 1)."""
-        gen = gen or GenerationConfig()
-        prompt_ids = list(prompt_ids)
-        if not prompt_ids:
-            raise ValueError("empty prompt")
-        bucket = -(-len(prompt_ids) // _PROMPT_BUCKET) * _PROMPT_BUCKET
-        key = (bucket, gen)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = self._build(bucket, gen)
-        run = self._jit_cache[key]
-
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(prompt_ids)] = prompt_ids
-        out, n = run(
-            self.params,
-            jnp.asarray(padded),
-            jnp.int32(len(prompt_ids)),
-            jax.random.PRNGKey(seed),
-        )
-        tokens = np.asarray(out)[0, : int(n)].tolist()
-        # trim everything from the first stop token on
-        for i, tok in enumerate(tokens):
-            if tok in self.eos_token_ids:
-                return tokens[:i]
-        return tokens
+        """Generate continuation token ids for one prompt (= batch of 1)."""
+        return self.generate_batch([prompt_ids], gen, seed)[0]
 
     def chat(
         self,

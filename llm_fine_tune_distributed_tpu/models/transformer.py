@@ -195,8 +195,21 @@ def _block(
     new_entry = None
     if cache_entry is not None:
         # Decode/prefill with a fixed-size KV buffer: write k,v at cache_pos.
-        ck = jax.lax.dynamic_update_slice(cache_entry["k"], k.astype(cache_entry["k"].dtype), (0, cache_pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cache_entry["v"], v.astype(cache_entry["v"].dtype), (0, cache_pos, 0, 0))
+        # A scalar cache_pos writes the same slots for every row (single
+        # prompt / aligned batch); a [batch] vector writes per-row slots —
+        # ragged batched decode, where row i's token t lives at slot
+        # len_i + t so the slot == position invariant holds per row.
+        if getattr(cache_pos, "ndim", 0) == 1:
+            slots = cache_pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
+            ck = cache_entry["k"].at[jnp.arange(b)[:, None], slots].set(
+                k.astype(cache_entry["k"].dtype)
+            )
+            cv = cache_entry["v"].at[jnp.arange(b)[:, None], slots].set(
+                v.astype(cache_entry["v"].dtype)
+            )
+        else:
+            ck = jax.lax.dynamic_update_slice(cache_entry["k"], k.astype(cache_entry["k"].dtype), (0, cache_pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cache_entry["v"], v.astype(cache_entry["v"].dtype), (0, cache_pos, 0, 0))
         new_entry = {"k": ck, "v": cv}
         k, v = ck, cv
 
@@ -283,7 +296,9 @@ def forward(
       padding_mask: [batch, seq] 1=real token (training path).
       cache: optional KV cache dict (see ``init_cache``); when given,
         attention runs over the full cache buffer with a position mask.
-      cache_pos: scalar — where this chunk starts in the cache.
+      cache_pos: where this chunk starts in the cache — a scalar (all rows
+        aligned) or a [batch] vector for per-row starts (ragged batched
+        decode: row i's slots stay equal to its logical positions).
       remat: rematerialize each block on backward
         (analog of reference ``gradient_checkpointing=True``, training.py:280).
       output_hidden: return the final-norm hidden states [batch, seq, hidden]
@@ -305,8 +320,13 @@ def forward(
     """
     b, s = input_ids.shape
     if positions is None:
-        positions = jnp.arange(s, dtype=jnp.int32)[None, :] + cache_pos
-        positions = jnp.broadcast_to(positions, (b, s))
+        # scalar cache_pos broadcasts; a [batch] vector gives per-row offsets
+        # (ragged batched decode)
+        offset = (
+            cache_pos[:, None] if getattr(cache_pos, "ndim", 0) == 1 else cache_pos
+        )
+        positions = jnp.arange(s, dtype=jnp.int32)[None, :] + offset
+        positions = jnp.broadcast_to(positions, (b, s)).astype(jnp.int32)
 
     def constrain(h):
         if activation_sharding is not None:

@@ -55,6 +55,25 @@ def int8_matmul(x, q: Dict, compute_dtype=jnp.bfloat16):
     return x.astype(compute_dtype) @ w
 
 
+def quantize_int8_stacked(w) -> Dict[str, jax.Array]:
+    """Stacked expert weight ``[E, in, out]`` -> int8 codes + per-(expert,
+    channel) scales ``[E, out]`` (each expert quantized independently)."""
+    w = jnp.asarray(w)
+    if w.ndim != 3:
+        raise ValueError(f"quantize_int8_stacked expects [E, in, out], got {w.shape}")
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1)  # [E, out]
+    scale = jnp.where(absmax == 0.0, 1.0, absmax) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[:, None, :]), -127, 127)
+    return {"int8": q.astype(jnp.int8), "int8_scale": scale.astype(jnp.float32)}
+
+
+def dequantize_int8_stacked(q: Dict, dtype=jnp.bfloat16):
+    """Inverse: [E, in, out] in ``dtype``."""
+    return (
+        q["int8"].astype(jnp.float32) * q["int8_scale"][:, None, :].astype(jnp.float32)
+    ).astype(dtype)
+
+
 def quantize_params_int8(params, predicate=None):
     """Replace every matching 2-D ``.../kernel`` leaf (transformer-block
     linears by default) with its int8 sibling leaves. Works on the nested
@@ -67,11 +86,13 @@ def quantize_params_int8(params, predicate=None):
     (parallel/qlora._is_quantizable): it is ~0.01% of the bytes and 8-bit
     rounding there would perturb every routing decision.
     """
+    def is_stacked_expert(path: str) -> bool:
+        return path.endswith(("/experts/w1", "/experts/w2", "/experts/w3"))
+
     if predicate is None:
-        predicate = lambda path: (
-            "/layers/" in path
-            and path.endswith("/kernel")
-            and not path.endswith("block_sparse_moe/gate/kernel")
+        predicate = lambda path: "/layers/" in path and (
+            (path.endswith("/kernel") and not path.endswith("block_sparse_moe/gate/kernel"))
+            or is_stacked_expert(path)
         )
 
     from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict, unflatten_dict
@@ -79,8 +100,14 @@ def quantize_params_int8(params, predicate=None):
     flat = flatten_dict(params)
     out = {}
     for path, leaf in flat.items():
-        if predicate(path) and getattr(leaf, "ndim", 0) == 2:
+        if not predicate(path):
+            out[path] = leaf
+        elif getattr(leaf, "ndim", 0) == 2 and path.endswith("/kernel"):
             q = quantize_int8(leaf)
+            for suffix in INT8_SUFFIXES:
+                out[f"{path}_{suffix}"] = q[suffix]
+        elif getattr(leaf, "ndim", 0) == 3 and is_stacked_expert(path):
+            q = quantize_int8_stacked(leaf)
             for suffix in INT8_SUFFIXES:
                 out[f"{path}_{suffix}"] = q[suffix]
         else:

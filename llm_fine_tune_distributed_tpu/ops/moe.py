@@ -134,10 +134,17 @@ def moe_mlp(lp, x, config: ModelConfig, compute_dtype, mesh=None, token_mask=Non
     xin = to_experts(xin)
 
     def expert_weight(name):
-        """[E, in, out], dequantizing the NF4 (QLoRA) form when present.
-        Under remat only one layer's dequantized experts are live at a time,
-        same as the dense QLoRA path."""
+        """[E, in, out], dequantizing the NF4 (QLoRA) or int8 (inference,
+        ops/int8.py) form when present. Under remat only one layer's
+        dequantized experts are live at a time, same as the dense paths."""
         ex = lp["experts"]
+        if f"{name}_int8" in ex:
+            from llm_fine_tune_distributed_tpu.ops.int8 import dequantize_int8_stacked
+
+            return dequantize_int8_stacked(
+                {"int8": ex[f"{name}_int8"], "int8_scale": ex[f"{name}_int8_scale"]},
+                dtype=compute_dtype,
+            )
         if f"{name}_nf4" in ex:
             from llm_fine_tune_distributed_tpu.ops.nf4 import (
                 QUANT_SUFFIXES,

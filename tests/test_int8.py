@@ -87,16 +87,19 @@ def test_generate_through_int8():
     assert len(out) == 5 and all(0 <= t < 512 for t in out)
 
 
-def test_moe_int8_quantizes_attention_only_and_runs():
-    """On MoE models the default predicate quantizes the attention linears
-    but leaves stacked experts AND the router gate exact — and the quantized
-    model must actually execute (the gate is read directly by ops/moe.py, so
-    quantizing it would crash the forward)."""
+def test_moe_int8_quantizes_experts_and_runs():
+    """On MoE models the attention linears AND the stacked experts quantize
+    (per-expert per-channel scales); the router gate stays exact (it is read
+    directly by ops/moe.py, and 8-bit rounding there would flip routing).
+    The quantized model must execute with bounded logit drift."""
     config = get_preset("tiny_moe")
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
     qparams = quantize_params_int8(params)
     qp = flatten_dict(qparams)
-    assert "model/layers/0/block_sparse_moe/experts/w1" in qp  # untouched
+    assert "model/layers/0/block_sparse_moe/experts/w1_int8" in qp
+    assert qp["model/layers/0/block_sparse_moe/experts/w1_int8"].shape == (4, 64, 128)
+    assert qp["model/layers/0/block_sparse_moe/experts/w1_int8_scale"].shape == (4, 128)
+    assert "model/layers/0/block_sparse_moe/experts/w1" not in qp
     assert "model/layers/0/block_sparse_moe/gate/kernel" in qp  # exact router
     assert "model/layers/0/self_attn/q_proj/kernel_int8" in qp
 
@@ -104,3 +107,17 @@ def test_moe_int8_quantizes_attention_only_and_runs():
     ref, _ = forward(params, ids, config, compute_dtype=jnp.float32)
     out, _ = forward(qparams, ids, config, compute_dtype=jnp.float32)
     assert np.abs(np.asarray(out) - np.asarray(ref)).max() < 0.15
+
+
+def test_stacked_int8_roundtrip():
+    from llm_fine_tune_distributed_tpu.ops.int8 import (
+        dequantize_int8_stacked,
+        quantize_int8_stacked,
+    )
+
+    rng = np.random.RandomState(2)
+    w = jnp.asarray(rng.randn(3, 16, 8).astype(np.float32))
+    q = quantize_int8_stacked(w)
+    back = np.asarray(dequantize_int8_stacked(q, dtype=jnp.float32))
+    bound = np.asarray(q["int8_scale"])[:, None, :] / 2 + 1e-7
+    assert np.all(np.abs(back - np.asarray(w)) <= bound)
