@@ -1,4 +1,4 @@
-"""Minimal HTTP serving for the tuned model.
+"""Minimal HTTP serving for the tuned model, with dynamic request batching.
 
 The reference has NO serving server — inference is CLI-only, and
 ``examples/openshift-deploy.yaml`` (C21) is an unrelated KServe template kept
@@ -10,7 +10,11 @@ closes that gap with a dependency-free stdlib server exposing:
         optional: "max_new_tokens", "temperature", "top_p", "top_k",
                   "repetition_penalty", "greedy", "seed", "system_prompt"}
 
-Single-threaded by design: one Generator owns the TPU; requests serialize.
+Handlers run on threads; a single worker (infer/batching.BatchingEngine)
+owns the TPU and groups concurrent same-config requests into one device
+batch (batch-1 decode is weight-bandwidth-bound, so a batch of B serves ~B
+requests for one request's HBM traffic). ``--max-batch 1`` restores strict
+serialization.
 Run: ``python -m llm_fine_tune_distributed_tpu.infer.server --model-dir ...``
 or ``ask_tuned_model.py --serve``.
 """
@@ -20,11 +24,17 @@ from __future__ import annotations
 import argparse
 import json
 import os
-from http.server import BaseHTTPRequestHandler, HTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
-def serve(model_dir: str, host: str = "0.0.0.0", port: int = 8080) -> None:
+def serve(
+    model_dir: str,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    max_batch: int = 8,
+    batch_window_ms: float = 10.0,
+) -> None:
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
         GenerationConfig,
@@ -33,11 +43,14 @@ def serve(model_dir: str, host: str = "0.0.0.0", port: int = 8080) -> None:
         load_tokenizer_dir,
     )
 
+    from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine
+
     print(f"Loading model from {model_dir} ...")
     params, model_config = load_model_dir(model_dir)
     tokenizer = load_tokenizer_dir(model_dir)
     generator = Generator(params, model_config, tokenizer)
-    print("Model ready.")
+    engine = BatchingEngine(generator, max_batch=max_batch, window_ms=batch_window_ms)
+    print(f"Model ready (max_batch={max_batch}).")
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict | str) -> None:
@@ -96,7 +109,13 @@ def serve(model_dir: str, host: str = "0.0.0.0", port: int = 8080) -> None:
                 {"role": "user", "content": question},
             ]
             try:
-                answer = generator.chat(messages, gen, seed=seed)
+                # tokenize/decode on the handler thread; only the device work
+                # goes through the batching engine's single worker
+                prompt_ids = tokenizer.apply_chat_template(
+                    messages, tokenize=True, add_generation_prompt=True
+                )
+                ids = engine.submit(prompt_ids, gen, seed=seed)
+                answer = tokenizer.decode(ids, skip_special_tokens=True).strip()
             except Exception as e:  # surface generation errors as 500s
                 self._send(500, {"error": str(e)})
                 return
@@ -105,7 +124,7 @@ def serve(model_dir: str, host: str = "0.0.0.0", port: int = 8080) -> None:
         def log_message(self, fmt, *args):
             print(f"[serve] {self.address_string()} {fmt % args}", flush=True)
 
-    httpd = HTTPServer((host, port), Handler)
+    httpd = ThreadingHTTPServer((host, port), Handler)
     print(f"Serving on {host}:{port}")
     try:
         httpd.serve_forever()
@@ -122,11 +141,19 @@ def main(argv: Optional[list] = None) -> int:
     )
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument(
+        "--max-batch", type=int, default=8,
+        help="max concurrent requests grouped into one device batch (1 = serialize)",
+    )
+    parser.add_argument(
+        "--batch-window-ms", type=float, default=10.0,
+        help="how long the batcher waits to fill a group",
+    )
     args = parser.parse_args(argv)
     if not os.path.isdir(args.model_dir):
         print(f"Error: model directory not found: {args.model_dir!r}")
         return 1
-    serve(args.model_dir, args.host, args.port)
+    serve(args.model_dir, args.host, args.port, args.max_batch, args.batch_window_ms)
     return 0
 
 

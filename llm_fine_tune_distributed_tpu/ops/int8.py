@@ -102,7 +102,7 @@ def quantize_params_int8(params, predicate=None):
     for path, leaf in flat.items():
         if not predicate(path):
             out[path] = leaf
-        elif getattr(leaf, "ndim", 0) == 2 and path.endswith("/kernel"):
+        elif getattr(leaf, "ndim", 0) == 2:
             q = quantize_int8(leaf)
             for suffix in INT8_SUFFIXES:
                 out[f"{path}_{suffix}"] = q[suffix]

@@ -1,0 +1,108 @@
+"""Dynamic request batching (infer/batching.py): concurrent same-config
+requests group into one device batch with unchanged (greedy) results;
+mixed-config traffic still resolves correctly."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
+from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine, _pad_batch_size
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+
+
+def _make_generator():
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    return Generator(
+        params, mc, ByteChatMLTokenizer(), compute_dtype=jnp.float32, eos_token_ids=[]
+    )
+
+
+def test_pad_batch_size():
+    assert [_pad_batch_size(n, 8) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 8]
+
+
+def test_concurrent_requests_match_solo():
+    gen = _make_generator()
+    tok = ByteChatMLTokenizer()
+    cfg = GenerationConfig(max_new_tokens=5, do_sample=False, repetition_penalty=1.0)
+    prompts = [tok.encode(t) for t in ("alpha", "beta bravo", "the quick brown fox")]
+    solo = [gen.generate_ids(p, cfg) for p in prompts]
+
+    engine = BatchingEngine(gen, max_batch=4, window_ms=200.0)
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = engine.submit(prompts[i], cfg)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert results == solo
+
+
+def test_mixed_configs_all_resolve():
+    gen = _make_generator()
+    tok = ByteChatMLTokenizer()
+    cfg_a = GenerationConfig(max_new_tokens=4, do_sample=False, repetition_penalty=1.0)
+    cfg_b = GenerationConfig(max_new_tokens=6, do_sample=False, repetition_penalty=1.0)
+    engine = BatchingEngine(gen, max_batch=4, window_ms=50.0)
+    prompts = [tok.encode("one"), tok.encode("two"), tok.encode("three")]
+    cfgs = [cfg_a, cfg_b, cfg_a]
+    results = [None] * 3
+
+    def worker(i):
+        results[i] = engine.submit(prompts[i], cfgs[i])
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    for i in range(3):
+        assert results[i] is not None
+        assert len(results[i]) == cfgs[i].max_new_tokens
+
+
+def test_generation_error_propagates_to_waiters():
+    class Boom:
+        def generate_batch(self, *a, **kw):
+            raise RuntimeError("boom")
+
+    engine = BatchingEngine(Boom(), max_batch=2, window_ms=5.0)
+    try:
+        engine.submit([1, 2, 3], GenerationConfig(max_new_tokens=2))
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError as e:
+        assert "boom" in str(e)
+
+
+def test_sampled_requests_keep_solo_seeding():
+    """Sampled requests never co-batch: each concurrent request reproduces
+    exactly what a solo run with its (config, seed) produces."""
+    gen = _make_generator()
+    tok = ByteChatMLTokenizer()
+    cfg = GenerationConfig(max_new_tokens=5, do_sample=True, temperature=1.0)
+    prompts = [tok.encode("alpha"), tok.encode("beta")]
+    seeds = [3, 7]
+    solo = [gen.generate_ids(p, cfg, seed=s) for p, s in zip(prompts, seeds)]
+
+    engine = BatchingEngine(gen, max_batch=4, window_ms=100.0)
+    results = [None, None]
+
+    def worker(i):
+        results[i] = engine.submit(prompts[i], cfg, seed=seeds[i])
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert results == solo

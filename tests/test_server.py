@@ -101,3 +101,33 @@ def test_unknown_path_404(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(f"{server}/nope", timeout=10)
     assert e.value.code == 404
+
+
+def test_concurrent_generate_batched(server):
+    """Several simultaneous identical-config requests all succeed and agree
+    (greedy + shared seed -> the batcher groups them; batched greedy rows
+    are bit-identical to solo decode)."""
+    def ask(q):
+        req = urllib.request.Request(
+            f"{server}/v1/generate",
+            data=json.dumps(
+                {"question": q, "max_new_tokens": 6, "greedy": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=180) as r:
+            return json.loads(r.read())["answer"]
+
+    questions = [f"question {i}?" for i in range(4)]
+    answers = [None] * 4
+    threads = [
+        threading.Thread(target=lambda i=i: answers.__setitem__(i, ask(questions[i])))
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=200)
+    assert all(isinstance(a, str) for a in answers), answers
+    # same question solo must give the same greedy answer
+    assert ask(questions[0]) == answers[0]
