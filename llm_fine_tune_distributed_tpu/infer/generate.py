@@ -182,6 +182,20 @@ class Generator:
         """Generate continuation token ids for one prompt (= batch of 1)."""
         return self.generate_batch([prompt_ids], gen, seed)[0]
 
+    def encode_chat(self, messages: List[dict], **template_kwargs) -> List[int]:
+        """ChatML conversation -> prompt token ids (generation prompt added).
+
+        Shared by ``chat`` and the serving path (infer/server.py submits the
+        ids through the batching engine) so prompt construction cannot
+        diverge between the CLI and the server."""
+        return self.tokenizer.apply_chat_template(
+            messages, tokenize=True, add_generation_prompt=True, **template_kwargs
+        )
+
+    def decode_reply(self, ids: Sequence[int]) -> str:
+        """Generated ids -> assistant reply text (shared with the server)."""
+        return self.tokenizer.decode(list(ids), skip_special_tokens=True).strip()
+
     def chat(
         self,
         messages: List[dict],
@@ -197,11 +211,8 @@ class Generator:
         here only the generated ids are decoded, which is the same extraction
         without the string fragility.
         """
-        prompt_ids = self.tokenizer.apply_chat_template(
-            messages, tokenize=True, add_generation_prompt=True, **template_kwargs
-        )
-        ids = self.generate_ids(prompt_ids, gen, seed)
-        return self.tokenizer.decode(ids, skip_special_tokens=True).strip()
+        ids = self.generate_ids(self.encode_chat(messages, **template_kwargs), gen, seed)
+        return self.decode_reply(ids)
 
 
 # ---------------------------------------------------------------------------

@@ -109,13 +109,12 @@ def serve(
                 {"role": "user", "content": question},
             ]
             try:
-                # tokenize/decode on the handler thread; only the device work
-                # goes through the batching engine's single worker
-                prompt_ids = tokenizer.apply_chat_template(
-                    messages, tokenize=True, add_generation_prompt=True
-                )
+                # tokenize/decode on the handler thread (Generator's shared
+                # chat helpers, so CLI and server cannot diverge); only the
+                # device work goes through the batching engine's worker
+                prompt_ids = generator.encode_chat(messages)
                 ids = engine.submit(prompt_ids, gen, seed=seed)
-                answer = tokenizer.decode(ids, skip_special_tokens=True).strip()
+                answer = generator.decode_reply(ids)
             except Exception as e:  # surface generation errors as 500s
                 self._send(500, {"error": str(e)})
                 return

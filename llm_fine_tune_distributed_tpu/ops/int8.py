@@ -102,7 +102,7 @@ def quantize_params_int8(params, predicate=None):
     for path, leaf in flat.items():
         if not predicate(path):
             out[path] = leaf
-        elif getattr(leaf, "ndim", 0) == 2:
+        elif getattr(leaf, "ndim", 0) == 2 and path.endswith("/kernel"):
             q = quantize_int8(leaf)
             for suffix in INT8_SUFFIXES:
                 out[f"{path}_{suffix}"] = q[suffix]
@@ -111,5 +111,11 @@ def quantize_params_int8(params, predicate=None):
             for suffix in INT8_SUFFIXES:
                 out[f"{path}_{suffix}"] = q[suffix]
         else:
-            out[path] = leaf
+            # a predicate hit with no int8 form (embedding, norm, odd shape)
+            # would produce orphaned leaves no consumer reads — be loud
+            raise ValueError(
+                f"predicate matched {path!r} (ndim="
+                f"{getattr(leaf, 'ndim', None)}) but only 2-D .../kernel "
+                "leaves and stacked 3-D expert weights have an int8 form"
+            )
     return unflatten_dict(out)

@@ -121,3 +121,12 @@ def test_stacked_int8_roundtrip():
     back = np.asarray(dequantize_int8_stacked(q, dtype=jnp.float32))
     bound = np.asarray(q["int8_scale"])[:, None, :] / 2 + 1e-7
     assert np.all(np.abs(back - np.asarray(w)) <= bound)
+
+
+def test_predicate_mismatch_is_loud():
+    import pytest
+
+    config = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="predicate matched"):
+        quantize_params_int8(params, predicate=lambda p: p.endswith("embed_tokens/weight"))

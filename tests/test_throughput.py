@@ -1,0 +1,29 @@
+"""ThroughputMeter: the steady-state window must exclude one-off pauses
+(jit compile) that dominate the cumulative rate on short runs."""
+
+import time
+
+from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter
+
+
+def test_steady_state_excludes_compile_pause():
+    m = ThroughputMeter(2, tokens_per_sample=10)
+    time.sleep(0.3)  # "compile" before the first step lands
+    m.update(4)
+    for _ in range(5):
+        time.sleep(0.02)
+        m.update(4)
+    s = m.snapshot()
+    assert "samples_per_second_per_chip_steady" in s
+    # cumulative is dragged down by the 0.3s pause; steady is not
+    assert s["samples_per_second_per_chip_steady"] > 2 * s["samples_per_second_per_chip"]
+    assert s["samples_per_second_per_chip"] > 0
+    assert s["tokens_per_second_per_chip"] > 0
+
+
+def test_no_steady_metric_before_enough_steps():
+    m = ThroughputMeter(1)
+    m.update(2)
+    assert "samples_per_second_per_chip_steady" not in m.snapshot()
+    m.update(2)
+    assert "samples_per_second_per_chip_steady" in m.snapshot()

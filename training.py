@@ -111,7 +111,9 @@ def main() -> int:
     if is_primary_host():
         print("\nDistributed Q&A fine-tuning completed successfully!")
         print(f"Training artifacts saved to {config.output_dir}/")
-        print(f"samples/sec/chip: {summary.get('samples_per_second_per_chip')}")
+        steady = summary.get("samples_per_second_per_chip_steady")
+        print(f"samples/sec/chip: {summary.get('samples_per_second_per_chip')}"
+              + (f" (steady-state: {steady})" if steady else ""))
     return 0
 
 
