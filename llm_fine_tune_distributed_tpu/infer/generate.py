@@ -49,6 +49,9 @@ class Generator:
             eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
         self.eos_token_ids = tuple(int(e) for e in eos)
         self._jit_cache = {}
+        # sequential-forward count of the last speculative run (telemetry;
+        # None when the last call took the plain batch path)
+        self.last_spec_steps: Optional[int] = None
 
     # ------------------------------------------------------------- jit build
 
@@ -131,6 +134,130 @@ class Generator:
 
         return run
 
+    def _build_spec(self, prompt_bucket: int, gen: GenerationConfig):
+        """Compile the prompt-lookup speculative greedy decoder (batch 1).
+
+        Each step feeds ``[cur, d_1..d_K]`` (K = ``gen.speculative_lookup``
+        drafts found by matching the newest bigram earlier in the context)
+        through ONE forward at cache slots ``pos-1 .. pos+K-1`` and accepts
+        the longest prefix of drafts that match the model's own greedy
+        choices. Algorithmically this IS plain greedy decode (bit-exact in
+        f32 — tests/test_generate.py); in bf16 the (K+1)-token verify can
+        resolve a near-tie differently than the 1-token step, so outputs may
+        diverge at tie points exactly as any chunked-verify speculative
+        decoder's do. Pays off when the OUTPUT repeats n-grams from the
+        context (extractive QA, code, summaries); on low-repetition text the
+        K+1-wide verify is pure overhead — hence opt-in, default off.
+        Rollback is free under the slot == position invariant: the next
+        step's writes start at the last accepted position, overwriting every
+        slot a rejected draft touched before any query can see it.
+        """
+        mc = self.config
+        dtype = self.compute_dtype
+        K = gen.speculative_lookup
+        max_new = gen.max_new_tokens
+        buf_len = prompt_bucket + max_new + K + 1
+        eos = jnp.asarray(self.eos_token_ids, jnp.int32) if self.eos_token_ids else None
+
+        @jax.jit
+        def run(params, prompt_ids, prompt_lens, rng):
+            del rng  # greedy
+            prompt_len = prompt_lens[0]
+            b, pb = prompt_ids.shape  # b == 1
+            cache = init_cache(mc, b, buf_len, dtype=dtype)
+
+            hidden, cache = forward(
+                params, prompt_ids, mc, cache=cache, cache_pos=0,
+                compute_dtype=dtype, output_hidden=True,
+            )
+            last_h = jnp.take_along_axis(
+                hidden, (prompt_len - 1)[None, None, None], axis=1
+            )[:, 0]
+            logits0 = unembed(params, last_h, mc, compute_dtype=dtype)
+
+            valid = jnp.arange(pb)[None, :] < prompt_len
+            safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
+            seen = jnp.zeros((b, mc.vocab_size), bool).at[
+                jnp.arange(b)[:, None], safe_ids
+            ].set(True)
+
+            # token history: prompt + generated, in logical positions
+            ids_buf = jnp.zeros((buf_len,), jnp.int32)
+            ids_buf = jax.lax.dynamic_update_slice(
+                ids_buf, jnp.where(valid, prompt_ids, 0)[0], (0,)
+            )
+
+            first = sample_token(None, logits0, seen, gen)[0]
+            ids_buf = ids_buf.at[prompt_len].set(first)
+            seen = seen.at[0, first].set(True)
+            done = jnp.isin(first, eos) if eos is not None else jnp.bool_(False)
+            n_gen = jnp.int32(1)
+
+            def body(c):
+                n_gen, cache, ids_buf, seen, done, n_steps = c
+                pos = prompt_len + n_gen  # position of the next token
+
+                # --- draft: most recent earlier occurrence of the newest bigram
+                last2 = jax.lax.dynamic_slice(ids_buf, (pos - 2,), (2,))
+                j = jnp.arange(buf_len - 1)
+                match = (
+                    (ids_buf[:-1] == last2[0])
+                    & (ids_buf[1:] == last2[1])
+                    & (j < pos - 2)
+                )
+                j_star = jnp.max(jnp.where(match, j, -1))
+                # garbage drafts are harmless: acceptance re-derives every
+                # token from the model's own greedy choice
+                start = jnp.clip(j_star + 2, 0, buf_len - K)
+                draft = jax.lax.dynamic_slice(ids_buf, (start,), (K,))
+
+                cur = ids_buf[pos - 1]
+                inputs = jnp.concatenate([cur[None], draft])[None, :]  # [1, K+1]
+                hidden, new_cache = forward(
+                    params, inputs, mc, cache=cache, cache_pos=pos - 1,
+                    compute_dtype=dtype, output_hidden=True,
+                )
+                logits_all = unembed(params, hidden[0], mc, compute_dtype=dtype)
+
+                # --- sequential greedy verify (evolving repetition-penalty set)
+                def verify(i, v):
+                    seen, ids_buf, n_acc, active, done = v
+                    tok = sample_token(None, logits_all[i][None], seen, gen)[0]
+                    take = active & ~done & (n_gen + i < max_new)
+                    seen = jnp.where(take, seen.at[0, tok].set(True), seen)
+                    ids_buf = jnp.where(
+                        take, ids_buf.at[pos + i].set(tok), ids_buf
+                    )
+                    n_acc = n_acc + jnp.where(take, 1, 0)
+                    hit = jnp.isin(tok, eos) if eos is not None else jnp.bool_(False)
+                    done = done | (take & hit)
+                    # token i+1 is valid only if draft i matched the choice
+                    # (the last slot K has no following draft to validate)
+                    active = active & (
+                        (i >= K) | (draft[jnp.minimum(i, K - 1)] == tok)
+                    )
+                    return (seen, ids_buf, n_acc, active, done)
+
+                seen, ids_buf, n_acc, _, done = jax.lax.fori_loop(
+                    0, K + 1, lambda i, v: verify(i, v),
+                    (seen, ids_buf, jnp.int32(0), jnp.bool_(True), done),
+                )
+                return (n_gen + n_acc, new_cache, ids_buf, seen, done, n_steps + 1)
+
+            def cond(c):
+                n_gen, _, _, _, done, _ = c
+                return (n_gen < max_new) & ~done
+
+            n_gen, cache, ids_buf, seen, done, n_steps = jax.lax.while_loop(
+                cond, body, (n_gen, cache, ids_buf, seen, done, jnp.int32(1))
+            )
+            out = jax.lax.dynamic_slice(ids_buf, (prompt_len,), (max_new,))
+            # n_steps counts sequential forwards (prefill + spec steps);
+            # n_steps < n_gen proves multi-token acceptance
+            return out[None, :], n_gen, n_steps
+
+        return run
+
     def generate_batch(
         self,
         prompts: Sequence[Sequence[int]],
@@ -146,9 +273,24 @@ class Generator:
             raise ValueError("generate_batch needs >= 1 non-empty prompt")
         longest = max(len(p) for p in prompts)
         bucket = -(-longest // _PROMPT_BUCKET) * _PROMPT_BUCKET
-        key = ("batch", len(prompts), bucket, gen)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_batch(len(prompts), bucket, gen)
+        # prompt-lookup speculation: greedy, batch-1 (the latency case)
+        speculate = (
+            gen.speculative_lookup > 0 and not gen.do_sample and len(prompts) == 1
+        )
+        if speculate:
+            key = ("spec", bucket, gen)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_spec(bucket, gen)
+        else:
+            # normalize the unused speculation knob out of the cache key so a
+            # sampled/multi-prompt fallback reuses the plain batch program
+            # instead of compiling a behaviorally identical copy
+            import dataclasses
+
+            gen = dataclasses.replace(gen, speculative_lookup=0)
+            key = ("batch", len(prompts), bucket, gen)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_batch(len(prompts), bucket, gen)
         run = self._jit_cache[key]
 
         padded = np.zeros((len(prompts), bucket), np.int32)
@@ -156,14 +298,19 @@ class Generator:
         for i, p in enumerate(prompts):
             padded[i, : len(p)] = p
             lens[i] = len(p)
-        out, _ = run(
+        res = run(
             self.params, jnp.asarray(padded), jnp.asarray(lens),
             jax.random.PRNGKey(seed),
         )
+        out, n = res[0], res[1]  # spec path also returns n_steps at res[2]
+        self.last_spec_steps = int(res[2]) if len(res) > 2 else None
         out = np.asarray(out)
         results: List[List[int]] = []
         for row in out:
             toks = row.tolist()
+            if speculate:
+                # slots past the accepted count hold rejected-draft leftovers
+                toks = toks[: int(n)]
             for i, tok in enumerate(toks):
                 if tok in self.eos_token_ids:
                     toks = toks[:i]

@@ -26,6 +26,12 @@ class GenerationConfig:
     top_p: float = 0.95
     top_k: Optional[int] = 40
     repetition_penalty: float = 1.1
+    # Prompt-lookup speculative decoding (greedy only): draft this many
+    # tokens per step by matching the latest bigram earlier in the context,
+    # verify them in ONE forward. 0 = off. Same greedy algorithm (bit-exact
+    # in f32; bf16 near-ties at the chunked verify may resolve differently);
+    # worthwhile when outputs repeat context n-grams (extractive QA, code).
+    speculative_lookup: int = 0
 
 
 def apply_repetition_penalty(logits, seen, penalty):

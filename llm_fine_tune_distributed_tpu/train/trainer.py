@@ -524,6 +524,12 @@ class SFTTrainer:
                         batch, self._batch_sharding, local_shards=True
                     )
                     self.state, metrics = self.train_step(self.state, dev_batch)
+                    # sync before stamping the meter: under async dispatch the
+                    # step returns at ENQUEUE time, and per-step host gaps
+                    # would otherwise measure dispatch, not device time —
+                    # making the steady-state median meaningless. One small
+                    # host sync per multi-second step is noise.
+                    jax.block_until_ready(metrics["loss"])
                     step += 1
                     meter.update(samples_per_step)
                     profiler.step(step)

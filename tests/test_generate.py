@@ -171,3 +171,94 @@ def test_batched_eos_stops_rows_independently(tiny_setup):
     out = gen.generate_batch([p1, p2], cfg)
     assert out[0] == []  # first emission was eos -> trimmed to empty
     assert out[1] == other
+
+
+def test_speculative_greedy_exact_equivalence(tiny_setup):
+    """Prompt-lookup speculative decode must emit EXACTLY the plain greedy
+    sequence — incl. evolving repetition penalty — on normal and highly
+    repetitive prompts (where drafting actually engages)."""
+    mc, params, tok = tiny_setup
+    gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    for text in (
+        "the quick brown fox",
+        "water water water water water water",
+        "abc abc abc abc abc abc abc abc",
+    ):
+        prompt = tok.encode(text)
+        for rp in (1.0, 1.1):
+            plain = gen.generate_ids(
+                prompt,
+                GenerationConfig(
+                    max_new_tokens=12, do_sample=False, repetition_penalty=rp
+                ),
+            )
+            spec = gen.generate_ids(
+                prompt,
+                GenerationConfig(
+                    max_new_tokens=12, do_sample=False, repetition_penalty=rp,
+                    speculative_lookup=4,
+                ),
+            )
+            assert spec == plain, f"{text!r} rp={rp}: {spec} != {plain}"
+
+
+def test_speculative_eos_stops(tiny_setup):
+    mc, params, tok = tiny_setup
+    probe = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    cfg = GenerationConfig(max_new_tokens=8, do_sample=False, repetition_penalty=1.0)
+    prompt = tok.encode("the quick brown fox")
+    plain = probe.generate_ids(prompt, cfg)
+    eos_tok = plain[3]  # declare the 4th emission to be eos
+    expect = plain[: plain.index(eos_tok)]
+
+    gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[eos_tok])
+    spec_cfg = GenerationConfig(
+        max_new_tokens=8, do_sample=False, repetition_penalty=1.0, speculative_lookup=4
+    )
+    assert gen.generate_ids(prompt, spec_cfg) == expect
+
+
+def test_speculative_falls_back_for_sampling_and_batch(tiny_setup):
+    """speculative_lookup is ignored for sampled or multi-prompt requests
+    (they use the standard batch path)."""
+    mc, params, tok = tiny_setup
+    gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    p = tok.encode("hello")
+    sampled = GenerationConfig(max_new_tokens=4, do_sample=True, speculative_lookup=4)
+    assert gen.generate_ids(p, sampled, seed=1) == gen.generate_ids(
+        p, GenerationConfig(max_new_tokens=4, do_sample=True), seed=1
+    )
+    greedy_spec = GenerationConfig(
+        max_new_tokens=4, do_sample=False, repetition_penalty=1.0, speculative_lookup=4
+    )
+    two = gen.generate_batch([p, tok.encode("bye")], greedy_spec)
+    assert len(two) == 2 and all(len(t) == 4 for t in two)
+
+
+def test_speculative_accepts_on_repetitive_output(tiny_setup):
+    """When greedy output repeats a bigram, drafting must accept multiple
+    tokens per forward: sequential steps < generated tokens."""
+    mc, params, tok = tiny_setup
+    gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    spec_cfg = GenerationConfig(
+        max_new_tokens=16, do_sample=False, repetition_penalty=1.0,
+        speculative_lookup=4,
+    )
+    # find a prompt whose greedy continuation contains a repeated bigram
+    plain_cfg = GenerationConfig(
+        max_new_tokens=16, do_sample=False, repetition_penalty=1.0
+    )
+    for text in ("a", "the", "x y z", "hello world"):
+        prompt = tok.encode(text)
+        out = gen.generate_ids(prompt, plain_cfg)
+        bigrams = list(zip(out, out[1:]))
+        if len(set(bigrams)) < len(bigrams):  # some bigram repeats
+            spec = gen.generate_ids(prompt, spec_cfg)
+            assert spec == out
+            assert gen.last_spec_steps is not None
+            assert gen.last_spec_steps < len(spec), (
+                f"no multi-accepts: {gen.last_spec_steps} steps for "
+                f"{len(spec)} tokens"
+            )
+            return
+    raise AssertionError("no repetitive greedy continuation found to test with")
