@@ -21,7 +21,9 @@ def run_ask_cli(
     template_kwargs: Optional[dict] = None,
 ) -> int:
     parser = argparse.ArgumentParser(description=description)
-    parser.add_argument("question", nargs="+", help="question for the model")
+    parser.add_argument(
+        "question", nargs="*", help="question for the model (omit with --serve)"
+    )
     parser.add_argument(
         "--model-dir",
         default=os.environ.get(model_dir_env, default_model_dir),
@@ -42,6 +44,12 @@ def run_ask_cli(
         help="weight-only inference quantization: int8 halves the HBM weight "
         "stream that bounds batch-1 decode (ops/int8.py)",
     )
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="run the HTTP server (infer/server.py) instead of answering once",
+    )
+    parser.add_argument("--host", default="0.0.0.0", help="--serve bind address")
+    parser.add_argument("--port", type=int, default=8080, help="--serve port")
     args = parser.parse_args(argv)
     question = " ".join(args.question)
 
@@ -51,6 +59,33 @@ def run_ask_cli(
         print(f"Error: model directory not found: {args.model_dir!r}")
         print(missing_dir_help)
         return 1
+
+    if args.serve:
+        # sampling knobs are per-REQUEST in server mode; refuse silently
+        # ignored arguments instead of starting a misconfigured-looking server
+        if question:
+            parser.error("--serve takes no question (clients POST /v1/generate)")
+        defaults = {
+            "max_new_tokens": 3768, "temperature": 0.6, "top_p": 0.95,
+            "top_k": 40, "repetition_penalty": 1.1,
+        }
+        ignored = [
+            f"--{k.replace('_', '-')}" for k, d in defaults.items()
+            if getattr(args, k) != d
+        ] + (["--greedy"] if args.greedy else []) + (
+            ["--seed"] if args.seed != 0 else []
+        )
+        if ignored:
+            parser.error(
+                f"{' '.join(ignored)} have no effect with --serve — sampling "
+                "options are per-request fields of POST /v1/generate"
+            )
+        from llm_fine_tune_distributed_tpu.infer.server import serve
+
+        serve(args.model_dir, host=args.host, port=args.port, quantize=args.quantize)
+        return 0
+    if not question:
+        parser.error("a question is required (or pass --serve)")
 
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (

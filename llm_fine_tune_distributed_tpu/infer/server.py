@@ -34,6 +34,7 @@ def serve(
     port: int = 8080,
     max_batch: int = 8,
     batch_window_ms: float = 10.0,
+    quantize: str = "none",
 ) -> None:
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
@@ -45,12 +46,19 @@ def serve(
 
     from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine
 
+    if quantize not in ("none", "int8"):  # fail fast, before the model load
+        raise ValueError(f"unknown quantize mode {quantize!r} (expected none/int8)")
     print(f"Loading model from {model_dir} ...")
     params, model_config = load_model_dir(model_dir)
+    if quantize == "int8":
+        from llm_fine_tune_distributed_tpu.ops.int8 import quantize_params_int8
+
+        print("Quantizing block linears to int8 (weight-only) ...")
+        params = quantize_params_int8(params)
     tokenizer = load_tokenizer_dir(model_dir)
     generator = Generator(params, model_config, tokenizer)
     engine = BatchingEngine(generator, max_batch=max_batch, window_ms=batch_window_ms)
-    print(f"Model ready (max_batch={max_batch}).")
+    print(f"Model ready (max_batch={max_batch}, quantize={quantize}).")
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, payload: dict | str) -> None:
@@ -148,11 +156,16 @@ def main(argv: Optional[list] = None) -> int:
         "--batch-window-ms", type=float, default=10.0,
         help="how long the batcher waits to fill a group",
     )
+    parser.add_argument(
+        "--quantize", choices=["none", "int8"], default="none",
+        help="weight-only inference quantization (ops/int8.py)",
+    )
     args = parser.parse_args(argv)
     if not os.path.isdir(args.model_dir):
         print(f"Error: model directory not found: {args.model_dir!r}")
         return 1
-    serve(args.model_dir, args.host, args.port, args.max_batch, args.batch_window_ms)
+    serve(args.model_dir, args.host, args.port, args.max_batch,
+          args.batch_window_ms, args.quantize)
     return 0
 
 

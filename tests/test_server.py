@@ -46,19 +46,20 @@ def model_dir(tmp_path_factory):
     return str(d)
 
 
-@pytest.fixture(scope="module")
-def server(model_dir):
+def _start_server(model_dir, timeout_s=120, **serve_kwargs):
+    """Start serve() on a free port in a daemon thread; wait for /healthz."""
     from llm_fine_tune_distributed_tpu.infer.server import serve
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     t = threading.Thread(
-        target=serve, args=(model_dir, "127.0.0.1", port), daemon=True
+        target=serve, args=(model_dir, "127.0.0.1", port),
+        kwargs=serve_kwargs, daemon=True,
     )
     t.start()
     base = f"http://127.0.0.1:{port}"
-    deadline = time.time() + 60
+    deadline = time.time() + timeout_s
     while time.time() < deadline:
         try:
             with urllib.request.urlopen(f"{base}/healthz", timeout=2) as r:
@@ -67,6 +68,11 @@ def server(model_dir):
         except OSError:
             time.sleep(0.25)
     raise RuntimeError("server did not become healthy")
+
+
+@pytest.fixture(scope="module")
+def server(model_dir):
+    return _start_server(model_dir)
 
 
 def test_healthz(server):
@@ -131,3 +137,15 @@ def test_concurrent_generate_batched(server):
     assert all(isinstance(a, str) for a in answers), answers
     # same question solo must give the same greedy answer
     assert ask(questions[0]) == answers[0]
+
+
+def test_serve_int8(model_dir):
+    """--quantize int8 serving path answers requests."""
+    base = _start_server(model_dir, quantize="int8")
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"question": "q?", "max_new_tokens": 4, "greedy": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert isinstance(json.loads(r.read())["answer"], str)
