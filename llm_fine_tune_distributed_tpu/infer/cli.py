@@ -36,6 +36,11 @@ def run_ask_cli(
     parser.add_argument("--top-k", type=int, default=40)
     parser.add_argument("--repetition-penalty", type=float, default=1.1)
     parser.add_argument("--greedy", action="store_true", help="disable sampling")
+    parser.add_argument(
+        "--speculative", type=int, default=0, metavar="K",
+        help="prompt-lookup speculative decoding with K drafts/step "
+        "(greedy only; pays off when answers quote the context)",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--quantize",
@@ -52,7 +57,9 @@ def run_ask_cli(
     parser.add_argument("--port", type=int, default=8080, help="--serve port")
     args = parser.parse_args(argv)
     question = " ".join(args.question)
-
+    if args.speculative and not args.greedy and not args.serve:
+        # before the (multi-minute) model load
+        parser.error("--speculative requires --greedy (verification is greedy)")
     if not args.model_dir or not os.path.isdir(args.model_dir):
         # reference exits with guidance when the artifact is missing
         # (ask_tuned_model.py:17-20)
@@ -65,16 +72,14 @@ def run_ask_cli(
         # ignored arguments instead of starting a misconfigured-looking server
         if question:
             parser.error("--serve takes no question (clients POST /v1/generate)")
-        defaults = {
-            "max_new_tokens": 3768, "temperature": 0.6, "top_p": 0.95,
-            "top_k": 40, "repetition_penalty": 1.1,
-        }
-        ignored = [
-            f"--{k.replace('_', '-')}" for k, d in defaults.items()
-            if getattr(args, k) != d
-        ] + (["--greedy"] if args.greedy else []) + (
-            ["--seed"] if args.seed != 0 else []
+        sampling_flags = (
+            "max_new_tokens", "temperature", "top_p", "top_k",
+            "repetition_penalty", "greedy", "seed", "speculative",
         )
+        ignored = [
+            f"--{k.replace('_', '-')}" for k in sampling_flags
+            if getattr(args, k) != parser.get_default(k)
+        ]
         if ignored:
             parser.error(
                 f"{' '.join(ignored)} have no effect with --serve — sampling "
@@ -82,7 +87,10 @@ def run_ask_cli(
             )
         from llm_fine_tune_distributed_tpu.infer.server import serve
 
-        serve(args.model_dir, host=args.host, port=args.port, quantize=args.quantize)
+        serve(
+            args.model_dir, host=args.host, port=args.port,
+            quantize=args.quantize, template_kwargs=template_kwargs,
+        )
         return 0
     if not question:
         parser.error("a question is required (or pass --serve)")
@@ -97,11 +105,9 @@ def run_ask_cli(
 
     print(f"Loading model from {args.model_dir} ...")
     params, model_config = load_model_dir(args.model_dir)
-    if args.quantize == "int8":
-        from llm_fine_tune_distributed_tpu.ops.int8 import quantize_params_int8
+    from llm_fine_tune_distributed_tpu.ops.int8 import maybe_quantize
 
-        print("Quantizing block linears to int8 (weight-only) ...")
-        params = quantize_params_int8(params)
+    params = maybe_quantize(params, args.quantize)
     tokenizer = load_tokenizer_dir(args.model_dir)
     generator = Generator(params, model_config, tokenizer)
 
@@ -112,6 +118,7 @@ def run_ask_cli(
         top_p=args.top_p,
         top_k=args.top_k,
         repetition_penalty=args.repetition_penalty,
+        speculative_lookup=args.speculative,
     )
     messages = [
         {"role": "system", "content": WILDERNESS_EXPERT_SYSTEM_PROMPT},

@@ -35,6 +35,7 @@ def serve(
     max_batch: int = 8,
     batch_window_ms: float = 10.0,
     quantize: str = "none",
+    template_kwargs: Optional[dict] = None,
 ) -> None:
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
@@ -46,15 +47,15 @@ def serve(
 
     from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine
 
-    if quantize not in ("none", "int8"):  # fail fast, before the model load
-        raise ValueError(f"unknown quantize mode {quantize!r} (expected none/int8)")
+    from llm_fine_tune_distributed_tpu.ops.int8 import QUANTIZE_MODES, maybe_quantize
+
+    if quantize not in QUANTIZE_MODES:  # fail fast, before the model load
+        raise ValueError(
+            f"unknown quantize mode {quantize!r} (expected one of {QUANTIZE_MODES})"
+        )
     print(f"Loading model from {model_dir} ...")
     params, model_config = load_model_dir(model_dir)
-    if quantize == "int8":
-        from llm_fine_tune_distributed_tpu.ops.int8 import quantize_params_int8
-
-        print("Quantizing block linears to int8 (weight-only) ...")
-        params = quantize_params_int8(params)
+    params = maybe_quantize(params, quantize)
     tokenizer = load_tokenizer_dir(model_dir)
     generator = Generator(params, model_config, tokenizer)
     engine = BatchingEngine(generator, max_batch=max_batch, window_ms=batch_window_ms)
@@ -93,6 +94,8 @@ def serve(
                 "top_k": int,
                 "repetition_penalty": float,
             }
+            # "speculative": K maps to GenerationConfig.speculative_lookup
+            # (greedy-only prompt-lookup decoding, infer/generate.py)
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(length) or b"{}")
@@ -104,6 +107,10 @@ def serve(
                 }
                 if "greedy" in req:
                     gen_kwargs["do_sample"] = not req["greedy"]
+                if "speculative" in req:
+                    gen_kwargs["speculative_lookup"] = int(req["speculative"])
+                    if gen_kwargs.get("do_sample", True):
+                        raise ValueError("speculative requires greedy: true")
                 seed = int(req.get("seed", 0))
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"bad request: {e}"})
@@ -120,7 +127,7 @@ def serve(
                 # tokenize/decode on the handler thread (Generator's shared
                 # chat helpers, so CLI and server cannot diverge); only the
                 # device work goes through the batching engine's worker
-                prompt_ids = generator.encode_chat(messages)
+                prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
                 ids = engine.submit(prompt_ids, gen, seed=seed)
                 answer = generator.decode_reply(ids)
             except Exception as e:  # surface generation errors as 500s

@@ -74,6 +74,24 @@ def dequantize_int8_stacked(q: Dict, dtype=jnp.bfloat16):
     ).astype(dtype)
 
 
+# the single source of truth for inference quantization modes (CLI choices,
+# server fail-fast check, and maybe_quantize all reference this)
+QUANTIZE_MODES = ("none", "int8")
+
+
+def maybe_quantize(params, mode: str):
+    """Shared inference-entry helper (CLI + server): apply the selected
+    weight-only quantization mode to a loaded params pytree."""
+    if mode not in QUANTIZE_MODES:
+        raise ValueError(
+            f"unknown quantize mode {mode!r} (expected one of {QUANTIZE_MODES})"
+        )
+    if mode == "none":
+        return params
+    print("Quantizing block linears to int8 (weight-only) ...")
+    return quantize_params_int8(params)
+
+
 def quantize_params_int8(params, predicate=None):
     """Replace every matching 2-D ``.../kernel`` leaf (transformer-block
     linears by default) with its int8 sibling leaves. Works on the nested

@@ -149,3 +149,22 @@ def test_serve_int8(model_dir):
     )
     with urllib.request.urlopen(req, timeout=120) as r:
         assert isinstance(json.loads(r.read())["answer"], str)
+
+
+def test_speculative_request_field(server):
+    """POST /v1/generate accepts "speculative": K with greedy, and rejects
+    it for sampled requests."""
+    def post(body):
+        req = urllib.request.Request(
+            f"{server}/v1/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        return urllib.request.urlopen(req, timeout=120)
+
+    with post(
+        {"question": "water?", "max_new_tokens": 4, "greedy": True, "speculative": 4}
+    ) as r:
+        assert isinstance(json.loads(r.read())["answer"], str)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post({"question": "water?", "max_new_tokens": 4, "speculative": 4})
+    assert e.value.code == 400
