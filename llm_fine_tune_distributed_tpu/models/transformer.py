@@ -338,12 +338,24 @@ def forward(
     # the activation sharding so call sites stay unchanged. (The attention
     # dispatch ignores it for non-sequence-parallel impls.)
     mesh = None
-    if activation_sharding is not None and (
-        attention_impl in ("ring", "ulysses") or config.num_experts > 0
-    ):
+    if activation_sharding is not None:
         mesh = getattr(activation_sharding, "mesh", None)
 
     embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
+    if mesh is not None and (
+        dict(mesh.shape).get("tensor", 1) > 1 or dict(mesh.shape).get("data", 1) > 1
+    ):
+        # Embedding-lookup layout: shard the table by vocab (tensor, else
+        # fsdp) and gather the hidden dim. FSDP shards the table's hidden dim
+        # with the same mesh axis that shards the ids' batch dim; on tensor>1
+        # or data>1 meshes GSPMD resolves that conflict by replicating the
+        # gather output and repartitioning it ("involuntary full
+        # rematerialization", spmd_partitioner.cc warnings). With the table
+        # vocab-sharded, each device gathers from its vocab shard (masked +
+        # psum) and the output lands directly on the activation layout.
+        # (1, fsdp, 1, *) meshes reshard the (small) gather output cleanly
+        # without help, so they skip this.
+        embed = _lookup_table_constraint(embed, mesh)
     x = constrain(embed[input_ids])
     cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
 
@@ -437,20 +449,61 @@ def forward(
     if output_hidden:
         out = x.astype(compute_dtype)
     else:
-        out = unembed(params, x, config, compute_dtype=compute_dtype, logits_dtype=logits_dtype)
+        out = unembed(
+            params, x, config, compute_dtype=compute_dtype, logits_dtype=logits_dtype, mesh=mesh
+        )
     if return_aux:
         return out, new_cache, moe_aux
     return out, new_cache
 
 
-def unembed(params: Params, hidden, config: ModelConfig, *, compute_dtype=jnp.bfloat16, logits_dtype=jnp.float32):
-    """Project hidden states [..., hidden] -> logits [..., vocab] (tied or not)."""
+def _lookup_table_constraint(table, mesh, vocab_dim: int = 0):
+    """Constrain a [vocab, hidden]-shaped (or transposed) weight so only the
+    vocab dim stays sharded and the hidden dim is gathered. Shared by the
+    embedding lookup and the unembed matmul — both places where FSDP's
+    hidden-dim sharding collides with the batch-sharded activations and GSPMD
+    would otherwise fall back to replicate-then-repartition
+    (spmd_partitioner.cc "Involuntary full rematerialization" warnings,
+    VERDICT r1 #1).
+
+    The vocab dim shards over ``tensor`` when live (Megatron layout), else
+    over ``fsdp`` — the table stays distributed either way (never fully
+    replicated for a large-vocab model); GSPMD lowers the lookup to a masked
+    local gather + psum over the vocab shards, with only activation-sized
+    collectives on the hot path."""
+    axes = dict(mesh.shape)
+    vocab_ax = None
+    for ax in ("tensor", "fsdp"):
+        if axes.get(ax, 1) > 1 and table.shape[vocab_dim] % axes[ax] == 0:
+            vocab_ax = ax
+            break
+    spec = [None, None]
+    spec[vocab_dim] = vocab_ax
+    return jax.lax.with_sharding_constraint(
+        table, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))
+    )
+
+
+def unembed(params: Params, hidden, config: ModelConfig, *, compute_dtype=jnp.bfloat16, logits_dtype=jnp.float32, mesh=None):
+    """Project hidden states [..., hidden] -> logits [..., vocab] (tied or not).
+
+    With a ``mesh``, the projection weight is constrained like the embedding
+    lookup table (vocab over ``tensor``, hidden gathered): under FSDP the
+    weight moves to the data, the batch-sharded activations stay put —
+    without this, GSPMD reshards the activations (and their cotangents) to
+    the weight's hidden-dim sharding through a replicate-then-repartition
+    fallback on data>1 meshes."""
     h = hidden.astype(compute_dtype)
     if config.tie_word_embeddings:
         embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
+        if mesh is not None:
+            embed = _lookup_table_constraint(embed, mesh, vocab_dim=0)
         logits = jnp.einsum("...h,vh->...v", h, embed)
     else:
-        logits = h @ params["lm_head"]["kernel"].astype(compute_dtype)
+        kernel = params["lm_head"]["kernel"].astype(compute_dtype)
+        if mesh is not None:
+            kernel = _lookup_table_constraint(kernel, mesh, vocab_dim=1)
+        logits = h @ kernel
     return logits.astype(logits_dtype)
 
 

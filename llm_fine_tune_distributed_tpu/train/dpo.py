@@ -45,7 +45,7 @@ def masked_sequence_logprob(per_token_logprob, loss_mask):
     return (per_token_logprob * loss_mask[:, 1:]).sum(axis=-1)
 
 
-def _target_logprobs(params, hidden, targets, model_config, chunk, compute_dtype):
+def _target_logprobs(params, hidden, targets, model_config, chunk, compute_dtype, mesh=None):
     """Per-token logprob of ``targets`` given final hidden states.
 
     hidden: [b, s-1, h] (positions 0..s-2 predicting 1..s-1); returns [b, s-1]
@@ -53,7 +53,7 @@ def _target_logprobs(params, hidden, targets, model_config, chunk, compute_dtype
     logits is live at a time.
     """
     if chunk is None:
-        logits = unembed(params, hidden, model_config, compute_dtype=compute_dtype)
+        logits = unembed(params, hidden, model_config, compute_dtype=compute_dtype, mesh=mesh)
         return -optax.softmax_cross_entropy_with_integer_labels(logits, targets)
 
     b, s, h = hidden.shape
@@ -68,7 +68,7 @@ def _target_logprobs(params, hidden, targets, model_config, chunk, compute_dtype
     @jax.checkpoint
     def one_chunk(args):
         h_c, t_c = args
-        logits = unembed(params, h_c, model_config, compute_dtype=compute_dtype)
+        logits = unembed(params, h_c, model_config, compute_dtype=compute_dtype, mesh=mesh)
         return -optax.softmax_cross_entropy_with_integer_labels(logits, t_c)
 
     lp = jax.lax.map(one_chunk, (hc, tc))  # [n, b, chunk]
@@ -115,7 +115,8 @@ def make_dpo_loss_fn(
         )
         hidden = result[0]
         per_token = _target_logprobs(
-            params, hidden[:, :-1], input_ids[:, 1:], model_config, chunk, compute_dtype
+            params, hidden[:, :-1], input_ids[:, 1:], model_config, chunk, compute_dtype,
+            mesh=getattr(activation_sharding, "mesh", None),
         )
         lp = masked_sequence_logprob(per_token, loss_mask)
         return (lp, result[2]) if with_aux else lp

@@ -26,7 +26,7 @@ from llm_fine_tune_distributed_tpu.train.state import TrainState
 from llm_fine_tune_distributed_tpu.utils.tree import merge_flat
 
 
-def chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfig, chunk_size: int, compute_dtype):
+def chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfig, chunk_size: int, compute_dtype, mesh=None):
     """Masked cross-entropy SUM computed in sequence chunks.
 
     Unembeds ``chunk_size`` positions at a time (each chunk rematerialized on
@@ -49,7 +49,7 @@ def chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfig, chu
     @jax.checkpoint
     def one_chunk(args):
         h_c, t_c, m_c = args
-        logits = unembed(params, h_c, model_config, compute_dtype=compute_dtype)
+        logits = unembed(params, h_c, model_config, compute_dtype=compute_dtype, mesh=mesh)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, t_c)
         return (ce * m_c).sum()
 
@@ -99,7 +99,8 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
         tokens = jnp.maximum(mask.sum(), 1.0)
         if chunk is not None:
             ce_sum = chunked_ce_sum(
-                params, out[:, :-1], targets, mask, model_config, chunk, compute_dtype
+                params, out[:, :-1], targets, mask, model_config, chunk, compute_dtype,
+                mesh=getattr(activation_sharding, "mesh", None),
             )
         else:
             ce = optax.softmax_cross_entropy_with_integer_labels(out[:, :-1], targets)

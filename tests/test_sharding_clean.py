@@ -1,0 +1,110 @@
+"""The sharded train step must compile without GSPMD resharding fallbacks.
+
+VERDICT round 1 flagged "Involuntary full rematerialization" warnings
+(spmd_partitioner.cc) in the 8-device dryrun: the embedding-lookup gather's
+output was hidden-sharded (fsdp) and XLA could only reach the batch/seq
+activation layout by replicating the whole tensor. models/transformer.py now
+constrains the lookup table (and the unembed weight) so the gather lands on
+the activation layout directly; these tests pin that property for the dryrun
+meshes and for the plain DP x FSDP mesh.
+
+The warning is emitted by XLA's C++ logger straight to stderr at compile
+time, so the checks run in subprocesses and grep stderr — for the dryrun,
+the exact artifact the driver executes for MULTICHIP_r{N}.json.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax, jax.numpy as jnp, numpy as np
+# a sitecustomize may have pinned a hardware platform at interpreter startup;
+# the config update overrides it as long as the backend isn't initialized yet
+jax.config.update("jax_platforms", "cpu")
+from jax.sharding import NamedSharding, PartitionSpec as P
+from llm_fine_tune_distributed_tpu.config import MeshConfig, TrainConfig
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.parallel.freeze import trainable_mask
+from llm_fine_tune_distributed_tpu.parallel.optimizer import build_optimizer
+from llm_fine_tune_distributed_tpu.parallel.sharding import _validate_spec, param_spec
+from llm_fine_tune_distributed_tpu.runtime.mesh import data_parallel_size, make_mesh
+from llm_fine_tune_distributed_tpu.train.state import TrainState
+from llm_fine_tune_distributed_tpu.train.step import build_train_step, jit_train_step
+from llm_fine_tune_distributed_tpu.utils.tree import split_by_mask
+
+shape = dict(zip(("data", "fsdp", "tensor", "seq"), map(int, sys.argv[1].split(","))))
+mesh = make_mesh(MeshConfig(**shape), jax.devices())
+dp = data_parallel_size(mesh)
+mc = get_preset("tiny")
+tc = TrainConfig(model_preset="tiny", per_device_batch_size=1,
+                 gradient_accumulation_steps=2, max_seq_length=64,
+                 gradient_checkpointing=True,
+                 attention_impl="ring" if shape["seq"] > 1 else "xla")
+params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+mask = trainable_mask(params, mc, tc)
+trainable, frozen = split_by_mask(params, mask)
+frozen = {k: v.astype(jnp.bfloat16) for k, v in frozen.items()}
+def put(flat):
+    return {k: jax.device_put(v, NamedSharding(mesh, _validate_spec(
+        param_spec(k, v.ndim), v.shape, mesh))) for k, v in flat.items()}
+trainable, frozen = put(trainable), put(frozen)
+opt = build_optimizer(tc, None, total_steps=4, data_parallel_size=dp)
+state = TrainState(
+    step=jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P())),
+    trainable=trainable, frozen=frozen, opt_state=jax.jit(opt.init)(trainable))
+seq_ax = "seq" if shape["seq"] > 1 else None
+act = NamedSharding(mesh, P(("data", "fsdp"), seq_ax, None))
+step = jit_train_step(build_train_step(mc, tc, opt, activation_sharding=act))
+bs = NamedSharding(mesh, P(None, ("data", "fsdp"), seq_ax))
+rng = np.random.RandomState(0)
+n = tc.per_device_batch_size * dp
+batch = {"input_ids": jax.device_put(
+             rng.randint(0, mc.vocab_size, (2, n, 64)).astype(np.int32), bs),
+         "loss_mask": jax.device_put(np.ones((2, n, 64), np.float32), bs),
+         "attention_mask": jax.device_put(np.ones((2, n, 64), np.int32), bs)}
+_, m = step(state, batch)
+jax.block_until_ready(m)
+assert np.isfinite(float(m["loss"]))
+print(f"PROBE OK mesh={shape}")
+"""
+
+
+def _run(args, timeout=900):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return subprocess.run(
+        args, capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env
+    )
+
+
+@pytest.mark.slow
+def test_dryrun_emits_no_involuntary_rematerialization():
+    r = _run([sys.executable, "-c", "import __graft_entry__ as g; g.dryrun_multichip(8)"])
+    assert r.returncode == 0, r.stderr[-4000:]
+    assert "dryrun_multichip OK" in r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr, (
+        "GSPMD replicate-then-repartition fallback is back on the train-step "
+        "hot path:\n" + r.stderr[-4000:]
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh", ["2,4,1,1", "1,8,1,1"])
+def test_dp_fsdp_mesh_emits_no_involuntary_rematerialization(mesh):
+    """data>1 meshes hit a different GSPMD fallback (the unembed/lookup weight
+    pulling batch-sharded activations to its hidden-fsdp layout); pinned
+    clean separately from the dryrun mesh."""
+    r = _run([sys.executable, "-c", _PROBE, mesh])
+    assert r.returncode == 0, r.stderr[-4000:]
+    assert "PROBE OK" in r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr, r.stderr[-4000:]
