@@ -1,0 +1,26 @@
+"""llm_fine_tune_distributed_tpu — a TPU-native distributed LLM fine-tuning framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+``thesteve0/llm-fine-tune-distributed`` (reference: PyTorch + TRL SFTTrainer +
+Kubeflow PyTorchJob + NCCL DDP; see reference ``training.py``):
+
+- SPMD training over a ``jax.sharding.Mesh`` (data / fsdp / tensor axes) with XLA
+  collectives over ICI/DCN instead of NCCL ring all-reduce
+  (reference ``training.py:285`` ``ddp_backend="nccl"``).
+- First-party SFT trainer (the reference delegates this to TRL/Accelerate,
+  ``training.py:289-300``): jit-compiled train/eval steps, gradient accumulation,
+  partial-layer freezing, grad clipping, lr x world_size scaling, checkpointing,
+  best-model tracking, and the on-disk artifact contract.
+- Flax transformer model family (SmolLM3 / Llama / Mistral / Qwen-style dense
+  decoders) with HF safetensors import/export.
+- Pallas TPU flash-attention kernel (replacing flash-attn CUDA,
+  reference ``requirements.txt:10``) and ring attention for long context.
+"""
+
+__version__ = "0.1.0"
+
+from llm_fine_tune_distributed_tpu.config import (  # noqa: F401
+    ModelConfig,
+    TrainConfig,
+    MeshConfig,
+)

@@ -1,0 +1,372 @@
+"""Configuration system.
+
+The reference configures training purely through environment variables
+(``EPOCHS, BATCH_SIZE, LEARNING_RATE, DATA_DIR, OUTPUT_DIR`` — reference
+``training.py:54-60``) with the model name, dataset path, grad-accum, seq-len,
+eval cadence and freezing policy hard-coded. Here every knob is a dataclass
+field, loadable from JSON/YAML, and every reference env var still works as an
+override so the deployment-manifest contract (``deploy/pytorchjob.yaml:30-66``)
+is preserved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of a dense decoder-only transformer.
+
+    One config class covers the Llama family: Llama-3, Mistral (sliding
+    window), Qwen-style (qkv bias), and SmolLM3 (NoPE-interleaved RoPE:
+    ``no_rope_layers[i] == 0`` means layer *i* applies no rotary embedding —
+    mirrors HF ``SmolLM3Config.no_rope_layers``).
+    """
+
+    name: str = "unnamed"
+    vocab_size: int = 128256
+    hidden_size: int = 2048
+    intermediate_size: int = 11008
+    num_layers: int = 36
+    num_heads: int = 16
+    num_kv_heads: int = 4
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 2_000_000.0
+    max_position_embeddings: int = 32768
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    # SmolLM3 NoPE: 1 = RoPE on this layer, 0 = no positional embedding.
+    # Empty tuple = RoPE everywhere (Llama/Mistral).
+    no_rope_layers: tuple = ()
+    sliding_window: Optional[int] = None  # Mistral-style local attention
+    dtype: str = "bfloat16"
+    # Mixture-of-experts (Mixtral-style). 0 = dense MLP. When > 0 every
+    # layer's MLP becomes num_experts SwiGLU experts with top-k routing
+    # (ops/moe.py); expert weights shard over the mesh "expert" axis.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # per-(batch-row, expert) token capacity = ceil(k * seq / E) * this factor;
+    # overflow tokens fall through on the residual path (GShard semantics)
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01  # load-balancing loss weight (Switch/Mixtral)
+    # sequences longer than this are routed in independent chunks (GShard
+    # "groups"), keeping the one-hot dispatch tensors linear in seq length:
+    # [b * s/chunk, chunk, E, C_chunk] instead of [b, s, E, C]. Tokens
+    # compete for capacity within their chunk only.
+    moe_dispatch_chunk: int = 1024
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @property
+    def num_params(self) -> int:
+        """Exact parameter count (matches HF model.num_parameters())."""
+        h, v, f, L = self.hidden_size, self.vocab_size, self.intermediate_size, self.num_layers
+        d = self.resolved_head_dim
+        embed = v * h
+        if self.num_experts:
+            # router gate [h, E] + E SwiGLU experts (w1/w3 [h, f], w2 [f, h])
+            mlp = h * self.num_experts + self.num_experts * 3 * h * f
+        else:
+            mlp = 3 * h * f                    # gate, up, down
+        per_layer = (
+            h * (self.num_heads * d)          # q_proj
+            + h * (self.num_kv_heads * d) * 2  # k_proj, v_proj
+            + (self.num_heads * d) * h         # o_proj
+            + mlp
+            + 2 * h                            # two RMSNorms
+        )
+        if self.attention_bias:
+            per_layer += (self.num_heads + 2 * self.num_kv_heads) * d + h
+        if self.mlp_bias:
+            per_layer += 2 * f + h
+        total = embed + L * per_layer + h  # + final norm
+        if not self.tie_word_embeddings:
+            total += v * h
+        return total
+
+    def uses_rope(self, layer_idx: int) -> bool:
+        if not self.no_rope_layers:
+            return True
+        return bool(self.no_rope_layers[layer_idx])
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh.
+
+    Axis meaning (scaling-book style):
+      - ``data``:  pure data parallelism (gradients psum'd; params replicated)
+      - ``fsdp``:  data parallelism with parameters sharded (ZeRO-3); batch is
+                   sharded over data*fsdp jointly
+      - ``tensor``: tensor parallelism (Megatron-style within attention/MLP)
+      - ``seq``  : sequence/context parallelism — ring attention or Ulysses
+                   all-to-all, selected by ``attention_impl`` (optional)
+      - ``expert``: expert parallelism for MoE models — expert weights and the
+                   dispatched token blocks shard over this axis (ops/moe.py)
+
+    Sizes of -1 mean "absorb remaining devices" (at most one axis may be -1).
+    This replaces the reference's implicit 1-D DDP world
+    (``WORLD_SIZE``/``RANK``, reference ``training.py:19-23``).
+    """
+
+    data: int = 1
+    fsdp: int = -1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def axis_sizes(self, n_devices: int) -> dict:
+        sizes = {"data": self.data, "fsdp": self.fsdp, "tensor": self.tensor,
+                 "seq": self.seq, "expert": self.expert}
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
+        fixed = 1
+        for k, v in sizes.items():
+            if v != -1:
+                fixed *= v
+        if unknown:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[unknown[0]] = n_devices // fixed
+        else:
+            if fixed != n_devices:
+                raise ValueError(f"mesh {sizes} does not cover {n_devices} devices")
+        return sizes
+
+
+@dataclass
+class TrainConfig:
+    """Full SFT training configuration.
+
+    Defaults reproduce the reference recipe exactly:
+    epochs=4, per-device batch=8, lr=5e-5 (scaled x data-parallel size,
+    reference ``training.py:263``), grad-accum 4 (``:262``), clip 1.0 (``:264``),
+    log every 2 steps + first (``:266-267``), eval every 10 (``:270-271``),
+    save every 500 keep 3 (``:268,276``), bf16 (``:269``), seq len 1024 with
+    packing off (``:282-283``), 90/10 split seed 42 (``:164``), freeze all but
+    last 2 layers + lm_head (``:113-149``).
+    """
+
+    # model / data
+    model_name: str = "HuggingFaceTB/SmolLM3-3B"
+    model_preset: Optional[str] = "smollm3_3b"
+    data_dir: str = "data"
+    dataset_file: str = "qa_dataset.parquet"
+    output_dir: str = "outputs"
+    tokenizer_path: Optional[str] = None  # defaults to model_name
+    # None = the wilderness-survival persona (reference C7, training.py:176-186)
+    system_prompt: Optional[str] = None
+
+    # optimization
+    epochs: int = 4
+    per_device_batch_size: int = 8
+    gradient_accumulation_steps: int = 4
+    learning_rate: float = 5e-5
+    scale_lr_by_data_parallel: bool = True  # lr x world_size rule, training.py:263
+    # "adamw" (HF Trainer default, reference parity) | "adafactor" (factored
+    # second moment — near-zero optimizer-state HBM, the classic TPU choice
+    # for big models) | "lion" (sign-momentum, one state slot)
+    optimizer: str = "adamw"
+    weight_decay: float = 0.0
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
+    max_grad_norm: float = 1.0
+    warmup_ratio: float = 0.0
+    lr_schedule: str = "linear"  # HF Trainer default: linear decay to 0
+    seed: int = 42
+
+    # sequence / precision
+    max_seq_length: int = 1024
+    # packing=True packs multiple examples per row with an exact
+    # block-diagonal segment mask (data/packing.py). Attention runs through
+    # the explicit-mask XLA path (flash/ring impls apply to unpacked runs).
+    packing: bool = False
+    param_dtype: str = "float32"     # master weights
+    compute_dtype: str = "bfloat16"  # activations / matmuls
+    gradient_checkpointing: bool = True
+    # remat granularity: "full" (recompute whole block — min memory),
+    # "dots" / "dots_no_batch" (save matmul outputs — less recompute, more
+    # HBM). None = auto (resolved_remat_policy): matmul-saving remat for
+    # models that comfortably fit (measured ~25% faster on v5e for the 3B
+    # flagship, bench.py), minimum-HBM full-block remat at >= 6B params.
+    remat_policy: Optional[str] = None
+    # loss on completion tokens only? TRL SFTTrainer default (packing=False,
+    # no completion_only flag in the reference) trains on the full sequence.
+    completion_only_loss: bool = False
+    # Compute the cross-entropy in sequence chunks of this size so the
+    # [batch, seq, vocab] float32 logits tensor never materializes (HBM saver
+    # for large-vocab models; None = single full-sequence unembed).
+    loss_chunk_size: Optional[int] = None
+
+    # objective: "sft" (the reference recipe) or "dpo" (preference pairs,
+    # BASELINE.json config #4 — the TRL DPOTrainer capability, first-party)
+    objective: str = "sft"
+    dpo_beta: float = 0.1              # TRL DPOConfig default
+    dpo_label_smoothing: float = 0.0   # conservative-DPO eps
+
+    # freezing policy (reference training.py:113-149)
+    freeze_strategy: str = "last_n_and_head"  # or "none" / "lora" / "qlora"
+    unfreeze_last_n_layers: int = 2
+
+    # QLoRA quantization (freeze_strategy="qlora": NF4 frozen base)
+    quant_block_size: int = 64        # NF4 scale block (QLoRA paper default)
+    quant_double_quant: bool = True   # int8-compress the absmax scales
+    quant_matmul_impl: str = "auto"   # "auto" | "xla" | "pallas"
+
+    # LoRA (external-doc config: r=16, alpha=8, dropout=0.05, 7 proj targets)
+    lora_rank: int = 16
+    lora_alpha: float = 8.0
+    lora_dropout: float = 0.05
+    lora_target_modules: Sequence[str] = (
+        "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj",
+    )
+
+    # cadence
+    logging_steps: int = 2
+    logging_first_step: bool = True
+    eval_steps: int = 10
+    save_steps: int = 500
+    save_total_limit: int = 3
+    metric_for_best_model: str = "eval_loss"
+    greater_is_better: bool = False
+    load_best_model_at_end: bool = True
+
+    # data split
+    validation_fraction: float = 0.1
+    split_seed: int = 42
+    drop_last: bool = True
+
+    # mesh / distributed
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    # attention implementation: "xla" | "flash" (Pallas) | "ring" | "ulysses"
+    attention_impl: str = "flash"
+
+    # observability
+    aim_repo: Optional[str] = None
+    experiment_name: str = "smollm3-wilderness-finetuning-distributed"
+    profile_dir: Optional[str] = None
+
+    # native runtime (C++ layer, native/*.cc)
+    use_native_loader: bool = True   # prefetching C++ batch pipeline, auto-fallback
+    heartbeat: bool = False          # TCP failure detector (auto-on multi-host)
+    heartbeat_port: int = 23457      # analog of reference master port 23456
+    heartbeat_timeout_ms: int = 30000
+    # cross-host param-consistency check every N steps (0 = off) — the
+    # systematic form of the reference runbook's gradient-desync diagnosis
+    # (docs/single-vs-distributed-comparison.md:571-580)
+    desync_check_steps: int = 0
+
+    # resume
+    resume_from_checkpoint: Optional[str] = None  # "latest" or a path
+
+    def effective_batch_size(self, data_parallel_size: int) -> int:
+        return self.per_device_batch_size * self.gradient_accumulation_steps * data_parallel_size
+
+    def resolved_remat_policy(self, model_config: "ModelConfig") -> str:
+        """Resolve remat_policy=None ("auto") by model size: small models
+        take the measured-fastest matmul-saving policy, big ones the
+        minimum-HBM full-block remat. An explicit setting always wins."""
+        if self.remat_policy is not None:
+            return self.remat_policy
+        return "dots_no_batch" if model_config.num_params < 6e9 else "full"
+
+    def scaled_learning_rate(self, data_parallel_size: int) -> float:
+        if self.scale_lr_by_data_parallel:
+            return self.learning_rate * data_parallel_size
+        return self.learning_rate
+
+    # ---- env-var override surface (reference training.py:54-60 + pytorchjob.yaml:30-66)
+
+    _ENV_MAP = {
+        "EPOCHS": ("epochs", int),
+        "BATCH_SIZE": ("per_device_batch_size", int),
+        "LEARNING_RATE": ("learning_rate", float),
+        "DATA_DIR": ("data_dir", str),
+        "OUTPUT_DIR": ("output_dir", str),
+        "AIM_REPO": ("aim_repo", str),
+        "MODEL_NAME": ("model_name", str),
+        "MODEL_PRESET": ("model_preset", str),
+        "TOKENIZER_PATH": ("tokenizer_path", str),
+        "MAX_SEQ_LENGTH": ("max_seq_length", int),
+        "GRAD_ACCUM_STEPS": ("gradient_accumulation_steps", int),
+        "SEED": ("seed", int),
+        "ATTENTION_IMPL": ("attention_impl", str),
+        "OPTIMIZER": ("optimizer", str),
+        "PARAM_DTYPE": ("param_dtype", str),
+        "FREEZE_STRATEGY": ("freeze_strategy", str),
+        "REMAT_POLICY": ("remat_policy", str),
+        "LOSS_CHUNK_SIZE": ("loss_chunk_size", int),
+        "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
+        "OBJECTIVE": ("objective", str),
+        "DPO_BETA": ("dpo_beta", float),
+    }
+
+    def apply_env_overrides(self, environ=None) -> "TrainConfig":
+        env = os.environ if environ is None else environ
+        for var, (attr, cast) in self._ENV_MAP.items():
+            if var in env and env[var] != "":
+                setattr(self, attr, cast(env[var]))
+        return self
+
+    # ---- (de)serialization
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["lora_target_modules"] = list(self.lora_target_modules)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainConfig":
+        d = dict(d)
+        if "mesh" in d and isinstance(d["mesh"], dict):
+            d["mesh"] = MeshConfig(**d["mesh"])
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**d)
+
+    @classmethod
+    def load(cls, path: str) -> "TrainConfig":
+        """Load from a JSON or YAML file."""
+        with open(path) as f:
+            text = f.read()
+        if path.endswith((".yaml", ".yml")):
+            try:
+                import yaml  # type: ignore
+            except ImportError as e:
+                raise ImportError("pyyaml not available; use JSON config") from e
+            data = yaml.safe_load(text)
+        else:
+            data = json.loads(text)
+        return cls.from_dict(data)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+
+def str_to_dtype(name: str):
+    import jax.numpy as jnp
+
+    return {
+        "float32": jnp.float32,
+        "f32": jnp.float32,
+        "bfloat16": jnp.bfloat16,
+        "bf16": jnp.bfloat16,
+        "float16": jnp.float16,
+    }[name]

@@ -1,0 +1,7 @@
+from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT  # noqa: F401
+from llm_fine_tune_distributed_tpu.data.dataset import (  # noqa: F401
+    load_qa_dataset,
+    format_chat_example,
+    train_validation_split,
+)
+from llm_fine_tune_distributed_tpu.data.loader import SFTBatchLoader  # noqa: F401

@@ -1,0 +1,163 @@
+"""Dataset loading, splitting, and ChatML formatting (C6 online half).
+
+Parity with reference ``training.py:155-212``:
+- ``load_dataset("parquet")`` on a two-column table (``full-question``, ``answer``);
+- 90/10 train/validation split with seed 42 via the SAME HF
+  ``datasets.train_test_split`` shuffle so the split is bit-identical
+  (reference ``training.py:164``);
+- each row becomes a 3-role ChatML conversation with the wilderness system
+  prompt (reference ``format_prompt``, ``training.py:189-199``).
+
+Tokenization produces fixed-length [max_seq_length] examples with a loss mask.
+TRL's SFTTrainer default (packing=False, no completion-only collator —
+exactly the reference's configuration, ``training.py:282-283``) computes LM
+loss over the full sequence; ``completion_only=True`` optionally restricts
+loss to assistant tokens.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
+
+
+def load_qa_dataset(parquet_path: str) -> List[Dict[str, str]]:
+    """Read the QA parquet into a list of {'full-question', 'answer'} rows."""
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(parquet_path)
+    cols = table.column_names
+    if "full-question" not in cols or "answer" not in cols:
+        raise ValueError(f"expected columns ['full-question', 'answer'], got {cols}")
+    questions = table.column("full-question").to_pylist()
+    answers = table.column("answer").to_pylist()
+    return [{"full-question": q, "answer": a} for q, a in zip(questions, answers)]
+
+
+def train_validation_split(
+    rows: List[dict],
+    test_size: float = 0.1,
+    seed: int = 42,
+) -> Tuple[List[dict], List[dict]]:
+    """90/10 split reproducing HF ``Dataset.train_test_split(test_size, seed)``
+    exactly (reference ``training.py:164``) when ``datasets`` is available."""
+    try:
+        import datasets
+
+        ds = datasets.Dataset.from_list(rows)
+        split = ds.train_test_split(test_size=test_size, seed=seed)
+        return list(split["train"]), list(split["test"])
+    except ImportError:
+        # NumPy fallback: same contract (deterministic, seeded), not bit-equal.
+        n = len(rows)
+        n_test = int(np.ceil(n * test_size))
+        perm = np.random.RandomState(seed).permutation(n)
+        test_idx = set(perm[:n_test].tolist())
+        train = [rows[i] for i in range(n) if i not in test_idx]
+        test = [rows[i] for i in range(n) if i in test_idx]
+        return train, test
+
+
+def format_chat_example(row: dict, system_prompt: str = WILDERNESS_EXPERT_SYSTEM_PROMPT):
+    """Row -> 3-role ChatML messages (reference ``format_prompt``, training.py:189-199)."""
+    return {
+        "messages": [
+            {"role": "system", "content": system_prompt},
+            {"role": "user", "content": row["full-question"]},
+            {"role": "assistant", "content": row["answer"]},
+        ]
+    }
+
+
+@dataclass
+class TokenizedExample:
+    input_ids: np.ndarray  # [seq] int32, padded with pad_token_id
+    loss_mask: np.ndarray  # [seq] float32, 1.0 where loss is computed
+    length: int            # true (unpadded) length
+
+
+def tokenize_example(
+    messages: List[dict],
+    tokenizer,
+    max_seq_length: int,
+    completion_only: bool = False,
+) -> TokenizedExample:
+    """Tokenize a conversation to fixed length with next-token loss masking.
+
+    The loss mask refers to *label* positions: ``loss_mask[t]`` gates the loss
+    of predicting token ``t`` from position ``t-1``. Position 0 (no left
+    context) is never counted.
+    """
+    full_ids = tokenizer.apply_chat_template(messages, tokenize=True)
+    if completion_only:
+        prompt_ids = tokenizer.apply_chat_template(
+            messages[:-1], tokenize=True, add_generation_prompt=True
+        )
+        prompt_len = len(prompt_ids)
+    else:
+        prompt_len = 1  # full-sequence LM loss; position 0 has no context
+
+    full_ids = full_ids[:max_seq_length]
+    length = len(full_ids)
+
+    input_ids = np.full((max_seq_length,), tokenizer.pad_token_id, dtype=np.int32)
+    input_ids[:length] = np.asarray(full_ids, dtype=np.int32)
+
+    loss_mask = np.zeros((max_seq_length,), dtype=np.float32)
+    start = min(prompt_len, length)
+    loss_mask[start:length] = 1.0
+    if completion_only and start >= length:
+        # prompt truncated away the completion: no trainable signal
+        loss_mask[:] = 0.0
+    return TokenizedExample(input_ids=input_ids, loss_mask=loss_mask, length=length)
+
+
+def tokenize_rows(
+    rows: List[dict],
+    tokenizer,
+    max_seq_length: int,
+    completion_only: bool = False,
+    system_prompt: str = WILDERNESS_EXPERT_SYSTEM_PROMPT,
+) -> List[TokenizedExample]:
+    """Tokenize a whole split (shared by the padded and packed array builders
+    so the two paths cannot diverge in tokenization)."""
+    return [
+        tokenize_example(
+            format_chat_example(r, system_prompt)["messages"],
+            tokenizer,
+            max_seq_length,
+            completion_only,
+        )
+        for r in rows
+    ]
+
+
+def build_sft_arrays(
+    rows: List[dict],
+    tokenizer,
+    max_seq_length: int,
+    completion_only: bool = False,
+    system_prompt: str = WILDERNESS_EXPERT_SYSTEM_PROMPT,
+) -> Dict[str, np.ndarray]:
+    """Tokenize a whole split into stacked arrays (the dataset is tiny —
+    2,845 rows, reference ``claude.md:98`` — so host RAM tokenization upfront
+    beats streaming; packing=True uses data/packing.py instead)."""
+    examples = tokenize_rows(rows, tokenizer, max_seq_length, completion_only, system_prompt)
+    input_ids = np.stack([e.input_ids for e in examples])
+    lengths = np.asarray([e.length for e in examples], dtype=np.int32)
+    # attention_mask: 1 where the token is real (not right-padding) — the
+    # collator behavior the reference inherits from HF (pad excluded from
+    # attention, reference training.py:92-94 pad=eos + right padding).
+    attention_mask = (
+        np.arange(input_ids.shape[1])[None, :] < lengths[:, None]
+    ).astype(np.float32)
+    return {
+        "input_ids": input_ids,
+        "loss_mask": np.stack([e.loss_mask for e in examples]),
+        "attention_mask": attention_mask,
+        "lengths": lengths,
+    }

@@ -1,0 +1,90 @@
+"""Batch loader: deterministic epoch shuffling, per-host sharding, drop_last.
+
+Replaces what the reference gets from HF Trainer's DataLoader +
+DistributedSampler (``docs/single-vs-distributed-comparison.md:395-407``):
+each data-parallel host sees a disjoint shard of every global batch, the
+permutation is seeded per epoch (same on every host), and trailing partial
+batches are dropped (``dataloader_drop_last=True``, reference ``training.py:281``).
+
+The loader yields GLOBAL-batch-sized host arrays laid out as
+``[grad_accum, per_host_batch, seq]`` so the train step can lax.scan over the
+accumulation axis — accumulation lives in the data layout, not a Python loop
+(reference ``gradient_accumulation_steps=4``, ``training.py:262``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class SFTBatchLoader:
+    def __init__(
+        self,
+        arrays: Dict[str, np.ndarray],
+        *,
+        per_device_batch_size: int,
+        grad_accum_steps: int = 1,
+        data_parallel_size: int = 1,
+        process_index: int = 0,
+        process_count: int = 1,
+        seed: int = 42,
+        drop_last: bool = True,
+        shuffle: bool = True,
+    ):
+        self.arrays = arrays
+        self.n = next(iter(arrays.values())).shape[0]
+        self.per_device_batch_size = per_device_batch_size
+        self.grad_accum = grad_accum_steps
+        self.dp = data_parallel_size
+        self.process_index = process_index
+        self.process_count = process_count
+        self.seed = seed
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+
+        # Global tokens consumed per optimizer step:
+        self.global_batch = per_device_batch_size * grad_accum_steps * data_parallel_size
+        if self.global_batch > self.n:
+            raise ValueError(
+                f"global batch {self.global_batch} exceeds dataset size {self.n}"
+            )
+        # per-host slice of each global batch
+        if (per_device_batch_size * data_parallel_size) % process_count:
+            raise ValueError(
+                f"batch {per_device_batch_size}x{data_parallel_size} not divisible "
+                f"by {process_count} hosts"
+            )
+        self.per_host_batch = per_device_batch_size * data_parallel_size // process_count
+
+    @property
+    def steps_per_epoch(self) -> int:
+        if self.drop_last:
+            return self.n // self.global_batch
+        return int(np.ceil(self.n / self.global_batch))
+
+    def epoch(self, epoch_idx: int) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield per-host batches [grad_accum, per_host_batch, ...] for one epoch."""
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + epoch_idx).permutation(self.n)
+        else:
+            order = np.arange(self.n)
+        steps = self.steps_per_epoch
+        for s in range(steps):
+            idx = order[s * self.global_batch : (s + 1) * self.global_batch]
+            if len(idx) < self.global_batch:
+                # no-drop_last path: wrap-pad the final batch deterministically
+                idx = np.concatenate([idx, order[: self.global_batch - len(idx)]])
+            # contiguous host shard of the global batch, over the accum axis:
+            # layout [accum, world_batch] -> this host's columns
+            idx = idx.reshape(self.grad_accum, -1)  # [accum, bs*dp]
+            lo = self.process_index * self.per_host_batch
+            hi = lo + self.per_host_batch
+            idx = idx[:, lo:hi]
+            # every array keyed by example index rides along (SFT:
+            # input_ids/loss_mask/attention_mask; DPO: chosen_*/rejected_*)
+            yield {k: v[idx] for k, v in self.arrays.items() if k != "lengths"}
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch

@@ -1,0 +1,118 @@
+"""NativeBatchLoader: drop-in alternative to SFTBatchLoader backed by the C++
+prefetch pipeline (native/loader.cc).
+
+Same contract as data/loader.py — deterministic seeded epoch permutation,
+disjoint per-host shards of every global batch, [grad_accum, per_host_batch,
+seq] layout, drop_last wrap-pad semantics — but the gather runs on a C++
+thread that assembles the NEXT batch while the device executes the current
+step, so host input time hides behind device step time (the role torch's
+DataLoader workers play for the reference, SURVEY.md §2.3).
+
+The permutation algorithm is splitmix64 Fisher-Yates (defined in loader.cc),
+not numpy's — both are deterministic per (seed, epoch), which is the property
+that matters for cross-host agreement; tests assert the two engines agree on
+sharding semantics when shuffling is off.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Iterator
+
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.runtime import native
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeBatchLoader:
+    def __init__(
+        self,
+        arrays: Dict[str, np.ndarray],
+        *,
+        per_device_batch_size: int,
+        grad_accum_steps: int = 1,
+        data_parallel_size: int = 1,
+        process_index: int = 0,
+        process_count: int = 1,
+        seed: int = 42,
+        drop_last: bool = True,
+        shuffle: bool = True,
+        queue_depth: int = 2,
+    ):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {native.build_error()}")
+        self._lib = lib
+
+        # Keep C-contiguous int32 copies alive for the library's lifetime.
+        self._ids = np.ascontiguousarray(arrays["input_ids"], dtype=np.int32)
+        self._lm = np.ascontiguousarray(arrays["loss_mask"], dtype=np.int32)
+        self._am = np.ascontiguousarray(arrays["attention_mask"], dtype=np.int32)
+        self.n, self.seq = self._ids.shape
+
+        self.per_device_batch_size = per_device_batch_size
+        self.grad_accum = grad_accum_steps
+        self.dp = data_parallel_size
+        self.global_batch = per_device_batch_size * grad_accum_steps * data_parallel_size
+        if self.global_batch > self.n:
+            raise ValueError(
+                f"global batch {self.global_batch} exceeds dataset size {self.n}"
+            )
+        if (per_device_batch_size * data_parallel_size) % process_count:
+            raise ValueError(
+                f"batch {per_device_batch_size}x{data_parallel_size} not divisible "
+                f"by {process_count} hosts"
+            )
+        self.per_host_batch = per_device_batch_size * data_parallel_size // process_count
+        host_lo = process_index * self.per_host_batch
+
+        self._handle = lib.sft_loader_create(
+            _i32p(self._ids), _i32p(self._lm), _i32p(self._am),
+            self.n, self.seq, self.global_batch, self.grad_accum,
+            self.per_host_batch, host_lo, seed,
+            1 if shuffle else 0, 1 if drop_last else 0, queue_depth,
+        )
+        if not self._handle:
+            raise RuntimeError("sft_loader_create rejected its arguments")
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return int(self._lib.sft_loader_steps_per_epoch(self._handle))
+
+    def epoch_order(self, epoch_idx: int) -> np.ndarray:
+        """The full deterministic permutation for one epoch (testing/debug)."""
+        out = np.empty(self.n, dtype=np.int64)
+        self._lib.sft_loader_epoch_order(
+            self._handle, epoch_idx, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        )
+        return out
+
+    def epoch(self, epoch_idx: int) -> Iterator[Dict[str, np.ndarray]]:
+        self._lib.sft_loader_start_epoch(self._handle, epoch_idx)
+        shape = (self.grad_accum, self.per_host_batch, self.seq)
+        while True:
+            ids = np.empty(shape, dtype=np.int32)
+            lm = np.empty(shape, dtype=np.int32)
+            am = np.empty(shape, dtype=np.int32)
+            ok = self._lib.sft_loader_next(self._handle, _i32p(ids), _i32p(lm), _i32p(am))
+            if not ok:
+                return
+            yield {"input_ids": ids, "loss_mask": lm, "attention_mask": am}
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.sft_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

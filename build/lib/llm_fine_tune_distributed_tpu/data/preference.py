@@ -1,0 +1,158 @@
+"""Preference-pair dataset pipeline for DPO.
+
+BASELINE.json config #4 names "Mistral-7B-Instruct DPO via TRL DPOTrainer ->
+JAX (preference-pair path)". The reference repo itself has no DPO code — TRL's
+``DPOTrainer`` supplies it upstream — so this module is the first-party
+TPU-native equivalent of TRL's preference-data plumbing: prompt/chosen/rejected
+rows tokenized into fixed-length pairs with completion-only logprob masks
+(DPO sums sequence logprobs over completion tokens only).
+
+Accepted on-disk schemas:
+- parquet/JSONL with ``prompt`` / ``chosen`` / ``rejected`` string columns
+  (the TRL DPO convention), or
+- the reference QA schema (``full-question`` / ``answer``, reference
+  ``convert_to_parquet.py:23``), from which pairs are synthesized: chosen =
+  the row's true answer, rejected = the answer of a different row (a seeded
+  derangement) — a mismatched-answer preference set that lets the stock
+  ``data/qa_dataset.parquet`` drive an end-to-end DPO run with no new assets.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.data.dataset import tokenize_example
+from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
+
+
+def synthesize_preference_rows(qa_rows: List[dict], seed: int = 42) -> List[dict]:
+    """QA rows -> preference rows via a seeded answer derangement.
+
+    Fewer than 2 rows cannot form a mismatched pair -> empty list (a tiny
+    validation split must not crash a run the SFT path would accept)."""
+    n = len(qa_rows)
+    if n < 2:
+        return []
+    rng = np.random.RandomState(seed)
+    shift = int(rng.randint(1, n))  # rotating by 1..n-1 is a derangement
+    return [
+        {
+            "prompt": row["full-question"],
+            "chosen": row["answer"],
+            "rejected": qa_rows[(i + shift) % n]["answer"],
+        }
+        for i, row in enumerate(qa_rows)
+    ]
+
+
+def load_rows(path: str) -> List[dict]:
+    """Read raw rows (any schema) from a parquet or JSONL file."""
+    if path.endswith(".jsonl"):
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    else:
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path)
+        names = table.column_names
+        rows = [
+            {name: col for name, col in zip(names, vals)}
+            for vals in zip(*(table.column(n).to_pylist() for n in names))
+        ]
+    if not rows:
+        raise ValueError(f"empty preference dataset: {path}")
+    return rows
+
+
+def preference_schema(rows: List[dict]) -> str:
+    """'preference' (prompt/chosen/rejected) or 'qa' (full-question/answer)."""
+    cols = set(rows[0])
+    if {"prompt", "chosen", "rejected"} <= cols:
+        return "preference"
+    if {"full-question", "answer"} <= cols:
+        return "qa"
+    raise ValueError(
+        f"unrecognized preference schema {sorted(cols)}; expected "
+        "prompt/chosen/rejected or full-question/answer"
+    )
+
+
+def load_preference_dataset(path: str, seed: int = 42) -> List[dict]:
+    """Read preference rows from parquet/JSONL; synthesize from QA schema.
+
+    NOTE: synthesis here rotates answers across the WHOLE file. Training code
+    must split train/validation BEFORE synthesizing (as DPOTrainer does) so a
+    validation pair's rejected text is never a train pair's chosen text.
+    """
+    rows = load_rows(path)
+    if preference_schema(rows) == "qa":
+        return synthesize_preference_rows(rows, seed=seed)
+    return rows
+
+
+def build_dpo_arrays(
+    rows: List[dict],
+    tokenizer,
+    max_seq_length: int,
+    system_prompt: str = WILDERNESS_EXPERT_SYSTEM_PROMPT,
+) -> Dict[str, np.ndarray]:
+    """Tokenize preference rows into stacked chosen_*/rejected_* arrays.
+
+    Both completions share the identical prompt tokens, and the loss masks are
+    completion-only: the DPO sequence logprob is the sum over assistant tokens
+    (the prompt term cancels between policy and reference anyway; masking it
+    matches TRL and keeps the implicit-reward magnitudes interpretable).
+    """
+    keys = (
+        "chosen_input_ids", "chosen_loss_mask", "chosen_attention_mask",
+        "rejected_input_ids", "rejected_loss_mask", "rejected_attention_mask",
+    )
+    if not rows:  # empty split (e.g. singleton validation set) -> empty arrays
+        return {
+            k: np.zeros((0, max_seq_length), np.int32 if "input_ids" in k else np.float32)
+            for k in keys
+        }
+    out = {k: [] for k in keys}
+    for row in rows:
+        for side in ("chosen", "rejected"):
+            messages = [
+                {"role": "system", "content": system_prompt},
+                {"role": "user", "content": row["prompt"]},
+                {"role": "assistant", "content": row[side]},
+            ]
+            ex = tokenize_example(
+                messages, tokenizer, max_seq_length, completion_only=True
+            )
+            attn = (np.arange(max_seq_length) < ex.length).astype(np.float32)
+            out[f"{side}_input_ids"].append(ex.input_ids)
+            out[f"{side}_loss_mask"].append(ex.loss_mask)
+            out[f"{side}_attention_mask"].append(attn)
+    arrays = {k: np.stack(v) for k, v in out.items()}
+    # A pair whose completion was truncated away (prompt >= max_seq_length)
+    # has an all-zero mask and contributes zero gradient — silently training
+    # on nothing. Fail loudly instead.
+    dead = (
+        (arrays["chosen_loss_mask"].sum(-1) == 0)
+        | (arrays["rejected_loss_mask"].sum(-1) == 0)
+    )
+    if dead.all():
+        raise ValueError(
+            f"every preference pair lost its completion to truncation at "
+            f"max_seq_length={max_seq_length}; raise the limit or shorten the "
+            f"system prompt ({len(system_prompt)} chars)"
+        )
+    if dead.any():
+        import warnings
+
+        warnings.warn(
+            f"{int(dead.sum())}/{len(dead)} preference pairs have truncated "
+            f"completions (zero loss mask) at max_seq_length={max_seq_length}"
+        )
+    return arrays

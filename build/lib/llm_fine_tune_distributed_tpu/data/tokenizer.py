@@ -1,0 +1,115 @@
+"""Tokenization for SFT: HF tokenizers on host (framework-neutral, as in the
+reference ``training.py:92-94``) plus a dependency-free byte-level ChatML
+tokenizer used by tests and offline demos (no Hub access required).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class ByteChatMLTokenizer:
+    """Byte-level tokenizer with ChatML special tokens.
+
+    Vocab: 256 raw bytes, then specials. Implements the subset of the HF
+    tokenizer interface the framework uses (``apply_chat_template``,
+    ``__call__``/encode, ``decode``, eos/pad ids), so the whole training and
+    inference stack runs hermetically (tests, CI, zero-egress environments).
+    """
+
+    IM_START = 256
+    IM_END = 257
+    BOS = 258
+    EOS = 257  # ChatML convention: <|im_end|> terminates a turn
+    _ROLE_OFFSET = 259  # system / user / assistant role tokens
+
+    ROLES = ("system", "user", "assistant")
+
+    MARKER_FILE = "byte_chatml_tokenizer.json"
+
+    def __init__(self, vocab_size: int = 512):
+        assert vocab_size >= 262
+        self.vocab_size = vocab_size
+        self.eos_token_id = self.EOS
+        self.pad_token_id = self.EOS  # pad = eos, reference training.py:93
+        self.eos_token = "<|im_end|>"
+        self.pad_token = "<|im_end|>"
+        self.name_or_path = "byte-chatml"
+
+    def save_pretrained(self, path: str) -> None:
+        """Marker file so infer.load_tokenizer_dir can reconstruct this
+        tokenizer from a saved model directory."""
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, self.MARKER_FILE), "w") as f:
+            json.dump({"tokenizer_class": "ByteChatMLTokenizer", "vocab_size": self.vocab_size}, f)
+
+    # -- core text <-> ids
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_special_tokens:
+            ids = [self.BOS] + ids
+        return ids
+
+    def __call__(self, text: str, **kw):
+        return {"input_ids": self.encode(text)}
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        out = []
+        for i in ids:
+            i = int(i)
+            if i < 256:
+                out.append(i)
+            elif not skip_special_tokens:
+                token = {
+                    self.IM_START: b"<|im_start|>",
+                    self.IM_END: b"<|im_end|>",
+                    self.BOS: b"<|bos|>",
+                }.get(i, f"<|{i}|>".encode())
+                out.extend(token)
+        return bytes(out).decode("utf-8", errors="replace")
+
+    def _role_id(self, role: str) -> int:
+        return self._ROLE_OFFSET + self.ROLES.index(role)
+
+    # -- chat template (ChatML)
+
+    def apply_chat_template(
+        self,
+        messages,
+        tokenize: bool = True,
+        add_generation_prompt: bool = False,
+        **kw,
+    ):
+        ids: List[int] = []
+        for m in messages:
+            ids.append(self.IM_START)
+            ids.append(self._role_id(m["role"]))
+            ids.extend(self.encode(m["content"]))
+            ids.append(self.IM_END)
+        if add_generation_prompt:
+            ids.append(self.IM_START)
+            ids.append(self._role_id("assistant"))
+        if tokenize:
+            return ids
+        return self.decode(ids, skip_special_tokens=False)
+
+
+def load_tokenizer(name_or_path: Optional[str]):
+    """Load a tokenizer: HF AutoTokenizer for real runs; the byte tokenizer
+    for ``byte-chatml``/None (hermetic mode).
+
+    Mirrors reference setup: pad token = eos, right padding
+    (reference ``training.py:92-94``)."""
+    if name_or_path in (None, "byte-chatml"):
+        return ByteChatMLTokenizer()
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name_or_path)
+    if tok.pad_token is None:
+        tok.pad_token = tok.eos_token
+    tok.padding_side = "right"
+    return tok

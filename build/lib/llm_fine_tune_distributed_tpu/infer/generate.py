@@ -1,0 +1,416 @@
+"""Autoregressive generation: jitted prefill + ``lax.while_loop`` KV-cache
+decode — the TPU-native replacement for the reference's ``model.generate``
+call (reference ``ask_tuned_model.py:55-65``). The whole decode loop is ONE
+XLA program; prompt lengths are bucketed so recompiles are rare.
+
+Layout invariant: decoded token *t* is written at cache slot
+``prompt_len + t``, so cache-slot index == logical position and the causal
+mask over the fixed-size buffer needs no separate validity tracking (pad
+slots written during prefill sit at positions > query position until
+overwritten, hence always masked).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import partial
+from types import SimpleNamespace
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig, sample_token
+from llm_fine_tune_distributed_tpu.models.transformer import forward, init_cache, unembed
+
+_PROMPT_BUCKET = 256
+
+
+class Generator:
+    """Single-host generation engine over a params pytree."""
+
+    def __init__(
+        self,
+        params,
+        model_config: ModelConfig,
+        tokenizer,
+        compute_dtype=jnp.bfloat16,
+        eos_token_ids: Optional[Sequence[int]] = None,
+    ):
+        self.params = params
+        self.config = model_config
+        self.tokenizer = tokenizer
+        self.compute_dtype = compute_dtype
+        eos = eos_token_ids
+        if eos is None:
+            eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
+        self.eos_token_ids = tuple(int(e) for e in eos)
+        self._jit_cache = {}
+        # sequential-forward count of the last speculative run (telemetry;
+        # None when the last call took the plain batch path)
+        self.last_spec_steps: Optional[int] = None
+
+    # ------------------------------------------------------------- jit build
+
+    def _build_batch(self, batch: int, prompt_bucket: int, gen: GenerationConfig):
+        """Compile one (batch, prompt_bucket, generation-config)
+        specialization with per-row prompt lengths (ragged batches).
+
+        Right-padded prompts prefill the whole bucket; row *i*'s decoded
+        token *t* is written at cache slot ``len_i + t`` (vector ``cache_pos``
+        — progressively overwriting that row's pad slots), so the cache
+        slot == logical position invariant holds per row and un-overwritten
+        pad slots sit at positions > any query, hence always masked. Greedy
+        decode of a batched row is bit-identical to running that prompt
+        alone (the single-prompt path IS the batch-of-1 case); SAMPLED rows
+        draw from a batched RNG stream, so row i > 0 sees different (still
+        seeded/deterministic) noise than a solo run would.
+        """
+        mc = self.config
+        dtype = self.compute_dtype
+        buf_len = prompt_bucket + gen.max_new_tokens
+        eos = jnp.asarray(self.eos_token_ids, jnp.int32) if self.eos_token_ids else None
+
+        def step_logits(params, token_ids, cache, cache_pos):
+            hidden, cache = forward(
+                params, token_ids, mc, cache=cache, cache_pos=cache_pos,
+                compute_dtype=dtype, output_hidden=True,
+            )
+            logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype)
+            return logits, cache
+
+        @jax.jit
+        def run(params, prompt_ids, prompt_lens, rng):
+            b, pb = prompt_ids.shape
+            cache = init_cache(mc, b, buf_len, dtype=dtype)
+
+            hidden, cache = forward(
+                params, prompt_ids, mc, cache=cache, cache_pos=0,
+                compute_dtype=dtype, output_hidden=True,
+            )
+            last_h = jnp.take_along_axis(
+                hidden, (prompt_lens - 1)[:, None, None], axis=1
+            )[:, 0]
+            logits0 = unembed(params, last_h, mc, compute_dtype=dtype)
+
+            valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
+            safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
+            seen = jnp.zeros((b, mc.vocab_size), bool).at[
+                jnp.arange(b)[:, None], safe_ids
+            ].set(True)
+
+            rng, sub = jax.random.split(rng)
+            first = sample_token(sub, logits0, seen, gen)
+            out = jnp.zeros((b, gen.max_new_tokens), jnp.int32)
+            out = out.at[:, 0].set(first)
+            done = jnp.isin(first, eos) if eos is not None else jnp.zeros((b,), bool)
+            seen = seen.at[jnp.arange(b), first].set(True)
+
+            def cond(c):
+                t, _, _, _, done, _ = c
+                return (t < gen.max_new_tokens) & ~done.all()
+
+            def body(c):
+                t, cache, out, seen, done, rng = c
+                last = jax.lax.dynamic_index_in_dim(out, t - 1, axis=1)
+                logits, cache = step_logits(
+                    params, last, cache, prompt_lens + (t - 1)
+                )
+                rng, sub = jax.random.split(rng)
+                nxt = sample_token(sub, logits, seen, gen)
+                hit_eos = jnp.isin(nxt, eos) if eos is not None else jnp.zeros((b,), bool)
+                nxt = jnp.where(done, nxt * 0 + (eos[0] if eos is not None else 0), nxt)
+                out = out.at[:, t].set(nxt)
+                seen = seen.at[jnp.arange(b), nxt].set(True)
+                return (t + 1, cache, out, seen, done | hit_eos, rng)
+
+            t, cache, out, seen, done, rng = jax.lax.while_loop(
+                cond, body, (jnp.int32(1), cache, out, seen, done, rng)
+            )
+            return out, t
+
+        return run
+
+    def _build_spec(self, prompt_bucket: int, gen: GenerationConfig):
+        """Compile the prompt-lookup speculative greedy decoder (batch 1).
+
+        Each step feeds ``[cur, d_1..d_K]`` (K = ``gen.speculative_lookup``
+        drafts found by matching the newest bigram earlier in the context)
+        through ONE forward at cache slots ``pos-1 .. pos+K-1`` and accepts
+        the longest prefix of drafts that match the model's own greedy
+        choices. Algorithmically this IS plain greedy decode (bit-exact in
+        f32 — tests/test_generate.py); in bf16 the (K+1)-token verify can
+        resolve a near-tie differently than the 1-token step, so outputs may
+        diverge at tie points exactly as any chunked-verify speculative
+        decoder's do. Pays off when the OUTPUT repeats n-grams from the
+        context (extractive QA, code, summaries); on low-repetition text the
+        K+1-wide verify is pure overhead — hence opt-in, default off.
+        Rollback is free under the slot == position invariant: the next
+        step's writes start at the last accepted position, overwriting every
+        slot a rejected draft touched before any query can see it.
+        """
+        mc = self.config
+        dtype = self.compute_dtype
+        K = gen.speculative_lookup
+        max_new = gen.max_new_tokens
+        buf_len = prompt_bucket + max_new + K + 1
+        eos = jnp.asarray(self.eos_token_ids, jnp.int32) if self.eos_token_ids else None
+
+        @jax.jit
+        def run(params, prompt_ids, prompt_lens, rng):
+            del rng  # greedy
+            prompt_len = prompt_lens[0]
+            b, pb = prompt_ids.shape  # b == 1
+            cache = init_cache(mc, b, buf_len, dtype=dtype)
+
+            hidden, cache = forward(
+                params, prompt_ids, mc, cache=cache, cache_pos=0,
+                compute_dtype=dtype, output_hidden=True,
+            )
+            last_h = jnp.take_along_axis(
+                hidden, (prompt_len - 1)[None, None, None], axis=1
+            )[:, 0]
+            logits0 = unembed(params, last_h, mc, compute_dtype=dtype)
+
+            valid = jnp.arange(pb)[None, :] < prompt_len
+            safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
+            seen = jnp.zeros((b, mc.vocab_size), bool).at[
+                jnp.arange(b)[:, None], safe_ids
+            ].set(True)
+
+            # token history: prompt + generated, in logical positions
+            ids_buf = jnp.zeros((buf_len,), jnp.int32)
+            ids_buf = jax.lax.dynamic_update_slice(
+                ids_buf, jnp.where(valid, prompt_ids, 0)[0], (0,)
+            )
+
+            first = sample_token(None, logits0, seen, gen)[0]
+            ids_buf = ids_buf.at[prompt_len].set(first)
+            seen = seen.at[0, first].set(True)
+            done = jnp.isin(first, eos) if eos is not None else jnp.bool_(False)
+            n_gen = jnp.int32(1)
+
+            def body(c):
+                n_gen, cache, ids_buf, seen, done, n_steps = c
+                pos = prompt_len + n_gen  # position of the next token
+
+                # --- draft: most recent earlier occurrence of the newest bigram
+                last2 = jax.lax.dynamic_slice(ids_buf, (pos - 2,), (2,))
+                j = jnp.arange(buf_len - 1)
+                match = (
+                    (ids_buf[:-1] == last2[0])
+                    & (ids_buf[1:] == last2[1])
+                    & (j < pos - 2)
+                )
+                j_star = jnp.max(jnp.where(match, j, -1))
+                # garbage drafts are harmless: acceptance re-derives every
+                # token from the model's own greedy choice
+                start = jnp.clip(j_star + 2, 0, buf_len - K)
+                draft = jax.lax.dynamic_slice(ids_buf, (start,), (K,))
+
+                cur = ids_buf[pos - 1]
+                inputs = jnp.concatenate([cur[None], draft])[None, :]  # [1, K+1]
+                hidden, new_cache = forward(
+                    params, inputs, mc, cache=cache, cache_pos=pos - 1,
+                    compute_dtype=dtype, output_hidden=True,
+                )
+                logits_all = unembed(params, hidden[0], mc, compute_dtype=dtype)
+
+                # --- sequential greedy verify (evolving repetition-penalty set)
+                def verify(i, v):
+                    seen, ids_buf, n_acc, active, done = v
+                    tok = sample_token(None, logits_all[i][None], seen, gen)[0]
+                    take = active & ~done & (n_gen + i < max_new)
+                    seen = jnp.where(take, seen.at[0, tok].set(True), seen)
+                    ids_buf = jnp.where(
+                        take, ids_buf.at[pos + i].set(tok), ids_buf
+                    )
+                    n_acc = n_acc + jnp.where(take, 1, 0)
+                    hit = jnp.isin(tok, eos) if eos is not None else jnp.bool_(False)
+                    done = done | (take & hit)
+                    # token i+1 is valid only if draft i matched the choice
+                    # (the last slot K has no following draft to validate)
+                    active = active & (
+                        (i >= K) | (draft[jnp.minimum(i, K - 1)] == tok)
+                    )
+                    return (seen, ids_buf, n_acc, active, done)
+
+                seen, ids_buf, n_acc, _, done = jax.lax.fori_loop(
+                    0, K + 1, lambda i, v: verify(i, v),
+                    (seen, ids_buf, jnp.int32(0), jnp.bool_(True), done),
+                )
+                return (n_gen + n_acc, new_cache, ids_buf, seen, done, n_steps + 1)
+
+            def cond(c):
+                n_gen, _, _, _, done, _ = c
+                return (n_gen < max_new) & ~done
+
+            n_gen, cache, ids_buf, seen, done, n_steps = jax.lax.while_loop(
+                cond, body, (n_gen, cache, ids_buf, seen, done, jnp.int32(1))
+            )
+            out = jax.lax.dynamic_slice(ids_buf, (prompt_len,), (max_new,))
+            # n_steps counts sequential forwards (prefill + spec steps);
+            # n_steps < n_gen proves multi-token acceptance
+            return out[None, :], n_gen, n_steps
+
+        return run
+
+    def generate_batch(
+        self,
+        prompts: Sequence[Sequence[int]],
+        gen: Optional[GenerationConfig] = None,
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Generate continuations for a ragged batch of prompts in ONE device
+        program — the weight stream (the batch-1 decode bottleneck) is read
+        once per step for the whole batch."""
+        gen = gen or GenerationConfig()
+        prompts = [list(p) for p in prompts]
+        if not prompts or any(not p for p in prompts):
+            raise ValueError("generate_batch needs >= 1 non-empty prompt")
+        longest = max(len(p) for p in prompts)
+        bucket = -(-longest // _PROMPT_BUCKET) * _PROMPT_BUCKET
+        # prompt-lookup speculation: greedy, batch-1 (the latency case)
+        speculate = (
+            gen.speculative_lookup > 0 and not gen.do_sample and len(prompts) == 1
+        )
+        if speculate:
+            key = ("spec", bucket, gen)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_spec(bucket, gen)
+        else:
+            # normalize the unused speculation knob out of the cache key so a
+            # sampled/multi-prompt fallback reuses the plain batch program
+            # instead of compiling a behaviorally identical copy
+            import dataclasses
+
+            gen = dataclasses.replace(gen, speculative_lookup=0)
+            key = ("batch", len(prompts), bucket, gen)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_batch(len(prompts), bucket, gen)
+        run = self._jit_cache[key]
+
+        padded = np.zeros((len(prompts), bucket), np.int32)
+        lens = np.zeros((len(prompts),), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+            lens[i] = len(p)
+        res = run(
+            self.params, jnp.asarray(padded), jnp.asarray(lens),
+            jax.random.PRNGKey(seed),
+        )
+        out, n = res[0], res[1]  # spec path also returns n_steps at res[2]
+        self.last_spec_steps = int(res[2]) if len(res) > 2 else None
+        out = np.asarray(out)
+        results: List[List[int]] = []
+        for row in out:
+            toks = row.tolist()
+            if speculate:
+                # slots past the accepted count hold rejected-draft leftovers
+                toks = toks[: int(n)]
+            for i, tok in enumerate(toks):
+                if tok in self.eos_token_ids:
+                    toks = toks[:i]
+                    break
+            results.append(toks)
+        return results
+
+    # -------------------------------------------------------------- generate
+
+    def generate_ids(
+        self,
+        prompt_ids: Sequence[int],
+        gen: Optional[GenerationConfig] = None,
+        seed: int = 0,
+    ) -> List[int]:
+        """Generate continuation token ids for one prompt (= batch of 1)."""
+        return self.generate_batch([prompt_ids], gen, seed)[0]
+
+    def encode_chat(self, messages: List[dict], **template_kwargs) -> List[int]:
+        """ChatML conversation -> prompt token ids (generation prompt added).
+
+        Shared by ``chat`` and the serving path (infer/server.py submits the
+        ids through the batching engine) so prompt construction cannot
+        diverge between the CLI and the server."""
+        return self.tokenizer.apply_chat_template(
+            messages, tokenize=True, add_generation_prompt=True, **template_kwargs
+        )
+
+    def decode_reply(self, ids: Sequence[int]) -> str:
+        """Generated ids -> assistant reply text (shared with the server)."""
+        return self.tokenizer.decode(list(ids), skip_special_tokens=True).strip()
+
+    def chat(
+        self,
+        messages: List[dict],
+        gen: Optional[GenerationConfig] = None,
+        seed: int = 0,
+        **template_kwargs,
+    ) -> str:
+        """ChatML conversation -> assistant reply text.
+
+        The reference recovers the assistant turn by scanning the decoded full
+        text for ``<|im_start|>assistant`` markers (reference
+        ``ask_tuned_model.py:69-92``) because HF returns prompt+completion;
+        here only the generated ids are decoded, which is the same extraction
+        without the string fragility.
+        """
+        ids = self.generate_ids(self.encode_chat(messages, **template_kwargs), gen, seed)
+        return self.decode_reply(ids)
+
+
+# ---------------------------------------------------------------------------
+# model-directory loading (the inference-side artifact contract)
+# ---------------------------------------------------------------------------
+
+
+def load_model_dir(path: str, dtype=None) -> Tuple[dict, ModelConfig]:
+    """Load a model directory (``best_model/`` emitted by the trainer, or any
+    local HF Llama-family checkpoint) into (params, ModelConfig).
+
+    Mirrors the reference inference entry (``ask_tuned_model.py:15-35``):
+    ``config.json`` describes the architecture; weights come from
+    ``*.safetensors``. ``dtype=None`` keeps the checkpoint's stored dtype
+    (bf16 for trainer-emitted ``best_model/`` — upcasting a 3B model to f32
+    would not fit a 16GB chip beside its KV cache).
+    """
+    from llm_fine_tune_distributed_tpu.models.configs import from_hf_config
+    from llm_fine_tune_distributed_tpu.models.hf_io import load_hf_checkpoint
+
+    cfg_path = os.path.join(path, "config.json")
+    if not os.path.exists(cfg_path):
+        raise FileNotFoundError(f"no config.json under {path}")
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    model_config = from_hf_config(SimpleNamespace(**raw))
+    params = load_hf_checkpoint(path, model_config, dtype=dtype)
+    return params, model_config
+
+
+def load_tokenizer_dir(path: str):
+    """Tokenizer saved beside the weights.
+
+    Resolution order: the hermetic byte tokenizer's marker file (written by
+    its ``save_pretrained``), then HF tokenizer files, else raise — a silent
+    byte-tokenizer fallback against a 128k-vocab model would emit garbage.
+    """
+    from llm_fine_tune_distributed_tpu.data.tokenizer import (
+        ByteChatMLTokenizer,
+        load_tokenizer,
+    )
+
+    if os.path.exists(os.path.join(path, ByteChatMLTokenizer.MARKER_FILE)):
+        return load_tokenizer("byte-chatml")
+    has_hf_tok = any(
+        os.path.exists(os.path.join(path, f))
+        for f in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model")
+    )
+    if not has_hf_tok:
+        raise FileNotFoundError(
+            f"no tokenizer files under {path} (expected tokenizer.json / "
+            f"tokenizer_config.json / tokenizer.model, or the byte-chatml marker)"
+        )
+    return load_tokenizer(path)

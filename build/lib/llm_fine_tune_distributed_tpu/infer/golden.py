@@ -1,0 +1,112 @@
+"""Golden-question behavioral eval.
+
+The reference's end-to-end quality check is manual: ask 5 canonical
+wilderness questions to the tuned and original models under the identical
+system prompt and compare (reference ``README.md:15-21``; SURVEY.md §4
+"golden-question behavioral eval"). This harness makes that a program:
+run both models over the question set, collect answers + simple lexical
+stats, and emit a side-by-side report (JSON + stdout).
+
+The questions are the reference's five from README.md:17-21.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional
+
+GOLDEN_QUESTIONS: List[str] = [
+    # reference README.md:17-21
+    "How many cups are in a gallon?",
+    "What's the best way to purify water in the wilderness?",
+    "How do I build an emergency shelter?",
+    "What should I do if I encounter a bear?",
+    "How do I start a fire without matches?",
+]
+
+
+@dataclass
+class GoldenAnswer:
+    question: str
+    answer: str
+    n_tokens: int
+    n_chars: int
+
+
+def run_golden_eval(
+    generator,
+    *,
+    questions: Optional[List[str]] = None,
+    max_new_tokens: int = 256,
+    greedy: bool = True,
+    system_prompt: Optional[str] = None,
+    template_kwargs: Optional[dict] = None,
+) -> List[GoldenAnswer]:
+    """Answer every golden question with one Generator."""
+    from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
+    from llm_fine_tune_distributed_tpu.infer import GenerationConfig
+
+    cfg = GenerationConfig(max_new_tokens=max_new_tokens, do_sample=not greedy)
+    out = []
+    for q in questions or GOLDEN_QUESTIONS:
+        messages = [
+            {"role": "system", "content": system_prompt or WILDERNESS_EXPERT_SYSTEM_PROMPT},
+            {"role": "user", "content": q},
+        ]
+        answer = generator.chat(messages, cfg, seed=0, **(template_kwargs or {}))
+        out.append(
+            GoldenAnswer(
+                question=q,
+                answer=answer,
+                n_tokens=len(generator.tokenizer.encode(answer)),
+                n_chars=len(answer),
+            )
+        )
+    return out
+
+
+def compare_golden(
+    tuned: List[GoldenAnswer], original: List[GoldenAnswer]
+) -> Dict[str, object]:
+    """Side-by-side report. The tuned/original answers MUST differ for the
+    fine-tune to have had an effect — that divergence is the signal the
+    reference checks by hand."""
+    rows = []
+    n_diff = 0
+    for t, o in zip(tuned, original):
+        differs = t.answer.strip() != o.answer.strip()
+        n_diff += differs
+        rows.append(
+            {
+                "question": t.question,
+                "tuned": asdict(t),
+                "original": asdict(o),
+                "answers_differ": differs,
+            }
+        )
+    return {
+        "n_questions": len(rows),
+        "n_answers_differ": n_diff,
+        "rows": rows,
+    }
+
+
+def save_report(report: Dict[str, object], path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+
+
+def print_report(report: Dict[str, object], max_chars: int = 400) -> None:
+    for row in report["rows"]:
+        print("=" * 72)
+        print(f"Q: {row['question']}")
+        print(f"--- tuned ({row['tuned']['n_tokens']} tokens):")
+        print(row["tuned"]["answer"][:max_chars])
+        print(f"--- original ({row['original']['n_tokens']} tokens):")
+        print(row["original"]["answer"][:max_chars])
+    print("=" * 72)
+    print(
+        f"{report['n_answers_differ']}/{report['n_questions']} answers differ "
+        "between tuned and original"
+    )

@@ -1,0 +1,71 @@
+"""Sampling-time logits processing, matching HF ``model.generate`` semantics
+for the knobs the reference CLIs use (reference ``ask_tuned_model.py:56-65``):
+repetition_penalty 1.1 -> temperature 0.6 -> top_k 40 -> top_p 0.95 ->
+categorical sample. Processor order mirrors HF (processors before warpers).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1.0e30
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    """Defaults are the reference's tuned-model sampling parameters
+    (reference ``ask_tuned_model.py:56-65``)."""
+
+    max_new_tokens: int = 3768
+    do_sample: bool = True
+    temperature: float = 0.6
+    top_p: float = 0.95
+    top_k: Optional[int] = 40
+    repetition_penalty: float = 1.1
+    # Prompt-lookup speculative decoding (greedy only): draft this many
+    # tokens per step by matching the latest bigram earlier in the context,
+    # verify them in ONE forward. 0 = off. Same greedy algorithm (bit-exact
+    # in f32; bf16 near-ties at the chunked verify may resolve differently);
+    # worthwhile when outputs repeat context n-grams (extractive QA, code).
+    speculative_lookup: int = 0
+
+
+def apply_repetition_penalty(logits, seen, penalty):
+    """HF semantics: for every token already in the sequence, positive logits
+    are divided by the penalty and negative logits multiplied by it."""
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def sample_token(rng, logits, seen, config: GenerationConfig):
+    """logits [batch, vocab], seen [batch, vocab] bool -> token [batch] int32.
+
+    The whole GenerationConfig is trace-time static (the Generator's jit cache
+    keys on it), so changing ANY knob — including temperature/top_p — compiles
+    a fresh decode program. Fine for CLI use; a parameter-sweep loop should
+    thread these as traced operands instead.
+    """
+    if config.repetition_penalty != 1.0:
+        logits = apply_repetition_penalty(logits, seen, config.repetition_penalty)
+    if not config.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits / jnp.maximum(config.temperature, 1e-6)
+    vocab = logits.shape[-1]
+    k = min(config.top_k or vocab, vocab)
+    vals, idx = jax.lax.top_k(logits, k)  # [batch, k] descending
+    if config.top_p < 1.0:
+        probs = jax.nn.softmax(vals, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p — HF
+        # TopPLogitsWarper, incl. its min_tokens_to_keep=1 guarantee (the
+        # most probable token survives even top_p <= 0)
+        keep = (cum - probs) < config.top_p
+        keep = keep.at[..., 0].set(True)
+        vals = jnp.where(keep, vals, _NEG_INF)
+    choice = jax.random.categorical(rng, vals, axis=-1)  # [batch]
+    return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)

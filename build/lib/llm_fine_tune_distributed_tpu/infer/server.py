@@ -1,0 +1,180 @@
+"""Minimal HTTP serving for the tuned model, with dynamic request batching.
+
+The reference has NO serving server — inference is CLI-only, and
+``examples/openshift-deploy.yaml`` (C21) is an unrelated KServe template kept
+"for a future endpoint" (SURVEY.md §2.1 C21, "not present" list). This
+closes that gap with a dependency-free stdlib server exposing:
+
+  GET  /healthz                      -> 200 "ok" (readiness probe target)
+  POST /v1/generate {"question": .., -> {"answer": ..}
+        optional: "max_new_tokens", "temperature", "top_p", "top_k",
+                  "repetition_penalty", "greedy", "seed", "system_prompt"}
+
+Handlers run on threads; a single worker (infer/batching.BatchingEngine)
+owns the TPU and groups concurrent same-config requests into one device
+batch (batch-1 decode is weight-bandwidth-bound, so a batch of B serves ~B
+requests for one request's HBM traffic). ``--max-batch 1`` restores strict
+serialization.
+Run: ``python -m llm_fine_tune_distributed_tpu.infer.server --model-dir ...``
+or ``ask_tuned_model.py --serve``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def serve(
+    model_dir: str,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    max_batch: int = 8,
+    batch_window_ms: float = 10.0,
+    quantize: str = "none",
+    template_kwargs: Optional[dict] = None,
+) -> None:
+    from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
+    from llm_fine_tune_distributed_tpu.infer import (
+        GenerationConfig,
+        Generator,
+        load_model_dir,
+        load_tokenizer_dir,
+    )
+
+    from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine
+
+    from llm_fine_tune_distributed_tpu.ops.int8 import QUANTIZE_MODES, maybe_quantize
+
+    if quantize not in QUANTIZE_MODES:  # fail fast, before the model load
+        raise ValueError(
+            f"unknown quantize mode {quantize!r} (expected one of {QUANTIZE_MODES})"
+        )
+    print(f"Loading model from {model_dir} ...")
+    params, model_config = load_model_dir(model_dir)
+    params = maybe_quantize(params, quantize)
+    tokenizer = load_tokenizer_dir(model_dir)
+    generator = Generator(params, model_config, tokenizer)
+    engine = BatchingEngine(generator, max_batch=max_batch, window_ms=batch_window_ms)
+    print(f"Model ready (max_batch={max_batch}, quantize={quantize}).")
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict | str) -> None:
+            body = (
+                payload if isinstance(payload, str) else json.dumps(payload)
+            ).encode()
+            self.send_response(code)
+            self.send_header(
+                "Content-Type",
+                "text/plain" if isinstance(payload, str) else "application/json",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            if self.path == "/healthz":
+                self._send(200, "ok")
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/v1/generate":
+                self._send(404, {"error": "not found"})
+                return
+            # Optional fields cast and forwarded only when present, so
+            # GenerationConfig stays the single source of sampling defaults.
+            field_casts = {
+                "max_new_tokens": int,
+                "temperature": float,
+                "top_p": float,
+                "top_k": int,
+                "repetition_penalty": float,
+            }
+            # "speculative": K maps to GenerationConfig.speculative_lookup
+            # (greedy-only prompt-lookup decoding, infer/generate.py)
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise TypeError("body must be a JSON object")
+                question = req["question"]
+                gen_kwargs = {
+                    k: cast(req[k]) for k, cast in field_casts.items() if k in req
+                }
+                if "greedy" in req:
+                    gen_kwargs["do_sample"] = not req["greedy"]
+                if "speculative" in req:
+                    gen_kwargs["speculative_lookup"] = int(req["speculative"])
+                    if gen_kwargs.get("do_sample", True):
+                        raise ValueError("speculative requires greedy: true")
+                seed = int(req.get("seed", 0))
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            gen = GenerationConfig(**gen_kwargs)
+            messages = [
+                {
+                    "role": "system",
+                    "content": req.get("system_prompt", WILDERNESS_EXPERT_SYSTEM_PROMPT),
+                },
+                {"role": "user", "content": question},
+            ]
+            try:
+                # tokenize/decode on the handler thread (Generator's shared
+                # chat helpers, so CLI and server cannot diverge); only the
+                # device work goes through the batching engine's worker
+                prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
+                ids = engine.submit(prompt_ids, gen, seed=seed)
+                answer = generator.decode_reply(ids)
+            except Exception as e:  # surface generation errors as 500s
+                self._send(500, {"error": str(e)})
+                return
+            self._send(200, {"answer": answer})
+
+        def log_message(self, fmt, *args):
+            print(f"[serve] {self.address_string()} {fmt % args}", flush=True)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    print(f"Serving on {host}:{port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(description="Serve the tuned model over HTTP")
+    parser.add_argument(
+        "--model-dir", default=os.environ.get("MODEL_DIR", "outputs/best_model")
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument(
+        "--max-batch", type=int, default=8,
+        help="max concurrent requests grouped into one device batch (1 = serialize)",
+    )
+    parser.add_argument(
+        "--batch-window-ms", type=float, default=10.0,
+        help="how long the batcher waits to fill a group",
+    )
+    parser.add_argument(
+        "--quantize", choices=["none", "int8"], default="none",
+        help="weight-only inference quantization (ops/int8.py)",
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.model_dir):
+        print(f"Error: model directory not found: {args.model_dir!r}")
+        return 1
+    serve(args.model_dir, args.host, args.port, args.max_batch,
+          args.batch_window_ms, args.quantize)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,180 @@
+"""Model presets for the supported decoder families.
+
+The flagship is SmolLM3-3B (the reference's hard-coded model,
+reference ``training.py:54``); the other presets cover the configs named in
+BASELINE.json (Llama-3-8B FSDP, Mistral-7B DPO, Llama-3-70B QLoRA) plus the
+Mixtral MoE family (expert parallelism, ops/moe.py). Values verified against
+the HF ``transformers`` config classes
+(``SmolLM3Config``/``LlamaConfig``/``MistralConfig``/``MixtralConfig``).
+"""
+
+from __future__ import annotations
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+
+
+def _smollm3_no_rope(num_layers: int, interval: int = 4) -> tuple:
+    """SmolLM3 NoPE pattern: every `interval`-th layer (1-indexed) has no RoPE.
+
+    Matches HF ``SmolLM3Config``: ``no_rope_layers[i] = 0 if (i+1) % 4 == 0``.
+    """
+    return tuple(0 if (i + 1) % interval == 0 else 1 for i in range(num_layers))
+
+
+PRESETS = {
+    # Tiny config for unit tests — same structure as SmolLM3 (GQA + NoPE).
+    "tiny": ModelConfig(
+        name="tiny",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=4,
+        num_heads=4,
+        num_kv_heads=2,
+        rope_theta=10_000.0,
+        max_position_embeddings=512,
+        tie_word_embeddings=True,
+        no_rope_layers=_smollm3_no_rope(4),
+    ),
+    # Tiny config with untied embeddings + sliding window (Mistral-style paths).
+    "tiny_mistral": ModelConfig(
+        name="tiny_mistral",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        rope_theta=10_000.0,
+        max_position_embeddings=512,
+        tie_word_embeddings=False,
+        sliding_window=64,
+    ),
+    "smollm3_3b": ModelConfig(
+        name="smollm3_3b",
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=11008,
+        num_layers=36,
+        num_heads=16,
+        num_kv_heads=4,
+        rope_theta=5_000_000.0,  # HuggingFaceTB/SmolLM3-3B release value
+        max_position_embeddings=65536,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        no_rope_layers=_smollm3_no_rope(36),
+    ),
+    "llama3_8b": ModelConfig(
+        name="llama3_8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=500_000.0,
+        max_position_embeddings=8192,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    ),
+    "llama3_70b": ModelConfig(
+        name="llama3_70b",
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        rope_theta=500_000.0,
+        max_position_embeddings=8192,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    ),
+    # Tiny MoE config (Mixtral structure) for unit tests / EP mesh tests.
+    "tiny_moe": ModelConfig(
+        name="tiny_moe",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        rope_theta=10_000.0,
+        max_position_embeddings=512,
+        tie_word_embeddings=False,
+        num_experts=4,
+        num_experts_per_tok=2,
+    ),
+    "mixtral_8x7b": ModelConfig(
+        name="mixtral_8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=1_000_000.0,
+        max_position_embeddings=32768,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        num_experts=8,
+        num_experts_per_tok=2,
+    ),
+    "mistral_7b": ModelConfig(
+        name="mistral_7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=1_000_000.0,  # v0.2+ (v0.1 used 10k + sliding_window=4096)
+        max_position_embeddings=32768,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+    ),
+}
+
+
+def get_preset(name: str) -> ModelConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise KeyError(f"unknown model preset {name!r}; available: {sorted(PRESETS)}")
+
+
+def from_hf_config(hf_config) -> ModelConfig:
+    """Build a ModelConfig from a HF transformers PretrainedConfig.
+
+    Lets users point at any local HF checkpoint directory (``config.json``)
+    for Llama-family models, mirroring the reference's
+    ``AutoModelForCausalLM.from_pretrained`` flexibility
+    (reference ``training.py:97-102``).
+    """
+    g = lambda k, default=None: getattr(hf_config, k, default)
+    no_rope = g("no_rope_layers") or ()
+    return ModelConfig(
+        name=g("model_type", "hf_model"),
+        vocab_size=g("vocab_size"),
+        hidden_size=g("hidden_size"),
+        intermediate_size=g("intermediate_size"),
+        num_layers=g("num_hidden_layers"),
+        num_heads=g("num_attention_heads"),
+        num_kv_heads=g("num_key_value_heads") or g("num_attention_heads"),
+        head_dim=g("head_dim"),
+        rope_theta=g("rope_theta", 10_000.0),
+        max_position_embeddings=g("max_position_embeddings", 4096),
+        rms_norm_eps=g("rms_norm_eps", 1e-6),
+        tie_word_embeddings=bool(g("tie_word_embeddings", False)),
+        attention_bias=bool(g("attention_bias", False)),
+        mlp_bias=bool(g("mlp_bias", False)),
+        no_rope_layers=tuple(no_rope),
+        sliding_window=g("sliding_window") if g("use_sliding_window", True) else None,
+        # MoE (HF MixtralConfig naming). router_aux_loss_coef=0.0 is a
+        # legitimate explicit choice (aux disabled) — only None falls back.
+        num_experts=g("num_local_experts", 0) or 0,
+        num_experts_per_tok=g("num_experts_per_tok", 2) or 2,
+        router_aux_coef=(
+            0.01 if g("router_aux_loss_coef") is None else g("router_aux_loss_coef")
+        ),
+    )

@@ -1,0 +1,229 @@
+"""Two-way HF safetensors <-> params-pytree bridge.
+
+Parity targets in the reference:
+- load base weights from an HF checkpoint (reference ``training.py:97-102``);
+- export the fine-tuned model as safetensors that the inference CLI loads
+  (``trainer.save_model`` -> ``best_model/``, reference ``training.py:310-311``,
+  consumed by ``ask_tuned_model.py:15-35``).
+
+Because the params pytree mirrors HF module paths, the mapping is purely
+mechanical: torch ``Linear.weight [out, in]`` <-> JAX ``kernel [in, out]``
+(transpose); embeddings/norms/biases copy through unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Optional
+
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+
+# Leaves stored transposed relative to torch (Linear weights).
+_KERNEL_LEAF = "kernel"
+
+
+def _flatten(tree, prefix=()):
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out.update(_flatten(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+def _unflatten(flat: Dict[tuple, np.ndarray]):
+    tree: dict = {}
+    for path, v in flat.items():
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = v
+    return tree
+
+
+def pytree_to_hf_state_dict(params) -> Dict[str, np.ndarray]:
+    """params pytree -> {hf_name: numpy array (torch layout)}."""
+    state = {}
+    for path, leaf in _flatten(params).items():
+        arr = np.asarray(leaf)
+        leaf_name = path[-1]
+        if len(path) >= 2 and path[-2] == "experts" and leaf_name in ("w1", "w2", "w3"):
+            # Stacked MoE expert weights [E, in, out] (ops/moe.py) -> HF
+            # Mixtral's per-expert Linears `...experts.<i>.w<n>.weight [out, in]`
+            base = ".".join(path[:-1])
+            for i in range(arr.shape[0]):
+                state[f"{base}.{i}.{leaf_name}.weight"] = np.ascontiguousarray(arr[i].T)
+            continue
+        if leaf_name == _KERNEL_LEAF:
+            hf_name = ".".join(path[:-1]) + ".weight"
+            arr = arr.T
+        elif leaf_name in ("lora_a", "lora_b", "lora_scale"):
+            continue  # adapters exported separately (parallel/lora.py)
+        else:
+            hf_name = ".".join(path)
+        state[hf_name] = np.ascontiguousarray(arr)
+    return state
+
+
+def hf_state_dict_to_pytree(state: Dict[str, np.ndarray], config: ModelConfig, dtype=None):
+    """{hf_name: array} -> params pytree (transposing Linear weights).
+
+    Handles tied embeddings: if the checkpoint carries no ``lm_head.weight``
+    and the config ties embeddings, none is created; if the config does NOT
+    tie but the checkpoint omits lm_head (HF stores tied models without it),
+    raises.
+    """
+    # Names whose final '.weight' is a torch-layout matrix needing transpose.
+    def needs_transpose(name: str) -> bool:
+        return name.endswith(".weight") and any(
+            part in name
+            for part in (
+                "q_proj", "k_proj", "v_proj", "o_proj",
+                "gate_proj", "up_proj", "down_proj", "lm_head",
+                "block_sparse_moe.gate",
+            )
+        )
+
+    expert_re = re.compile(r"^(.*\.experts)\.(\d+)\.(w[123])\.weight$")
+    experts: Dict[tuple, Dict[int, np.ndarray]] = {}
+    flat: Dict[tuple, np.ndarray] = {}
+    for name, arr in state.items():
+        arr = np.asarray(arr)
+        if dtype is not None:
+            arr = arr.astype(dtype)
+        m = expert_re.match(name)
+        if m:
+            # HF Mixtral per-expert Linear [out, in] -> row of the stacked
+            # [E, in, out] leaf (ops/moe.py layout)
+            key = tuple(m.group(1).split(".")) + (m.group(3),)
+            experts.setdefault(key, {})[int(m.group(2))] = np.ascontiguousarray(arr.T)
+            continue
+        if needs_transpose(name):
+            path = tuple(name[: -len(".weight")].split(".")) + (_KERNEL_LEAF,)
+            arr = np.ascontiguousarray(arr.T)
+        else:
+            path = tuple(name.split("."))
+        flat[path] = arr
+    for key, rows in experts.items():
+        n = config.num_experts or (max(rows) + 1)
+        missing = [i for i in range(n) if i not in rows]
+        if missing:
+            raise ValueError(
+                f"checkpoint is missing expert tensors {missing} for "
+                f"{'.'.join(key)} (expected {n} experts)"
+            )
+        if max(rows) + 1 > n:
+            raise ValueError(
+                f"checkpoint has {max(rows) + 1} experts for {'.'.join(key)} "
+                f"but config.num_experts={n}"
+            )
+        flat[key] = np.stack([rows[i] for i in range(n)])
+
+    if config.tie_word_embeddings:
+        flat.pop(("lm_head", _KERNEL_LEAF), None)
+    elif ("lm_head", _KERNEL_LEAF) not in flat:
+        embed = flat.get(("model", "embed_tokens", "weight"))
+        if embed is None:
+            raise ValueError("checkpoint has neither lm_head nor embed_tokens")
+        flat[("lm_head", _KERNEL_LEAF)] = np.ascontiguousarray(embed.T)
+    return _unflatten(flat)
+
+
+# ---------------------------------------------------------------------------
+# safetensors files
+# ---------------------------------------------------------------------------
+
+
+def load_safetensors_dir(path: str) -> Dict[str, np.ndarray]:
+    """Read one or many ``*.safetensors`` files (sharded HF checkpoints use
+    ``model.safetensors.index.json``)."""
+    from safetensors.numpy import load_file
+
+    if os.path.isfile(path):
+        return load_file(path)
+    index = os.path.join(path, "model.safetensors.index.json")
+    state: Dict[str, np.ndarray] = {}
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        for shard in sorted(set(weight_map.values())):
+            state.update(load_file(os.path.join(path, shard)))
+        return state
+    single = os.path.join(path, "model.safetensors")
+    if os.path.exists(single):
+        return load_file(single)
+    shards = sorted(
+        f for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if not shards:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    for shard in shards:
+        state.update(load_file(os.path.join(path, shard)))
+    return state
+
+
+def load_hf_checkpoint(path: str, config: ModelConfig, dtype=np.float32):
+    """Load an HF checkpoint directory (or single file) into a params pytree."""
+    state = load_safetensors_dir(path)
+    # torch bf16 arrives as uint16 view through safetensors.numpy on some
+    # versions; normalize via ml_dtypes if needed.
+    state = {k: _as_float(v) for k, v in state.items()}
+    return hf_state_dict_to_pytree(state, config, dtype=dtype)
+
+
+def _as_float(arr: np.ndarray) -> np.ndarray:
+    if arr.dtype == np.uint16:
+        import ml_dtypes
+
+        return arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+_MAX_SHARD_BYTES = 4 * 1024**3
+
+
+def save_hf_checkpoint(
+    params,
+    path: str,
+    *,
+    metadata: Optional[Dict[str, str]] = None,
+    save_dtype=None,
+):
+    """Write params as HF-layout safetensors under ``path`` (sharding files at
+    4GB like HF does). Produces ``model.safetensors`` or shards + index."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    state = pytree_to_hf_state_dict(params)
+    if save_dtype is not None:
+        state = {k: v.astype(save_dtype) for k, v in state.items()}
+
+    total = sum(v.nbytes for v in state.values())
+    meta = {"format": "pt", **(metadata or {})}
+    if total <= _MAX_SHARD_BYTES:
+        save_file(state, os.path.join(path, "model.safetensors"), metadata=meta)
+        return
+
+    shards: list = [{}]
+    sizes = [0]
+    for name, arr in state.items():
+        if sizes[-1] + arr.nbytes > _MAX_SHARD_BYTES and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][name] = arr
+        sizes[-1] += arr.nbytes
+
+    n = len(shards)
+    weight_map = {}
+    for i, shard in enumerate(shards):
+        fname = f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+        save_file(shard, os.path.join(path, fname), metadata=meta)
+        for name in shard:
+            weight_map[name] = fname
+    with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {"total_size": total}, "weight_map": weight_map}, f, indent=2)

@@ -1,0 +1,532 @@
+"""Llama-family decoder-only transformer as pure JAX functions over a pytree.
+
+Design notes (TPU-first, not a torch translation):
+
+- Params are a plain nested dict whose paths mirror HF checkpoint names
+  (``model.layers.0.self_attn.q_proj`` ...), so safetensors import/export is a
+  rename-free transpose (models/hf_io.py) and sharding rules match on path.
+- No module framework: ``forward`` is a pure function — trivially jittable,
+  shardable with NamedSharding on the params pytree, and rematerializable per
+  block with ``jax.checkpoint`` (the analog of the reference's
+  ``gradient_checkpointing=True``, reference ``training.py:280``).
+- Master params stay float32; compute casts to bfloat16 at use (the MXU path).
+  Softmax/RMSNorm/RoPE run in float32.
+- Covers SmolLM3 (GQA + NoPE-interleaved RoPE + tied embeddings), Llama-3,
+  Mistral (sliding window) via ModelConfig — the model surface of the
+  reference's ``AutoModelForCausalLM`` usage (reference ``training.py:97-102``).
+
+Linear weights are stored in JAX kernel layout ``[in, out]`` under the leaf
+name ``kernel`` (transpose of torch ``weight``); norm/embedding leaves are
+``weight`` in torch layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+from llm_fine_tune_distributed_tpu.ops.attention import attention, xla_attention
+from llm_fine_tune_distributed_tpu.ops.norms import rms_norm
+from llm_fine_tune_distributed_tpu.ops.rope import apply_rope, rope_cos_sin
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
+    """Random init (normal 0.02, HF convention). Returns the params pytree."""
+    h = config.hidden_size
+    d = config.resolved_head_dim
+    qd, kvd = config.num_heads * d, config.num_kv_heads * d
+    f, v = config.intermediate_size, config.vocab_size
+
+    keys = iter(jax.random.split(rng, 2 + config.num_layers * 7))
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
+
+    layers = {}
+    for i in range(config.num_layers):
+        attn = {
+            "q_proj": {"kernel": dense(next(keys), (h, qd))},
+            "k_proj": {"kernel": dense(next(keys), (h, kvd))},
+            "v_proj": {"kernel": dense(next(keys), (h, kvd))},
+            "o_proj": {"kernel": dense(next(keys), (qd, h))},
+        }
+        if config.attention_bias:
+            # HF Llama applies attention_bias to q/k/v/o alike.
+            attn["q_proj"]["bias"] = jnp.zeros((qd,), dtype)
+            attn["k_proj"]["bias"] = jnp.zeros((kvd,), dtype)
+            attn["v_proj"]["bias"] = jnp.zeros((kvd,), dtype)
+            attn["o_proj"]["bias"] = jnp.zeros((h,), dtype)
+        layer = {
+            "input_layernorm": {"weight": jnp.ones((h,), dtype)},
+            "self_attn": attn,
+            "post_attention_layernorm": {"weight": jnp.ones((h,), dtype)},
+        }
+        if config.num_experts > 0:
+            from llm_fine_tune_distributed_tpu.ops.moe import init_moe_params
+
+            # consumes one key (split internally); a model is uniformly MoE
+            # or dense so per-layer key alignment needs no padding
+            layer["block_sparse_moe"] = init_moe_params(next(keys), config, dtype)
+        else:
+            mlp = {
+                "gate_proj": {"kernel": dense(next(keys), (h, f))},
+                "up_proj": {"kernel": dense(next(keys), (h, f))},
+                "down_proj": {"kernel": dense(next(keys), (f, h))},
+            }
+            if config.mlp_bias:
+                mlp["gate_proj"]["bias"] = jnp.zeros((f,), dtype)
+                mlp["up_proj"]["bias"] = jnp.zeros((f,), dtype)
+                mlp["down_proj"]["bias"] = jnp.zeros((h,), dtype)
+            layer["mlp"] = mlp
+        layers[str(i)] = layer
+
+    params: Params = {
+        "model": {
+            "embed_tokens": {"weight": dense(next(keys), (v, h))},
+            "layers": layers,
+            "norm": {"weight": jnp.ones((h,), dtype)},
+        }
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense(next(keys), (h, v))}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _linear(x, p, compute_dtype, quant_impl: str = "auto"):
+    """x @ kernel (+ bias), with optional additive LoRA branch.
+
+    LoRA params, when present (parallel/lora.py), live beside the kernel as
+    ``lora_a [in, r]`` / ``lora_b [r, out]`` and contribute
+    ``(alpha/r) * x @ A @ B`` (external-doc LoRA config: r=16, alpha=8).
+
+    NF4-quantized kernels (QLoRA frozen base, ops/nf4.py) replace ``kernel``
+    with sibling leaves ``kernel_nf4`` (+ absmax scales); the matmul then
+    runs through the fused Pallas decode kernel or the XLA dequant path.
+    Int8 weight-only kernels (inference, ops/int8.py) replace it with
+    ``kernel_int8`` + ``kernel_int8_scale``.
+    """
+    if "kernel_int8" in p:
+        from llm_fine_tune_distributed_tpu.ops.int8 import int8_matmul
+
+        y = int8_matmul(
+            x,
+            {"int8": p["kernel_int8"], "int8_scale": p["kernel_int8_scale"]},
+            compute_dtype=compute_dtype,
+        )
+    elif "kernel_nf4" in p:
+        from llm_fine_tune_distributed_tpu.ops.nf4 import QUANT_SUFFIXES, nf4_matmul
+
+        q = {s: p[f"kernel_{s}"] for s in QUANT_SUFFIXES if f"kernel_{s}" in p}
+        y = nf4_matmul(
+            x.astype(compute_dtype), q, impl=quant_impl, compute_dtype=compute_dtype
+        )
+    else:
+        y = x @ p["kernel"].astype(compute_dtype)
+    if "lora_a" in p:
+        a = p["lora_a"].astype(compute_dtype)
+        b = p["lora_b"].astype(compute_dtype)
+        y = y + (x @ a) @ b * p["lora_scale"].astype(compute_dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(compute_dtype)
+    return y
+
+
+def _block(
+    lp: Params,
+    x,
+    cos,
+    sin,
+    padding_mask,
+    segment_ids,
+    explicit_mask,
+    cache_entry,
+    cache_pos,
+    *,
+    config: ModelConfig,
+    layer_idx: int,
+    attention_impl: str,
+    compute_dtype,
+    mesh=None,
+    quant_impl: str = "auto",
+    rope_flag=None,
+):
+    """One transformer block. Returns (x, new_cache_entry, moe_aux).
+
+    ``rope_flag`` (traced bool scalar) overrides the static
+    ``config.uses_rope(layer_idx)`` decision — used by the pipeline's
+    layer-scan, where the absolute layer index is data, not Python.
+    ``moe_aux`` is the layer's load-balancing loss (f32 scalar; 0.0 for
+    dense models — ``config.num_experts == 0``).
+    """
+    b, s, h = x.shape
+    d = config.resolved_head_dim
+    eps = config.rms_norm_eps
+    attn_p = lp["self_attn"]
+
+    hid = rms_norm(x, lp["input_layernorm"]["weight"], eps)
+    q = _linear(hid, attn_p["q_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_heads, d)
+    k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
+    v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
+
+    if rope_flag is not None:
+        qr, kr = apply_rope(q, k, cos, sin)
+        q = jnp.where(rope_flag, qr, q)
+        k = jnp.where(rope_flag, kr, k)
+    elif config.uses_rope(layer_idx):
+        q, k = apply_rope(q, k, cos, sin)
+
+    new_entry = None
+    if cache_entry is not None:
+        # Decode/prefill with a fixed-size KV buffer: write k,v at cache_pos.
+        # A scalar cache_pos writes the same slots for every row (single
+        # prompt / aligned batch); a [batch] vector writes per-row slots —
+        # ragged batched decode, where row i's token t lives at slot
+        # len_i + t so the slot == position invariant holds per row.
+        if getattr(cache_pos, "ndim", 0) == 1:
+            slots = cache_pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
+            ck = cache_entry["k"].at[jnp.arange(b)[:, None], slots].set(
+                k.astype(cache_entry["k"].dtype)
+            )
+            cv = cache_entry["v"].at[jnp.arange(b)[:, None], slots].set(
+                v.astype(cache_entry["v"].dtype)
+            )
+        else:
+            ck = jax.lax.dynamic_update_slice(cache_entry["k"], k.astype(cache_entry["k"].dtype), (0, cache_pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cache_entry["v"], v.astype(cache_entry["v"].dtype), (0, cache_pos, 0, 0))
+        new_entry = {"k": ck, "v": cv}
+        k, v = ck, cv
+
+    if explicit_mask is not None:
+        out = xla_attention(q, k, v, mask=explicit_mask, causal=False)
+    else:
+        out = attention(
+            q,
+            k,
+            v,
+            impl=attention_impl,
+            padding_mask=padding_mask,
+            segment_ids=segment_ids,
+            causal=True,
+            sliding_window=config.sliding_window,
+            mesh=mesh,
+        )
+
+    out = out.reshape(b, s, config.num_heads * d)
+    x = x + _linear(out, attn_p["o_proj"], compute_dtype, quant_impl)
+
+    hid = rms_norm(x, lp["post_attention_layernorm"]["weight"], eps)
+    aux = jnp.float32(0.0)
+    if config.num_experts > 0:
+        from llm_fine_tune_distributed_tpu.ops.moe import moe_mlp
+
+        # token-level real/pad mask for routing: packed batches encode pads
+        # as segment 0; the cache path's padding_mask covers the KV buffer
+        # (wrong length for the current chunk) and is skipped
+        token_mask = None
+        if segment_ids is not None:
+            token_mask = segment_ids > 0
+        elif padding_mask is not None and padding_mask.shape[-1] == s:
+            token_mask = padding_mask
+        moe_out, aux = moe_mlp(
+            lp["block_sparse_moe"], hid, config, compute_dtype, mesh=mesh,
+            token_mask=token_mask,
+            # decode/prefill (KV cache live) is dropless like HF Mixtral:
+            # capacity drops would make outputs depend on batch/chunk shape
+            dropless=cache_entry is not None,
+        )
+        x = x + moe_out
+    else:
+        gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl)
+        up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype, quant_impl)
+        # Named so remat_policy="mlp" can save JUST this [b, s, f] product: the
+        # gate/up matmuls are ~58% of a block's param FLOPs, so saving their
+        # fused output avoids most of full-remat's recompute at one tensor per
+        # layer of extra HBM (vs. two for saving gate and up separately).
+        prod = checkpoint_name(jax.nn.silu(gate) * up, "mlp_act")
+        x = x + _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl)
+    return x, new_entry, aux
+
+
+def forward(
+    params: Params,
+    input_ids,
+    config: ModelConfig,
+    *,
+    positions=None,
+    padding_mask=None,
+    segment_ids=None,
+    cache: Optional[Dict[str, Any]] = None,
+    cache_pos: int | jax.Array = 0,
+    attention_impl: str = "xla",
+    compute_dtype=jnp.bfloat16,
+    remat: bool = False,
+    remat_policy: Optional[str] = None,
+    logits_dtype=jnp.float32,
+    activation_sharding=None,
+    output_hidden: bool = False,
+    quant_impl: str = "auto",
+    return_aux: bool = False,
+) -> (
+    Tuple[jax.Array, Optional[Dict[str, Any]]]
+    | Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]
+):
+    """Run the model.
+
+    Args:
+      input_ids: int32 [batch, seq].
+      positions: int32 [batch, seq] absolute positions (default arange, or
+        cache_pos offset when a cache is passed).
+      padding_mask: [batch, seq] 1=real token (training path).
+      cache: optional KV cache dict (see ``init_cache``); when given,
+        attention runs over the full cache buffer with a position mask.
+      cache_pos: where this chunk starts in the cache — a scalar (all rows
+        aligned) or a [batch] vector for per-row starts (ragged batched
+        decode: row i's slots stay equal to its logical positions).
+      remat: rematerialize each block on backward
+        (analog of reference ``gradient_checkpointing=True``, training.py:280).
+      output_hidden: return the final-norm hidden states [batch, seq, hidden]
+        (in ``compute_dtype``) instead of logits — the chunked-loss path
+        (train/step.py) unembeds chunk-by-chunk so the [batch, seq, vocab]
+        float32 logits tensor never materializes in HBM.
+      return_aux: also return the summed MoE load-balancing loss as a third
+        element ``(out, cache, aux)`` — 0.0 for dense models. The train step
+        requests it when ``config.num_experts > 0``.
+      activation_sharding: optional ``NamedSharding`` for the [batch, seq,
+        hidden] activations (normally batch over (data, fsdp)). Constraining
+        activations explicitly keeps XLA/Shardy propagation on the intended
+        layout — without it, propagation can try to shard the hidden dim with
+        the same axis as the batch dim and fail (or silently pick a slow
+        layout). Set by the trainer whenever a mesh is in use.
+
+    Returns:
+      (logits [batch, seq, vocab] in ``logits_dtype``, updated cache or None).
+    """
+    b, s = input_ids.shape
+    if positions is None:
+        # scalar cache_pos broadcasts; a [batch] vector gives per-row offsets
+        # (ragged batched decode)
+        offset = (
+            cache_pos[:, None] if getattr(cache_pos, "ndim", 0) == 1 else cache_pos
+        )
+        positions = jnp.arange(s, dtype=jnp.int32)[None, :] + offset
+        positions = jnp.broadcast_to(positions, (b, s)).astype(jnp.int32)
+
+    def constrain(h):
+        if activation_sharding is not None:
+            return jax.lax.with_sharding_constraint(h, activation_sharding)
+        return h
+
+    # Sequence parallelism (ring / ulysses) shard_maps over the mesh, and the
+    # MoE dispatch constrains its expert blocks to it; recover the mesh from
+    # the activation sharding so call sites stay unchanged. (The attention
+    # dispatch ignores it for non-sequence-parallel impls.)
+    mesh = None
+    if activation_sharding is not None:
+        mesh = getattr(activation_sharding, "mesh", None)
+
+    embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
+    if mesh is not None and (
+        dict(mesh.shape).get("tensor", 1) > 1 or dict(mesh.shape).get("data", 1) > 1
+    ):
+        # Embedding-lookup layout: shard the table by vocab (tensor, else
+        # fsdp) and gather the hidden dim. FSDP shards the table's hidden dim
+        # with the same mesh axis that shards the ids' batch dim; on tensor>1
+        # or data>1 meshes GSPMD resolves that conflict by replicating the
+        # gather output and repartitioning it ("involuntary full
+        # rematerialization", spmd_partitioner.cc warnings). With the table
+        # vocab-sharded, each device gathers from its vocab shard (masked +
+        # psum) and the output lands directly on the activation layout.
+        # (1, fsdp, 1, *) meshes reshard the (small) gather output cleanly
+        # without help, so they skip this.
+        embed = _lookup_table_constraint(embed, mesh)
+    x = constrain(embed[input_ids])
+    cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
+
+    explicit_mask = None
+    if segment_ids is not None:
+        if cache is not None:
+            raise ValueError("segment_ids (packing) and KV cache are exclusive")
+        # Packed batch (data/packing.py): attention is restricted to equal
+        # segment ids (block-diagonal causal). The segment ids flow into the
+        # attention dispatch so the Pallas flash kernel (which masks by
+        # segment natively) stays usable; only the sliding-window case needs
+        # an explicit mask (window distance uses per-segment positions).
+        if config.sliding_window is not None:
+            idx = jnp.arange(s, dtype=jnp.int32)
+            causal = idx[None, None, :] <= idx[None, :, None]
+            same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
+            explicit_mask = causal & same_seg
+            q_pos, k_pos = positions[:, :, None], positions[:, None, :]
+            explicit_mask &= k_pos > q_pos - config.sliding_window
+            segment_ids = None  # consumed into the explicit mask
+    elif cache is not None:
+        # Mask over the fixed-size buffer: key j visible to query i iff
+        # j <= position(i), and within the sliding window if configured.
+        kv_len = cache["layers"]["0"]["k"].shape[1]
+        k_pos = jnp.arange(kv_len, dtype=jnp.int32)[None, None, :]
+        q_pos = positions[:, :, None]
+        explicit_mask = k_pos <= q_pos
+        if config.sliding_window is not None:
+            explicit_mask &= k_pos > q_pos - config.sliding_window
+        if padding_mask is not None:
+            # With a cache, padding_mask must cover the WHOLE buffer
+            # [batch, kv_len] (1 = real token at that cache slot), so batched
+            # generate over ragged prompts can mask pad keys already written.
+            if padding_mask.shape[-1] != kv_len:
+                raise ValueError(
+                    f"with a KV cache, padding_mask must be [batch, {kv_len}] "
+                    f"(full buffer), got {padding_mask.shape}"
+                )
+            explicit_mask &= padding_mask.astype(bool)[:, None, :]
+
+    new_layers = {}
+    moe_aux = jnp.float32(0.0)
+    for i in range(config.num_layers):
+        entry = cache["layers"][str(i)] if cache is not None else None
+        block_fn = partial(
+            _block,
+            config=config,
+            layer_idx=i,
+            attention_impl=attention_impl,
+            compute_dtype=compute_dtype,
+            mesh=mesh,
+            quant_impl=quant_impl,
+        )
+        if remat and cache is None:
+            if remat_policy in (None, "full"):
+                block_fn = jax.checkpoint(block_fn)
+            else:
+                # Selective remat: save the expensive tensors, recompute the
+                # cheap elementwise ops — trades HBM for less recompute FLOPs
+                # than full-block remat (v5e is compute-bound here).
+                policies = {
+                    "dots": jax.checkpoint_policies.checkpoint_dots,
+                    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    "mlp": jax.checkpoint_policies.save_only_these_names("mlp_act"),
+                }
+                if remat_policy not in policies:
+                    raise ValueError(
+                        f"unknown remat_policy {remat_policy!r}; expected one of "
+                        f"'full', {sorted(policies)}"
+                    )
+                block_fn = jax.checkpoint(block_fn, policy=policies[remat_policy])
+        x, new_entry, layer_aux = block_fn(
+            params["model"]["layers"][str(i)],
+            x,
+            cos,
+            sin,
+            padding_mask,
+            segment_ids,
+            explicit_mask,
+            entry,
+            cache_pos,
+        )
+        x = constrain(x)
+        moe_aux = moe_aux + layer_aux
+        if new_entry is not None:
+            new_layers[str(i)] = new_entry
+
+    x = rms_norm(x, params["model"]["norm"]["weight"], config.rms_norm_eps)
+
+    new_cache = {"layers": new_layers} if cache is not None else None
+    if output_hidden:
+        out = x.astype(compute_dtype)
+    else:
+        out = unembed(
+            params, x, config, compute_dtype=compute_dtype, logits_dtype=logits_dtype, mesh=mesh
+        )
+    if return_aux:
+        return out, new_cache, moe_aux
+    return out, new_cache
+
+
+def _lookup_table_constraint(table, mesh, vocab_dim: int = 0):
+    """Constrain a [vocab, hidden]-shaped (or transposed) weight so only the
+    vocab dim stays sharded and the hidden dim is gathered. Shared by the
+    embedding lookup and the unembed matmul — both places where FSDP's
+    hidden-dim sharding collides with the batch-sharded activations and GSPMD
+    would otherwise fall back to replicate-then-repartition
+    (spmd_partitioner.cc "Involuntary full rematerialization" warnings,
+    VERDICT r1 #1).
+
+    The vocab dim shards over ``tensor`` when live (Megatron layout), else
+    over ``fsdp`` — the table stays distributed either way (never fully
+    replicated for a large-vocab model); GSPMD lowers the lookup to a masked
+    local gather + psum over the vocab shards, with only activation-sized
+    collectives on the hot path."""
+    axes = dict(mesh.shape)
+    vocab_ax = None
+    for ax in ("tensor", "fsdp"):
+        if axes.get(ax, 1) > 1 and table.shape[vocab_dim] % axes[ax] == 0:
+            vocab_ax = ax
+            break
+    spec = [None, None]
+    spec[vocab_dim] = vocab_ax
+    return jax.lax.with_sharding_constraint(
+        table, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))
+    )
+
+
+def unembed(params: Params, hidden, config: ModelConfig, *, compute_dtype=jnp.bfloat16, logits_dtype=jnp.float32, mesh=None):
+    """Project hidden states [..., hidden] -> logits [..., vocab] (tied or not).
+
+    With a ``mesh``, the projection weight is constrained like the embedding
+    lookup table (vocab over ``tensor``, hidden gathered): under FSDP the
+    weight moves to the data, the batch-sharded activations stay put —
+    without this, GSPMD reshards the activations (and their cotangents) to
+    the weight's hidden-dim sharding through a replicate-then-repartition
+    fallback on data>1 meshes."""
+    h = hidden.astype(compute_dtype)
+    if config.tie_word_embeddings:
+        embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
+        if mesh is not None:
+            embed = _lookup_table_constraint(embed, mesh, vocab_dim=0)
+        logits = jnp.einsum("...h,vh->...v", h, embed)
+    else:
+        kernel = params["lm_head"]["kernel"].astype(compute_dtype)
+        if mesh is not None:
+            kernel = _lookup_table_constraint(kernel, mesh, vocab_dim=1)
+        logits = h @ kernel
+    return logits.astype(logits_dtype)
+
+
+def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+    """Fixed-size KV cache buffers for autoregressive decoding."""
+    d = config.resolved_head_dim
+    shape = (batch_size, max_len, config.num_kv_heads, d)
+    return {
+        "layers": {
+            str(i): {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for i in range(config.num_layers)
+        }
+    }
+
+
+class TransformerLM:
+    """Thin OO facade over the functional API (convenience for scripts)."""
+
+    def __init__(self, config: ModelConfig):
+        self.config = config
+
+    def init(self, rng, dtype=jnp.float32) -> Params:
+        return init_params(rng, self.config, dtype)
+
+    def apply(self, params, input_ids, **kw):
+        return forward(params, input_ids, self.config, **kw)

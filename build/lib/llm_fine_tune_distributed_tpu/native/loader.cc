@@ -1,0 +1,201 @@
+// Native batch pipeline: threaded gather + bounded prefetch queue.
+//
+// The reference's input pipeline is HF Trainer's DataLoader (C++-backed via
+// Arrow + torch's pin-memory workers — SURVEY.md §2.3). This is the
+// TPU-framework equivalent: batch assembly (seeded shuffle, per-host shard
+// slicing, row gather into [accum, per_host_batch, seq] staging buffers) runs
+// on background C++ threads so the host-side work overlaps device step time
+// and never contends for the Python GIL.
+//
+// Determinism: the permutation is a Fisher-Yates driven by splitmix64, fully
+// specified here (not std::shuffle, whose distribution is
+// implementation-defined) so every host computes the identical epoch order
+// from (seed + epoch) — the property DistributedSampler's set_epoch gives the
+// reference (docs/single-vs-distributed-comparison.md:395-407).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t& state) {
+  uint64_t z = (state += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// Unbiased bounded draw (Lemire-style rejection on the modulus).
+inline uint64_t bounded(uint64_t& state, uint64_t n) {
+  uint64_t threshold = (0ULL - n) % n;
+  for (;;) {
+    uint64_t r = splitmix64(state);
+    if (r >= threshold) return r % n;
+  }
+}
+
+struct Batch {
+  std::vector<int32_t> ids, lm, am;
+  int64_t step = -1;
+};
+
+}  // namespace
+
+struct SFTLoader {
+  const int32_t *input_ids, *loss_mask, *attention_mask;
+  int64_t n, seq;
+  int64_t global_batch, accum, per_host, host_lo;
+  uint64_t seed;
+  bool shuffle, drop_last;
+  int queue_cap;
+
+  std::vector<int64_t> order;
+  int64_t steps = 0;
+
+  // prefetch machinery
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::queue<Batch> ready;
+  std::atomic<bool> stop{false};
+  int64_t consumed = 0;
+
+  int64_t steps_per_epoch() const {
+    if (drop_last) return n / global_batch;
+    return (n + global_batch - 1) / global_batch;
+  }
+
+  void make_order(int64_t epoch) {
+    order.resize(n);
+    for (int64_t i = 0; i < n; ++i) order[i] = i;
+    if (shuffle) {
+      uint64_t state = seed + static_cast<uint64_t>(epoch);
+      // warm the mixer so small seeds don't correlate across epochs
+      splitmix64(state);
+      for (int64_t i = n - 1; i > 0; --i) {
+        uint64_t j = bounded(state, static_cast<uint64_t>(i + 1));
+        std::swap(order[i], order[static_cast<int64_t>(j)]);
+      }
+    }
+  }
+
+  void assemble(int64_t step, Batch& out) {
+    const int64_t bsz = accum * per_host;
+    out.ids.resize(bsz * seq);
+    out.lm.resize(bsz * seq);
+    out.am.resize(bsz * seq);
+    out.step = step;
+    const int64_t world_batch = global_batch / accum;  // rows per accum slice
+    for (int64_t a = 0; a < accum; ++a) {
+      for (int64_t b = 0; b < per_host; ++b) {
+        // global index within the epoch order, wrap-padded past the end
+        int64_t flat = step * global_batch + a * world_batch + host_lo + b;
+        int64_t src = order[flat % n];
+        int64_t dst = (a * per_host + b) * seq;
+        std::memcpy(&out.ids[dst], input_ids + src * seq, seq * sizeof(int32_t));
+        std::memcpy(&out.lm[dst], loss_mask + src * seq, seq * sizeof(int32_t));
+        std::memcpy(&out.am[dst], attention_mask + src * seq, seq * sizeof(int32_t));
+      }
+    }
+  }
+
+  void run_epoch() {
+    for (int64_t s = 0; s < steps && !stop.load(); ++s) {
+      Batch b;
+      assemble(s, b);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_push.wait(lk, [&] {
+        return stop.load() || static_cast<int>(ready.size()) < queue_cap;
+      });
+      if (stop.load()) return;
+      ready.push(std::move(b));
+      cv_pop.notify_one();
+    }
+  }
+};
+
+extern "C" {
+
+SFTLoader* sft_loader_create(const int32_t* input_ids, const int32_t* loss_mask,
+                             const int32_t* attention_mask, int64_t n, int64_t seq,
+                             int64_t global_batch, int64_t accum, int64_t per_host,
+                             int64_t host_lo, uint64_t seed, int shuffle,
+                             int drop_last, int queue_cap) {
+  if (n <= 0 || seq <= 0 || global_batch <= 0 || accum <= 0 || per_host <= 0)
+    return nullptr;
+  if (global_batch % accum != 0) return nullptr;
+  auto* L = new SFTLoader();
+  L->input_ids = input_ids;
+  L->loss_mask = loss_mask;
+  L->attention_mask = attention_mask;
+  L->n = n;
+  L->seq = seq;
+  L->global_batch = global_batch;
+  L->accum = accum;
+  L->per_host = per_host;
+  L->host_lo = host_lo;
+  L->seed = seed;
+  L->shuffle = shuffle != 0;
+  L->drop_last = drop_last != 0;
+  L->queue_cap = queue_cap > 0 ? queue_cap : 2;
+  return L;
+}
+
+int64_t sft_loader_steps_per_epoch(SFTLoader* L) { return L->steps_per_epoch(); }
+
+// Begin prefetching one epoch; joins any previous epoch's worker first.
+void sft_loader_start_epoch(SFTLoader* L, int64_t epoch) {
+  if (L->worker.joinable()) {
+    L->stop.store(true);
+    L->cv_push.notify_all();
+    L->worker.join();
+  }
+  L->stop.store(false);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    std::queue<Batch>().swap(L->ready);
+    L->consumed = 0;
+  }
+  L->make_order(epoch);
+  L->steps = L->steps_per_epoch();
+  L->worker = std::thread([L] { L->run_epoch(); });
+}
+
+// Blocking pop into caller buffers of [accum*per_host*seq] int32.
+// Returns 1 on success, 0 at epoch end.
+int sft_loader_next(SFTLoader* L, int32_t* ids, int32_t* lm, int32_t* am) {
+  std::unique_lock<std::mutex> lk(L->mu);
+  if (L->consumed >= L->steps) return 0;
+  L->cv_pop.wait(lk, [&] { return !L->ready.empty(); });
+  Batch b = std::move(L->ready.front());
+  L->ready.pop();
+  ++L->consumed;
+  L->cv_push.notify_one();
+  lk.unlock();
+  std::memcpy(ids, b.ids.data(), b.ids.size() * sizeof(int32_t));
+  std::memcpy(lm, b.lm.data(), b.lm.size() * sizeof(int32_t));
+  std::memcpy(am, b.am.data(), b.am.size() * sizeof(int32_t));
+  return 1;
+}
+
+void sft_loader_destroy(SFTLoader* L) {
+  if (!L) return;
+  L->stop.store(true);
+  L->cv_push.notify_all();
+  if (L->worker.joinable()) L->worker.join();
+  delete L;
+}
+
+// Expose the epoch permutation for cross-host determinism tests.
+void sft_loader_epoch_order(SFTLoader* L, int64_t epoch, int64_t* out) {
+  L->make_order(epoch);
+  std::memcpy(out, L->order.data(), L->order.size() * sizeof(int64_t));
+}
+
+}  // extern "C"

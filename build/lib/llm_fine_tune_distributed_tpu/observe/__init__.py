@@ -1,0 +1,2 @@
+from llm_fine_tune_distributed_tpu.observe.metrics import MetricLogger  # noqa: F401
+from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter  # noqa: F401

@@ -1,0 +1,117 @@
+"""Metric logging — the three-sink design of the reference (SURVEY.md §5.5):
+
+1. stdout stage prints (kubectl-logs consumption, reference ``README.md:31-40``);
+2. accumulated in-memory history -> ``training_history.json``
+   (TrainingHistoryCallback parity, reference ``training.py:215-221,315-316``);
+3. Aim experiment tracker when available (AimCallback parity, reference
+   ``training.py:240-241``) with the same naming contract: HF
+   ``train_loss``/``eval_loss`` become Aim metric ``loss`` with
+   ``context.subset in {train, eval}`` (reference ``docs/AIM_WORKFLOW.md:334-337``);
+   plus an always-on JSONL fallback sink with the same schema, so runs are
+   inspectable even where Aim isn't installed.
+
+Perplexity injection (``exp(loss)``/``exp(eval_loss)``) reproduces
+PerplexityCallback (reference ``training.py:224-234``). Only host 0 writes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional
+
+from llm_fine_tune_distributed_tpu.runtime.distributed import is_primary_host
+
+
+def inject_perplexity(logs: Dict[str, float]) -> Dict[str, float]:
+    """Add perplexity next to any loss, capped like the reference caps
+    overflow (exp of large losses) via math.exp guarded at 700."""
+    out = dict(logs)
+    if "loss" in out:
+        out["perplexity"] = math.exp(min(out["loss"], 700.0))
+    if "eval_loss" in out:
+        out["eval_perplexity"] = math.exp(min(out["eval_loss"], 700.0))
+    return out
+
+
+class _AimSink:
+    def __init__(self, repo: str, experiment: str):
+        from aim import Run  # optional dep, gated by caller
+
+        self.run = Run(repo=repo, experiment=experiment)
+
+    def log(self, step: int, epoch: float, logs: Dict[str, float]) -> None:
+        for key, value in logs.items():
+            if not isinstance(value, (int, float)):
+                continue
+            # naming contract: train_/eval_ prefixes become context.subset
+            if key.startswith("eval_"):
+                name, ctx = key[len("eval_") :], {"subset": "eval"}
+            else:
+                name, ctx = key, {"subset": "train"}
+            self.run.track(value, name=name, step=step, epoch=int(epoch), context=ctx)
+
+    def close(self) -> None:
+        self.run.close()
+
+
+class _JsonlSink:
+    """Aim-schema-compatible flat-file sink (one JSON object per log event)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+
+    def log(self, step: int, epoch: float, logs: Dict[str, float]) -> None:
+        self._f.write(json.dumps({"step": step, "epoch": epoch, **logs}) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class MetricLogger:
+    def __init__(
+        self,
+        output_dir: str,
+        aim_repo: Optional[str] = None,
+        experiment: str = "experiment",
+        stdout: bool = True,
+    ):
+        self.history: List[Dict[str, float]] = []
+        self.stdout = stdout
+        self.primary = is_primary_host()
+        self.sinks = []
+        if self.primary:
+            self.sinks.append(_JsonlSink(os.path.join(output_dir, "metrics.jsonl")))
+            if aim_repo:
+                try:
+                    self.sinks.append(_AimSink(aim_repo, experiment))
+                except ImportError:
+                    print("[metrics] aim not installed; falling back to JSONL sink only")
+
+    def log(self, step: int, epoch: float, logs: Dict[str, float]) -> None:
+        logs = inject_perplexity(logs)
+        record = {"step": step, "epoch": round(epoch, 4), **logs}
+        self.history.append(record)
+        if not self.primary:
+            return
+        for sink in self.sinks:
+            sink.log(step, epoch, logs)
+        if self.stdout:
+            rendered = ", ".join(
+                f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in record.items()
+            )
+            print(f"[train] {rendered}", flush=True)
+
+    def save_history(self, path: str) -> None:
+        """``training_history.json`` artifact (reference ``training.py:315-316``)."""
+        if self.primary:
+            with open(path, "w") as f:
+                json.dump(self.history, f, indent=2)
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            sink.close()

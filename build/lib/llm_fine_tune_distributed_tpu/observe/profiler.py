@@ -1,0 +1,68 @@
+"""Profiling hooks: jax.profiler traces + device memory reports.
+
+The reference's tracing story is ad-hoc VRAM prints
+(``torch.cuda.memory_allocated``, reference ``training.py:107-111``) plus
+cluster dashboards (SURVEY.md §5.1) — no profiler. Here profiling is
+first-class: set ``TrainConfig.profile_dir`` and the trainer captures an
+XProf/TensorBoard-compatible trace of a few hot-loop steps (compile excluded)
+that shows MXU utilization, HBM traffic, and collective overlap per op —
+the data the ≥4x perf target is tuned against.
+
+View: ``tensorboard --logdir <profile_dir>`` (Profile tab), or
+xprof. Host 0 only; tracing other hosts adds nothing for SPMD programs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from llm_fine_tune_distributed_tpu.runtime.distributed import is_primary_host
+
+
+class StepProfiler:
+    """Trace steps [start, start+count) of the training loop.
+
+    Skips the first steps by default so compilation and warmup don't pollute
+    the trace (first-step compile dominates otherwise).
+    """
+
+    def __init__(self, profile_dir: Optional[str], start_step: int = 3, num_steps: int = 3):
+        self.dir = profile_dir if (profile_dir and is_primary_host()) else None
+        self.start = start_step
+        self.stop_at = start_step + num_steps
+        self._active = False
+
+    def step(self, step: int) -> None:
+        """Call once per optimizer step (after the step completes)."""
+        if self.dir is None:
+            return
+        if not self._active and step == self.start:
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+        elif self._active and step >= self.stop_at:
+            jax.profiler.stop_trace()
+            self._active = False
+            print(f"[profiler] trace for steps [{self.start},{self.stop_at}) "
+                  f"written to {self.dir}")
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+def device_memory_report() -> dict:
+    """Live HBM usage of local devices — the analog of the reference's VRAM
+    print (``training.py:107-111``), per chip."""
+    report = {}
+    for d in jax.local_devices():
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            report[str(d.id)] = {
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+    return report

@@ -1,0 +1,69 @@
+"""Samples/sec/chip instrumentation — first-class because it IS the
+north-star metric (BASELINE.json; the reference only surfaces HF's
+``train_samples_per_second`` in Aim, ``docs/AIM_WORKFLOW.md:42-43``).
+
+Two figures per snapshot: the cumulative rate (includes jit compile and
+eval pauses — honest wall-clock accounting) and a steady-state rate over a
+sliding window of recent steps, which is the number comparable to
+``bench.py`` (short runs are otherwise dominated by the one-off compile)."""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, Optional
+
+_WINDOW_STEPS = 16
+
+
+class ThroughputMeter:
+    def __init__(self, n_chips: int, tokens_per_sample: Optional[int] = None):
+        self.n_chips = max(n_chips, 1)
+        self.tokens_per_sample = tokens_per_sample
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._samples = 0
+        self._steps = 0
+        # (timestamp, cumulative_samples) ring for the steady-state window;
+        # seeded with t0 so the first window spans step 1..N and the compile
+        # falls out of the window once _WINDOW_STEPS+1 entries exist
+        self._window = deque([(self._t0, 0)], maxlen=_WINDOW_STEPS + 1)
+
+    def update(self, samples: int) -> None:
+        self._samples += samples
+        self._steps += 1
+        self._window.append((time.perf_counter(), self._samples))
+
+    def snapshot(self) -> Dict[str, float]:
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        sps = self._samples / dt
+        out = {
+            "samples_per_second": sps,
+            "samples_per_second_per_chip": sps / self.n_chips,
+            "steps_per_second": self._steps / dt,
+            "elapsed_seconds": dt,
+        }
+        if len(self._window) >= 3:
+            # steady state: MEDIAN of recent per-step rates — robust to the
+            # occasional slow span (compile, an eval pass, a checkpoint
+            # save) landing inside the window, not just the oldest one
+            pairs = list(self._window)
+            rates = [
+                (s_b - s_a) / (t_b - t_a)
+                for (t_a, s_a), (t_b, s_b) in zip(pairs, pairs[1:])
+                if t_b > t_a and s_b > s_a
+            ]
+            if rates:
+                rates.sort()
+                mid = len(rates) // 2
+                median = (
+                    rates[mid]
+                    if len(rates) % 2
+                    else 0.5 * (rates[mid - 1] + rates[mid])
+                )
+                out["samples_per_second_per_chip_steady"] = median / self.n_chips
+        if self.tokens_per_sample:
+            out["tokens_per_second_per_chip"] = sps * self.tokens_per_sample / self.n_chips
+        return out

@@ -1,0 +1,3 @@
+from llm_fine_tune_distributed_tpu.ops.rope import rope_cos_sin, apply_rope  # noqa: F401
+from llm_fine_tune_distributed_tpu.ops.attention import attention  # noqa: F401
+from llm_fine_tune_distributed_tpu.ops.norms import rms_norm  # noqa: F401

@@ -1,0 +1,192 @@
+"""Attention implementations and dispatch.
+
+Replaces the reference's flash-attn-2 CUDA kernels
+(reference ``requirements.txt:10``, ``training.py:101``) with TPU paths:
+
+- ``"xla"``:   plain masked attention — XLA fuses this well at seq<=1024 and it
+               is the numerically-transparent fallback.
+- ``"flash"``: Pallas (Mosaic) blockwise flash attention kernel (ops/flash_attention.py).
+- ``"ring"``:  ring attention over a sequence-parallel mesh axis (parallel/ring_attention.py),
+               selected by the trainer when mesh.seq > 1.
+- ``"ulysses"``: all-to-all sequence parallelism (parallel/ulysses.py) — heads
+               re-partitioned over the seq axis so each device runs full-sequence
+               flash attention on its head subset.
+
+All implementations take/return the same layout:
+  q: [batch, q_len, num_heads, head_dim]
+  k,v: [batch, kv_len, num_kv_heads, head_dim]   (GQA: num_heads % num_kv_heads == 0)
+and compute softmax in float32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -2.0e38  # large finite negative; avoids NaN from (-inf) - (-inf)
+
+
+def _causal_mask(q_len: int, kv_len: int, sliding_window: Optional[int] = None):
+    """[q_len, kv_len] bool mask; True = attend. Supports decode offset where
+    q positions are the last q_len of kv_len."""
+    q_pos = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+    k_pos = jnp.arange(kv_len)[None, :]
+    mask = k_pos <= q_pos
+    if sliding_window is not None:
+        mask &= k_pos > q_pos - sliding_window
+    return mask
+
+
+def xla_attention(
+    q,
+    k,
+    v,
+    *,
+    padding_mask=None,
+    segment_ids=None,
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    mask=None,
+):
+    """Reference masked attention with GQA, f32 softmax.
+
+    padding_mask: optional [batch, kv_len] bool/int, 1 = real token.
+    segment_ids: optional [batch, kv_len] int32 packing segments — attention
+      is restricted to equal ids (block-diagonal; 0 = pad tail).
+    mask: optional explicit [batch, q_len, kv_len] bool mask (True = attend);
+      when given it replaces the causal mask (used by the KV-cache decode path).
+    """
+    b, q_len, num_heads, head_dim = q.shape
+    kv_len, num_kv = k.shape[1], k.shape[2]
+    groups = num_heads // num_kv
+
+    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+    # [b, q, kv_heads, groups, d]
+    qg = q.reshape(b, q_len, num_kv, groups, head_dim)
+    # scores: [b, kv_heads, groups, q, kv]
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * scale
+
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
+    elif causal:
+        cmask = _causal_mask(q_len, kv_len, sliding_window)
+        scores = jnp.where(cmask[None, None, None], scores, _NEG_INF)
+    if padding_mask is not None:
+        pm = padding_mask.astype(bool)[:, None, None, None, :]
+        scores = jnp.where(pm, scores, _NEG_INF)
+    if segment_ids is not None:
+        # note: a fully-masked row is safe — _NEG_INF is finite, so softmax
+        # degrades to uniform garbage on pad rows, which the loss mask drops
+        same = segment_ids[:, None, :] == segment_ids[:, :, None]  # [b, q, kv]
+        scores = jnp.where(same[:, None, None], scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, q_len, num_heads, head_dim).astype(q.dtype)
+
+
+def _seq_parallel_fallback(impl: str, q, mesh) -> str:
+    """Fallback target when a sequence-parallel impl cannot apply.
+
+    A missing/size-1 seq axis is the ordinary single-device case — fall back
+    quietly. A PROVISIONED seq axis with an unsupported shape (e.g. ulysses
+    capped by kv heads, or an indivisible seq length) means the user's
+    parallelism is silently dead — be loud, because at long-context shapes
+    the difference between the flash kernel and quadratic XLA attention is
+    an OOM. Either way prefer "flash" (linear memory), which itself degrades
+    to XLA attention only when truly unsupported."""
+    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        import warnings
+
+        warnings.warn(
+            f"attention_impl={impl!r} requested but unsupported for shape "
+            f"q={tuple(q.shape)} on mesh {dict(mesh.shape)} — the seq axis is "
+            "NOT being used; falling back to flash/XLA attention (check head/"
+            "kv-head divisibility by the seq axis and seq-length alignment)",
+            stacklevel=3,
+        )
+    return "flash"
+
+
+def attention(
+    q,
+    k,
+    v,
+    *,
+    impl: str = "xla",
+    padding_mask=None,
+    segment_ids=None,
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    mesh=None,
+):
+    """Dispatch to the selected attention implementation.
+
+    ``mesh`` is consulted by the sequence-parallel paths (ring and ulysses);
+    the trainer passes the active mesh whenever ``attention_impl`` is one of
+    those. Without a mesh (or with an unsupported shape) they fall back to
+    the flash kernel, which itself degrades to XLA attention when it cannot
+    apply.
+    """
+    if impl in ("ring", "ulysses") and segment_ids is not None:
+        # the ring rotation has no segment support; packed batches take the
+        # flash kernel (which masks by segment natively) or XLA. Be loud:
+        # a user who provisioned a seq axis should know it is being bypassed
+        # (and beyond the flash kernel's max length this degrades to
+        # quadratic XLA attention).
+        import warnings
+
+        warnings.warn(
+            f"packing (segment_ids) disables {impl} attention (sequence "
+            f"parallelism has no segment support); falling back to flash/XLA "
+            f"for seq {q.shape[1]} — disable packing for sequence-parallel "
+            "long-context runs",
+            stacklevel=2,
+        )
+        impl = "flash"
+    if impl == "ulysses":
+        from llm_fine_tune_distributed_tpu.parallel.ulysses import (
+            ulysses_attention,
+            ulysses_attention_supported,
+        )
+
+        if ulysses_attention_supported(
+            q, k, mesh, sliding_window=sliding_window, causal=causal
+        ):
+            return ulysses_attention(
+                q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal
+            )
+        impl = _seq_parallel_fallback("ulysses", q, mesh)
+    if impl == "ring":
+        from llm_fine_tune_distributed_tpu.parallel.ring_attention import (
+            ring_attention,
+            ring_attention_supported,
+        )
+
+        if ring_attention_supported(
+            q, k, mesh, sliding_window=sliding_window, causal=causal
+        ):
+            return ring_attention(q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal)
+        impl = _seq_parallel_fallback("ring", q, mesh)
+    if impl == "flash":
+        # Pallas kernel requires TPU, no sliding window (falls back otherwise).
+        from llm_fine_tune_distributed_tpu.ops.flash_attention import (
+            flash_attention_supported,
+            pallas_flash_attention,
+        )
+
+        if flash_attention_supported(q, k, v, sliding_window=sliding_window, causal=causal):
+            return pallas_flash_attention(
+                q, k, v, padding_mask=padding_mask, segment_ids=segment_ids
+            )
+        impl = "xla"
+    if impl == "xla":
+        return xla_attention(
+            q, k, v, padding_mask=padding_mask, segment_ids=segment_ids,
+            causal=causal, sliding_window=sliding_window,
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")

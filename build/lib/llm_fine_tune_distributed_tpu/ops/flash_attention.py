@@ -1,0 +1,365 @@
+"""Pallas (Mosaic) flash attention for TPU — forward AND backward kernels.
+
+TPU-native replacement for the reference's flash-attn-2 CUDA dependency
+(reference ``requirements.txt:10``, ``training.py:101``). Blockwise online-
+softmax attention computed in VMEM tiles: the [seq, seq] score matrix never
+materializes in HBM, in either direction.
+
+Formulation (FlashAttention-2 style):
+  fwd   per (batch, q_head, q_block): stream K/V blocks up to the causal
+        limit, carrying running max ``m``, normalizer ``l`` and the
+        unnormalized accumulator; emit O and LSE = m + log(l).
+  bwd   delta = rowsum(dO * O); then
+        dq  per (batch, q_head, q_block):   ds = p * (dO V^T - delta); dq = ds K
+        dk/dv per (batch, KV head, k_block): dv += p^T dO; dk += ds^T q,
+        accumulated over the KV head's query group inside the kernel.
+
+GQA is handled by BlockSpec index maps (K/V indexed with ``head // groups``
+in fwd/dq; q/dO indexed per-group in dk/dv) — K/V are never repeated in HBM
+and dk/dv stay at KV-head width. Decode uses the XLA cache path, not this
+kernel.
+
+Layout contract (matches ops/attention.py): q [b, sq, hq, d], k/v
+[b, sk, hkv, d], output [b, sq, hq, d] in q.dtype. Masking is expressed as
+per-position ``segments`` [b, s] int32 — attention flows within equal ids
+only (0 = padding tail; sequence packing passes its real segment ids, plain
+right-padded batches pass the 1/0 padding mask); softmax runs in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1.0e30
+_MAX_KERNEL_SEQ = 4096  # whole K/V/Q reside in VMEM per program; ring
+                        # attention (parallel/ring_attention.py) covers longer
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(seg_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, groups):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [BQ, d]
+    bq, d = q.shape
+    q_start = iq * bq
+    # 0 = padding, >0 = packed segment id; ref-indexed with pl.ds (Mosaic
+    # has no dynamic_slice on loaded arrays)
+    q_seg = seg_ref[0, pl.ds(q_start, bq), 0]
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    # causal upper bound: K blocks whose start exceeds the last q position of
+    # this block contribute nothing
+    n_blocks = (q_start + bq + block_k - 1) // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK]
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        k_seg = seg_ref[0, pl.ds(j * block_k, block_k), 0]
+        # same-segment test subsumes padding: pad queries (seg 0) attend only
+        # the pad tail (incl. themselves at k==q, keeping softmax finite),
+        # real queries never see pad keys or other segments
+        mask = (k_pos <= q_pos) & (q_seg[:, None] == k_seg[None, :])
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(mask, p, 0.0)  # exp(-1e30 - m) underflows anyway; be exact
+        l = l * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, :, 0] = m + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, segments, *, scale, block_q, block_k, groups, interpret):
+    b, hq, sq, d = q.shape
+    sk = k.shape[2]
+    grid = (b, hq, sq // block_q)
+    out_shape = (
+        jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        # trailing unit dim: TPU tiling wants the block's last dim equal to
+        # the array's (1) and the second-to-last divisible by 8 (block_q)
+        jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
+    )
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k, groups=groups)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, sk, 1), lambda b_, h, i: (b_, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h, i: (b_, h // groups, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h, i: (b_, h // groups, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h, i: (b_, h, i, 0)),
+        ),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(segments[:, :, None], q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_k):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    bq, d = q.shape
+    q_start = iq * bq
+    q_seg = seg_ref[0, pl.ds(q_start, bq), 0]
+    n_blocks = (q_start + bq + block_k - 1) // block_k
+
+    def body(j, dq_acc):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        k_seg = seg_ref[0, pl.ds(j * block_k, block_k), 0]
+        mask = (k_pos <= q_pos) & (q_seg[:, None] == k_seg[None, :])
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, n_blocks, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q, groups):
+    """Per (batch, KV head, k_block): accumulate dk/dv over this KV head's
+    ``groups`` query heads and all causal q blocks — dk/dv stay at KV-head
+    width (no group-factor HBM inflation)."""
+    jk = pl.program_id(2)
+    k_blk = k_ref[0, 0].astype(jnp.float32)  # [BK, d]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    bk, d = k_blk.shape
+    sq = q_ref.shape[2]
+    k_start = jk * bk
+    k_seg = seg_ref[0, pl.ds(k_start, bk), 0]
+    # causal: only q blocks at/after this k block contribute
+    start_block = k_start // block_q
+    n_blocks = sq // block_q
+
+    def make_body(g):
+        def body(i, carry):
+            dk_acc, dv_acc = carry
+            q_blk = q_ref[0, g, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            do_blk = do_ref[0, g, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+            lse_blk = lse_ref[0, g, pl.ds(i * block_q, block_q), 0]
+            delta_blk = delta_ref[0, g, pl.ds(i * block_q, block_q), 0]
+            s = jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # [BQ, BK]
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            q_seg = seg_ref[0, pl.ds(i * block_q, block_q), 0]
+            mask = (k_pos <= q_pos) & (q_seg[:, None] == k_seg[None, :])
+            p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            dp = jax.lax.dot_general(
+                do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - delta_blk[:, None])
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            return dk_acc, dv_acc
+
+        return body
+
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+    for g in range(groups):  # static unroll over the KV head's query group
+        dk, dv = jax.lax.fori_loop(start_block, n_blocks, make_body(g), (dk, dv))
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, segments, o, lse, do, *, scale, block_q, block_k, groups, interpret):
+    """Head-major inputs: q/o/do/lse [b, hq, ...], k/v [b, hkv, s, d]."""
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[..., None]  # [b,hq,sq,1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_k=block_k),
+        grid=(b, hq, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, sq, 1), lambda b_, h, i: (b_, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda b_, h, i: (b_, h // groups, 0, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda b_, h, i: (b_, h // groups, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h, i: (b_, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        interpret=interpret,
+    )(segments[:, :, None], q, k, v, do, lse, delta)
+
+    # grid over KV heads; q/do/lse/delta blocks span the head's query group
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q, groups=groups),
+        grid=(b, hkv, sq // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, 1), lambda b_, h, j: (b_, 0, 0)),
+            pl.BlockSpec((1, groups, sq, d), lambda b_, h, j: (b_, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j: (b_, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j: (b_, h, j, 0)),
+            pl.BlockSpec((1, groups, sq, d), lambda b_, h, j: (b_, h, 0, 0)),
+            pl.BlockSpec((1, groups, sq, 1), lambda b_, h, j: (b_, h, 0, 0)),
+            pl.BlockSpec((1, groups, sq, 1), lambda b_, h, j: (b_, h, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j: (b_, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h, j: (b_, h, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, sq, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, sq, d), v.dtype),
+        ),
+        interpret=interpret,
+    )(segments[:, :, None], q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper (public entry)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash_fn(scale: float, block_q: int, block_k: int, groups: int, interpret: bool):
+    """One custom_vjp closure per static configuration."""
+
+    @jax.custom_vjp
+    def fn(q, k, v, segments):
+        o, _ = _fwd(
+            q, k, v, segments,
+            scale=scale, block_q=block_q, block_k=block_k, groups=groups,
+            interpret=interpret,
+        )
+        return o
+
+    def fn_fwd(q, k, v, segments):
+        o, lse = _fwd(
+            q, k, v, segments,
+            scale=scale, block_q=block_q, block_k=block_k, groups=groups,
+            interpret=interpret,
+        )
+        return o, (q, k, v, segments, o, lse)
+
+    def fn_bwd(res, do):
+        q, k, v, segments, o, lse = res
+        dq, dk, dv = _bwd(
+            q, k, v, segments, o, lse, do,
+            scale=scale, block_q=block_q, block_k=block_k, groups=groups,
+            interpret=interpret,
+        )
+        dsegments = np.zeros(segments.shape, jax.dtypes.float0)
+        return dq, dk, dv, dsegments
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return fn
+
+
+def _pick_block(s: int) -> int:
+    for blk in (512, 256, 128):
+        if s % blk == 0:
+            return blk
+    return 0
+
+
+def flash_attention_supported(
+    q, k, v, *, sliding_window=None, causal: bool = True
+) -> bool:
+    """Static eligibility check run at trace time by ops/attention.py."""
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    if jax.default_backend() != "tpu":
+        return False
+    if not causal or sliding_window is not None:
+        return False
+    if sq != sk or sq > _MAX_KERNEL_SEQ:
+        return False  # decode/cache path and very long sequences use xla/ring
+    if _pick_block(sq) == 0:
+        return False
+    if d % 128 != 0:
+        return False  # MXU lane alignment (all supported models have d=128)
+    return hq % k.shape[2] == 0
+
+
+def pallas_flash_attention(
+    q, k, v, *, padding_mask=None, segment_ids=None, interpret: bool = False
+):
+    """q [b, sq, hq, d], k/v [b, sk, hkv, d] -> [b, sq, hq, d] (q.dtype).
+
+    Masking is expressed as per-position segments [b, sk] int32: attention
+    flows only within equal segment ids (plus causal). ``segment_ids`` comes
+    from the packing pipeline (data/packing.py, 0 = pad tail); without it,
+    ``padding_mask`` (1 = real) degenerates to the two-segment real/pad case.
+    Softmax in f32; causal.
+    """
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    if segment_ids is not None:
+        segments = segment_ids.astype(jnp.int32)
+    elif padding_mask is not None:
+        segments = padding_mask.astype(jnp.int32)
+    else:
+        segments = jnp.ones((b, sq), jnp.int32)
+
+    block = _pick_block(sq)
+    if block == 0:
+        raise ValueError(
+            f"flash attention requires seq length divisible by 128, got {sq} "
+            f"(use ops.attention.attention() for automatic XLA fallback)"
+        )
+    fn = _make_flash_fn(float(1.0 / np.sqrt(d)), block, block, groups, interpret)
+    # head-major layout for clean blocking
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = fn(qt, kt, vt, segments)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,139 @@
+"""Int8 weight-only quantization for inference.
+
+Batch-1 autoregressive decode is weight-bandwidth-bound: every generated
+token reads every matmul weight once, so tokens/sec is HBM GB/s divided by
+the weight-stream size. NF4 (ops/nf4.py) halves that stream twice over but
+its nibble unpack is VPU-bound on v5e (measured 20 tok/s vs 73 bf16 for the
+3B flagship, benchmarks/decode_bench.py). Int8 sits in the sweet spot:
+
+- the weight stream halves (int8 at rest vs bf16);
+- dequantization is ONE convert + ONE broadcast multiply, which XLA fuses
+  into the matmul operand read — no unpack, no codebook lookup;
+- symmetric per-output-channel scales keep matmul semantics exact up to the
+  8-bit rounding (no zero points to fold).
+
+Storage: sibling leaves ``kernel_int8 [in, out] int8`` +
+``kernel_int8_scale [out] f32`` (per-output-channel absmax / 127), consumed
+by ``models/transformer._linear`` exactly like the NF4 leaves. This is an
+INFERENCE format — the trainer never produces it; ``quantize_params_int8``
+converts a loaded checkpoint in one pass (CLI flag ``--quantize int8`` on
+the inference entry points).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+INT8_SUFFIXES = ("int8", "int8_scale")
+
+
+def quantize_int8(w) -> Dict[str, jax.Array]:
+    """``w [in, out]`` -> {"int8": int8 [in, out], "int8_scale": f32 [out]}."""
+    w = jnp.asarray(w)
+    if w.ndim != 2:
+        raise ValueError(f"quantize_int8 expects a 2-D weight, got {w.shape}")
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)  # [out]
+    scale = jnp.where(absmax == 0.0, 1.0, absmax) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]), -127, 127)
+    return {"int8": q.astype(jnp.int8), "int8_scale": scale.astype(jnp.float32)}
+
+
+def dequantize_int8(q: Dict, dtype=jnp.bfloat16):
+    """Inverse: int8 codes * per-channel scale -> [in, out] in ``dtype``."""
+    return (
+        q["int8"].astype(jnp.float32) * q["int8_scale"][None, :].astype(jnp.float32)
+    ).astype(dtype)
+
+
+def int8_matmul(x, q: Dict, compute_dtype=jnp.bfloat16):
+    """``x [..., in] @ dequant(q)``. The convert+scale fuses into the matmul
+    operand read under XLA; the HBM stream is the int8 codes."""
+    w = q["int8"].astype(compute_dtype) * q["int8_scale"].astype(compute_dtype)[None, :]
+    return x.astype(compute_dtype) @ w
+
+
+def quantize_int8_stacked(w) -> Dict[str, jax.Array]:
+    """Stacked expert weight ``[E, in, out]`` -> int8 codes + per-(expert,
+    channel) scales ``[E, out]`` (each expert quantized independently)."""
+    w = jnp.asarray(w)
+    if w.ndim != 3:
+        raise ValueError(f"quantize_int8_stacked expects [E, in, out], got {w.shape}")
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=1)  # [E, out]
+    scale = jnp.where(absmax == 0.0, 1.0, absmax) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[:, None, :]), -127, 127)
+    return {"int8": q.astype(jnp.int8), "int8_scale": scale.astype(jnp.float32)}
+
+
+def dequantize_int8_stacked(q: Dict, dtype=jnp.bfloat16):
+    """Inverse: [E, in, out] in ``dtype``."""
+    return (
+        q["int8"].astype(jnp.float32) * q["int8_scale"][:, None, :].astype(jnp.float32)
+    ).astype(dtype)
+
+
+# the single source of truth for inference quantization modes (CLI choices,
+# server fail-fast check, and maybe_quantize all reference this)
+QUANTIZE_MODES = ("none", "int8")
+
+
+def maybe_quantize(params, mode: str):
+    """Shared inference-entry helper (CLI + server): apply the selected
+    weight-only quantization mode to a loaded params pytree."""
+    if mode not in QUANTIZE_MODES:
+        raise ValueError(
+            f"unknown quantize mode {mode!r} (expected one of {QUANTIZE_MODES})"
+        )
+    if mode == "none":
+        return params
+    print("Quantizing block linears to int8 (weight-only) ...")
+    return quantize_params_int8(params)
+
+
+def quantize_params_int8(params, predicate=None):
+    """Replace every matching 2-D ``.../kernel`` leaf (transformer-block
+    linears by default) with its int8 sibling leaves. Works on the nested
+    params pytree; non-matching leaves pass through untouched.
+
+    Embeddings and the lm_head stay full precision: the embedding gather
+    reads one row per token (not bandwidth-bound) and the unembed feeds the
+    sampling distribution where 8-bit rounding is most visible. The MoE
+    router gate also stays exact — same reasoning as the NF4 path
+    (parallel/qlora._is_quantizable): it is ~0.01% of the bytes and 8-bit
+    rounding there would perturb every routing decision.
+    """
+    def is_stacked_expert(path: str) -> bool:
+        return path.endswith(("/experts/w1", "/experts/w2", "/experts/w3"))
+
+    if predicate is None:
+        predicate = lambda path: "/layers/" in path and (
+            (path.endswith("/kernel") and not path.endswith("block_sparse_moe/gate/kernel"))
+            or is_stacked_expert(path)
+        )
+
+    from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict, unflatten_dict
+
+    flat = flatten_dict(params)
+    out = {}
+    for path, leaf in flat.items():
+        if not predicate(path):
+            out[path] = leaf
+        elif getattr(leaf, "ndim", 0) == 2 and path.endswith("/kernel"):
+            q = quantize_int8(leaf)
+            for suffix in INT8_SUFFIXES:
+                out[f"{path}_{suffix}"] = q[suffix]
+        elif getattr(leaf, "ndim", 0) == 3 and is_stacked_expert(path):
+            q = quantize_int8_stacked(leaf)
+            for suffix in INT8_SUFFIXES:
+                out[f"{path}_{suffix}"] = q[suffix]
+        else:
+            # a predicate hit with no int8 form (embedding, norm, odd shape)
+            # would produce orphaned leaves no consumer reads — be loud
+            raise ValueError(
+                f"predicate matched {path!r} (ndim="
+                f"{getattr(leaf, 'ndim', None)}) but only 2-D .../kernel "
+                "leaves and stacked 3-D expert weights have an int8 form"
+            )
+    return unflatten_dict(out)

@@ -1,0 +1,203 @@
+"""Mixture-of-experts MLP with top-k routing and expert parallelism.
+
+The reference trains dense models only (SURVEY.md §2.4: "EP (expert
+parallel): NO — dense models only"); this module extends the framework to
+the Mixtral family with a TPU-first design:
+
+- **Einsum dispatch, not gather/scatter loops.** Routing is expressed as
+  GShard/Switch-style one-hot dispatch/combine tensors contracted on the MXU:
+  ``[b, s, E, C] x [b, s, h] -> [b, E, C, h]``. No dynamic shapes, no
+  data-dependent control flow — one XLA program regardless of routing.
+- **Capacity-bounded queues.** Each (batch row, expert) pair processes at
+  most ``C = ceil(k * s / E * capacity_factor)`` tokens; overflow tokens
+  fall through on the residual path (GShard drop semantics). C is static,
+  so expert blocks are dense [E, C, h] tiles the MXU likes.
+- **Expert parallelism over the mesh "expert" axis.** Expert weights
+  [E, h, f] shard on E (parallel/sharding.py); a sharding constraint on the
+  dispatched [b, E, C, h] blocks moves tokens from batch-sharded to
+  expert-sharded layout — XLA inserts the all_to_all over ICI, the
+  collective that defines EP. With expert=1 everything stays local.
+- **Load-balancing auxiliary loss** (Switch/Mixtral):
+  ``E * sum_e fraction_dispatched_e * mean_router_prob_e``, returned
+  unscaled; the train step weights it by ``config.router_aux_coef``.
+
+Weight layout mirrors HF Mixtral names (models/hf_io.py stacks the
+per-expert torch Linears): ``block_sparse_moe/gate/kernel [h, E]``,
+``block_sparse_moe/experts/{w1,w3} [E, h, f]`` (gate/up), ``w2 [E, f, h]``
+(down). Router softmax and the combine run in float32.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+
+
+def expert_capacity(seq_len: int, config: ModelConfig) -> int:
+    """Static per-(batch-row, expert) token capacity."""
+    k, e = config.num_experts_per_tok, config.num_experts
+    return max(1, int(math.ceil(k * seq_len / e * config.capacity_factor)))
+
+
+def moe_mlp(lp, x, config: ModelConfig, compute_dtype, mesh=None, token_mask=None,
+            dropless=False):
+    """Sparse MoE MLP. ``x [b, s, h] -> (y [b, s, h], aux scalar f32)``.
+
+    ``lp`` is the ``block_sparse_moe`` params subtree. ``aux`` is the raw
+    load-balancing loss (scale by ``config.router_aux_coef`` in the train
+    objective); it is differentiable through the router softmax.
+    ``token_mask [b, s]`` (1 = real token) excludes padding from routing:
+    pad tokens get no dispatch (zero MoE output), consume no expert
+    capacity, and do not pollute the load-balancing statistics.
+    ``dropless=True`` sizes the capacity at the worst case (every token to
+    one expert) so NO token is ever dropped — the inference semantics (HF
+    Mixtral decode is dropless); capacity drops are a training-efficiency
+    trade-off that would otherwise make decode output depend on how many
+    tokens share the forward pass.
+    """
+    b, s, h = x.shape
+    e, k = config.num_experts, config.num_experts_per_tok
+
+    # Long sequences: route in independent chunks (GShard grouping) so the
+    # one-hot dispatch tensors stay linear in s — [b*n, chunk, E, C_chunk]
+    # instead of [b, s, E, C] whose C grows with s. The aux statistics are
+    # token-means, so grouping leaves them unchanged.
+    if s > config.moe_dispatch_chunk:
+        # balanced grouping: n = ceil(s/budget) groups of ceil(s/n) tokens,
+        # padded+masked to a chunk multiple. Handles every length (incl.
+        # primes) with < n wasted positions — s=1030 @ budget 1024 becomes
+        # two 515-token groups with zero padding, not two padded 1024s.
+        n_groups = -(-s // config.moe_dispatch_chunk)
+        chunk = -(-s // n_groups)
+        pad = (-s) % chunk
+        xg = jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
+        mg = token_mask
+        if pad:
+            if mg is None:
+                mg = jnp.ones((b, s), jnp.int32)
+            mg = jnp.pad(mg.astype(jnp.int32), ((0, 0), (0, pad)))
+        n = (s + pad) // chunk
+        xg = xg.reshape(b * n, chunk, h)
+        mg = None if mg is None else mg.reshape(b * n, chunk)
+        y, aux = moe_mlp(lp, xg, config, compute_dtype, mesh=mesh, token_mask=mg,
+                         dropless=dropless)
+        return y.reshape(b, s + pad, h)[:, :s], aux
+
+    cap = s if dropless else expert_capacity(s, config)
+
+    gate_logits = x @ lp["gate"]["kernel"].astype(compute_dtype)  # [b, s, E]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    top_p, top_i = jax.lax.top_k(probs, k)  # [b, s, k]
+    # Mixtral renormalizes the selected probabilities to sum to 1.
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    sel = jax.nn.one_hot(top_i, e, dtype=jnp.float32)          # [b, s, k, E]
+    mask_se = sel.sum(2)                                       # [b, s, E] 0/1
+    weight_se = (sel * top_p[..., None]).sum(2)                # [b, s, E]
+
+    if token_mask is not None:
+        real = token_mask.astype(jnp.float32)                  # [b, s]
+        # masked BEFORE the capacity cumsum so pads hold no queue slots
+        mask_se = mask_se * real[..., None]
+        weight_se = weight_se * real[..., None]
+        n_tokens = jnp.maximum(real.sum(), 1.0)
+    else:
+        real = None
+        n_tokens = jnp.float32(b * s)
+
+    # Queue position of each token within its (batch row, expert) capacity
+    # buffer — first-come-first-served along the sequence.
+    pos_se = jnp.cumsum(mask_se, axis=1).astype(jnp.int32) - 1  # [b, s, E]
+    keep = mask_se * (pos_se < cap)                             # drop overflow
+    dispatch = jax.nn.one_hot(
+        jnp.where(keep > 0, pos_se, -1), cap, dtype=jnp.float32
+    )                                                           # [b, s, E, C]
+    combine = dispatch * weight_se[..., None]                  # [b, s, E, C]
+
+    def to_experts(t):
+        """Constrain dispatched blocks to the expert axis (the EP boundary)."""
+        if mesh is not None and mesh.shape.get("expert", 1) > 1:
+            spec = P(("data", "fsdp"), "expert", None, None)
+            return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
+        return t
+
+    xin = jnp.einsum(
+        "bsec,bsh->bech", dispatch.astype(compute_dtype), x
+    )                                                          # [b, E, C, h]
+    xin = to_experts(xin)
+
+    def expert_weight(name):
+        """[E, in, out], dequantizing the NF4 (QLoRA) or int8 (inference,
+        ops/int8.py) form when present. Under remat only one layer's
+        dequantized experts are live at a time, same as the dense paths."""
+        ex = lp["experts"]
+        if f"{name}_int8" in ex:
+            from llm_fine_tune_distributed_tpu.ops.int8 import dequantize_int8_stacked
+
+            return dequantize_int8_stacked(
+                {"int8": ex[f"{name}_int8"], "int8_scale": ex[f"{name}_int8_scale"]},
+                dtype=compute_dtype,
+            )
+        if f"{name}_nf4" in ex:
+            from llm_fine_tune_distributed_tpu.ops.nf4 import (
+                QUANT_SUFFIXES,
+                dequantize_nf4_stacked,
+            )
+
+            q = {
+                s: ex[f"{name}_{s}"] for s in QUANT_SUFFIXES if f"{name}_{s}" in ex
+            }
+            return dequantize_nf4_stacked(q, dtype=compute_dtype)
+        return ex[name].astype(compute_dtype)
+
+    w1 = expert_weight("w1")                                   # [E, h, f]
+    w3 = expert_weight("w3")                                   # [E, h, f]
+    w2 = expert_weight("w2")                                   # [E, f, h]
+    # named like the dense path's product so remat_policy="mlp"
+    # (save_only_these_names("mlp_act")) works for MoE models too
+    act = checkpoint_name(
+        jax.nn.silu(jnp.einsum("bech,ehf->becf", xin, w1))
+        * jnp.einsum("bech,ehf->becf", xin, w3),
+        "mlp_act",
+    )
+    out = to_experts(jnp.einsum("becf,efh->bech", act, w2))    # [b, E, C, h]
+
+    # combine in float32: the renormalized routing weights stay full
+    # precision through the weighted sum (the per-token FLOPs here are tiny)
+    y = jnp.einsum("bsec,bech->bsh", combine, out.astype(jnp.float32))
+
+    # Load-balancing loss over all REAL tokens (dropped ones included):
+    # uniform routing minimizes it at 1.0.
+    frac = mask_se.sum(axis=(0, 1)) / (n_tokens * k)           # [E]
+    if real is not None:
+        mean_prob = (probs * real[..., None]).sum(axis=(0, 1)) / n_tokens
+    else:
+        mean_prob = probs.mean(axis=(0, 1))                    # [E]
+    aux = e * jnp.sum(frac * mean_prob)
+
+    return y.astype(x.dtype), aux
+
+
+def init_moe_params(rng, config: ModelConfig, dtype):
+    """Random init of one layer's ``block_sparse_moe`` subtree."""
+    h, f, e = config.hidden_size, config.intermediate_size, config.num_experts
+    kg, k1, k2, k3 = jax.random.split(rng, 4)
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
+
+    return {
+        "gate": {"kernel": dense(kg, (h, e))},
+        "experts": {
+            "w1": dense(k1, (e, h, f)),
+            "w3": dense(k3, (e, h, f)),
+            "w2": dense(k2, (e, f, h)),
+        },
+    }

@@ -1,0 +1,17 @@
+"""RMSNorm, computed in float32 regardless of input dtype (HF Llama semantics)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """x: [..., hidden]; weight: [hidden]. Returns same dtype as x."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    # HF casts back to input dtype before multiplying by the weight; doing the
+    # multiply in f32 and casting once at the end is equivalent within bf16 ulp.
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,62 @@
+"""Rotary position embeddings (HF Llama "rotate_half" convention).
+
+Must match HF numerics exactly so imported safetensors weights reproduce the
+reference model's logits (the reference loads HF SmolLM3-3B,
+reference ``training.py:97-102``). HF applies RoPE by splitting the head dim
+in half (NOT even/odd interleaving):
+
+    rotate_half(x) = concat(-x[..., d/2:], x[..., :d/2])
+    x_rot = x * cos + rotate_half(x) * sin
+
+with ``cos/sin = f(outer(positions, inv_freq))`` tiled twice along the last dim.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions, head_dim: int, theta: float, dtype=jnp.float32):
+    """Compute cos/sin tables for given positions.
+
+    Args:
+      positions: int array [...,] token positions (any leading shape).
+      head_dim: per-head dimension (must be even).
+      theta: RoPE base frequency.
+
+    Returns:
+      (cos, sin) arrays of shape positions.shape + (head_dim,).
+    """
+    half = head_dim // 2
+    # f32 throughout: bf16 position phases destroy long-context accuracy.
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., head_dim]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(q, k, cos, sin):
+    """Apply rotary embedding to q and k.
+
+    Args:
+      q: [batch, seq, num_heads, head_dim]
+      k: [batch, seq, num_kv_heads, head_dim]
+      cos/sin: [batch, seq, head_dim] (or broadcastable)
+
+    Returns rotated (q, k), same dtypes as inputs.
+    """
+    # Broadcast over the heads axis.
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    q_dtype, k_dtype = q.dtype, k.dtype
+    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+    c32, s32 = c.astype(jnp.float32), s.astype(jnp.float32)
+    q_rot = q32 * c32 + _rotate_half(q32) * s32
+    k_rot = k32 * c32 + _rotate_half(k32) * s32
+    return q_rot.astype(q_dtype), k_rot.astype(k_dtype)

@@ -1,0 +1,10 @@
+from llm_fine_tune_distributed_tpu.parallel.sharding import (  # noqa: F401
+    param_sharding_rules,
+    param_spec,
+    shard_params,
+    batch_spec,
+)
+from llm_fine_tune_distributed_tpu.parallel.freeze import (  # noqa: F401
+    trainable_mask,
+    describe_trainable,
+)

@@ -1,0 +1,74 @@
+"""Partial-layer freezing policy.
+
+Reference behavior (C5, ``training.py:113-149``): freeze every param, then
+unfreeze the LAST 2 transformer layers + lm_head, yielding 418.9M/3.075B =
+13.62% trainable on SmolLM3-3B (``claude.md:241-245``). On error the reference
+falls back to full fine-tuning (``training.py:143-145``).
+
+TPU-native expression: a boolean mask pytree consumed by
+``optax.masked`` / ``multi_transform`` so frozen params get no optimizer state
+(the memory win) and their gradients are never materialized into updates.
+With tied embeddings, "lm_head" trainable means the embedding matrix is
+trainable (same tensor — matching what torch does for tied weights).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig, TrainConfig
+from llm_fine_tune_distributed_tpu.utils.tree import (
+    count_params,
+    count_params_where,
+    map_with_path,
+    tree_paths,
+)
+
+_LAYER_RE = re.compile(r"model/layers/(\d+)/")
+
+
+def trainable_predicate(config: ModelConfig, train: TrainConfig) -> Callable[[str], bool]:
+    strategy = train.freeze_strategy
+    if strategy == "none":
+        return lambda path: True
+    if strategy in ("lora", "qlora"):
+        # Only adapter matrices train; base weights AND the (constant)
+        # alpha/r scale stay frozen. For qlora the frozen base is additionally
+        # NF4-quantized after the split (parallel/qlora.py).
+        return lambda path: path.endswith(("lora_a", "lora_b"))
+    if strategy == "last_n_and_head":
+        cutoff = config.num_layers - train.unfreeze_last_n_layers
+
+        def pred(path: str) -> bool:
+            m = _LAYER_RE.search(path)
+            if m:
+                return int(m.group(1)) >= cutoff
+            if "lm_head" in path:
+                return True
+            if config.tie_word_embeddings and "embed_tokens" in path:
+                return True  # tied: the lm_head IS the embedding matrix
+            return False  # final norm + embeddings(untied) stay frozen
+
+        return pred
+    raise ValueError(f"unknown freeze_strategy {strategy!r}")
+
+
+def trainable_mask(params, config: ModelConfig, train: TrainConfig):
+    """Boolean pytree: True = trainable."""
+    pred = trainable_predicate(config, train)
+    return map_with_path(lambda path, leaf: pred(path), params)
+
+
+def describe_trainable(params, mask) -> dict:
+    """Trainable-parameter report (the reference prints this at
+    ``training.py:147-149``; values recorded into training_summary.json at
+    ``training.py:323-326``)."""
+    total = count_params(params)
+    flat_mask = {p: m for p, m in tree_paths(mask)}
+    trainable = count_params_where(params, lambda p: flat_mask[p])
+    return {
+        "total_parameters": total,
+        "trainable_parameters": trainable,
+        "trainable_percent": round(100.0 * trainable / total, 2),
+    }

@@ -1,0 +1,220 @@
+"""LoRA adapters: init, merge, and PEFT-compatible export.
+
+The reference ships no LoRA in code, but its external-doc article documents
+the exact intended configuration — r=16, alpha=8, dropout=0.05, seven
+projection targets (SURVEY.md C23; Kubeflow-Trainer article p.11) — and
+BASELINE.json's 70B config requires QLoRA-style adapter training. The model
+side is already wired: ``models/transformer.py:_linear`` adds
+``(alpha/r) * x @ A @ B`` whenever ``lora_a``/``lora_b``/``lora_scale`` sit
+beside a kernel, ``parallel/freeze.py`` trains only ``lora_*`` paths under
+``freeze_strategy="lora"``, and ``parallel/sharding.py`` has adapter
+sharding rules. This module is the lifecycle: create the adapter leaves,
+merge them into the base weights for serving, and round-trip them as a
+standalone PEFT-layout safetensors file.
+
+TPU note: rank-16 matmuls are far below the MXU's 128x128 tile, so LoRA's
+win here is optimizer-state memory (adam moments on ~0.5%% of params), not
+FLOPs — same as on GPU, but the merge-for-serving path matters more because
+tiny matmuls waste MXU occupancy at inference.
+
+``lora_dropout`` is recorded in adapter_config.json for PEFT interop but not
+applied during training: the jitted train step is deterministic (no dropout
+RNG is threaded through the model) and at r=16 the regularization effect is
+marginal for SFT-scale runs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.config import TrainConfig
+
+Params = Dict[str, object]
+
+
+def _target(path: str, modules: Sequence[str]) -> bool:
+    return path.endswith("/kernel") and any(f"/{m}/kernel" in f"/{path}" for m in modules)
+
+
+def add_lora_params(
+    params: Params,
+    rng,
+    *,
+    rank: int = 16,
+    alpha: float = 8.0,
+    target_modules: Sequence[str] = (
+        "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj",
+    ),
+    dtype=jnp.float32,
+) -> Params:
+    """Return a copy of ``params`` with adapter leaves beside each target
+    kernel. A ~ Kaiming-uniform (HF PEFT init), B = 0 so the adapted model
+    starts exactly equal to the base model. Each adapter's key is
+    ``fold_in(rng, crc32(path))`` — deterministic and order-independent."""
+    import zlib
+
+    def walk(node, prefix):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for name, child in node.items():
+            path = f"{prefix}/{name}" if prefix else name
+            if (
+                isinstance(child, dict)
+                and "kernel" in child
+                and _target(f"{path}/kernel", target_modules)
+            ):
+                kernel = child["kernel"]
+                d_in, d_out = kernel.shape
+                sub = jax.random.fold_in(rng, zlib.crc32(path.encode()))
+                bound = math.sqrt(3.0) * math.sqrt(1.0 / d_in)  # kaiming a=sqrt(5)
+                entry = dict(child)
+                entry["lora_a"] = jax.random.uniform(
+                    sub, (d_in, rank), dtype, minval=-bound, maxval=bound
+                )
+                entry["lora_b"] = jnp.zeros((rank, d_out), dtype)
+                entry["lora_scale"] = jnp.asarray(alpha / rank, dtype)
+                out[name] = entry
+            else:
+                out[name] = walk(child, path)
+        return out
+
+    return walk(params, "")
+
+
+def add_lora_from_config(params: Params, rng, train: TrainConfig) -> Params:
+    return add_lora_params(
+        params,
+        rng,
+        rank=train.lora_rank,
+        alpha=train.lora_alpha,
+        target_modules=tuple(train.lora_target_modules),
+    )
+
+
+def merge_lora(params: Params) -> Params:
+    """Fold adapters into the base kernels (W' = W + scale * A @ B) and drop
+    the adapter leaves — the serving-time form."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        if "kernel" in node and "lora_a" in node:
+            out = {k: v for k, v in node.items() if not k.startswith("lora_")}
+            delta = (node["lora_a"] @ node["lora_b"]) * node["lora_scale"]
+            out["kernel"] = (
+                node["kernel"].astype(jnp.float32) + delta.astype(jnp.float32)
+            ).astype(node["kernel"].dtype)
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(params)
+
+
+def strip_lora(params: Params) -> Params:
+    """Remove adapter leaves without merging (back to the pristine base)."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        return {k: walk(v) for k, v in node.items() if not k.startswith("lora_")}
+
+    return walk(params)
+
+
+# ---------------------------------------------------------------------------
+# PEFT-layout adapter export/import (adapter_model.safetensors)
+# ---------------------------------------------------------------------------
+
+
+def lora_state_dict(params: Params) -> Dict[str, np.ndarray]:
+    """Adapters as a PEFT-style state dict:
+    ``base_model.model.<path>.lora_A.weight [r, in]`` (torch layout) etc."""
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(node, prefix):
+        if not isinstance(node, dict):
+            return
+        if "lora_a" in node:
+            base = f"base_model.model.{prefix}"
+            flat[f"{base}.lora_A.weight"] = np.ascontiguousarray(np.asarray(node["lora_a"]).T)
+            flat[f"{base}.lora_B.weight"] = np.ascontiguousarray(np.asarray(node["lora_b"]).T)
+            return
+        for k, v in node.items():
+            walk(v, f"{prefix}.{k}" if prefix else k)
+
+    walk(params, "")
+    return flat
+
+
+def save_lora_adapter(params: Params, path: str, train: TrainConfig) -> None:
+    """Write ``adapter_model.safetensors`` + ``adapter_config.json`` (the HF
+    PEFT directory layout, loadable by ``peft.PeftModel``)."""
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    state = lora_state_dict(params)
+    if not state:
+        raise ValueError("params carry no LoRA adapters")
+    save_file(state, os.path.join(path, "adapter_model.safetensors"), metadata={"format": "pt"})
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump(
+            {
+                "peft_type": "LORA",
+                "r": train.lora_rank,
+                "lora_alpha": train.lora_alpha,
+                "lora_dropout": train.lora_dropout,
+                "target_modules": list(train.lora_target_modules),
+                "task_type": "CAUSAL_LM",
+            },
+            f,
+            indent=2,
+        )
+
+
+def load_lora_adapter(params: Params, path: str, train: TrainConfig = None) -> Params:
+    """Attach adapters from a PEFT directory onto a base params pytree.
+
+    The scale comes from the directory's own ``adapter_config.json`` (the
+    adapter is self-describing); ``train`` is only a fallback for bare
+    directories without a config file."""
+    import json
+    import os
+
+    from safetensors.numpy import load_file
+
+    state = load_file(os.path.join(path, "adapter_model.safetensors"))
+    cfg_path = os.path.join(path, "adapter_config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            acfg = json.load(f)
+        scale = np.float32(acfg["lora_alpha"] / acfg["r"])
+    elif train is not None:
+        scale = np.float32(train.lora_alpha / train.lora_rank)
+    else:
+        raise ValueError(f"{path} has no adapter_config.json and no TrainConfig given")
+
+    def walk(node, prefix):
+        if not isinstance(node, dict):
+            return node
+        base = f"base_model.model.{prefix}" if prefix else "base_model.model"
+        a_name = f"{base}.lora_A.weight"
+        if "kernel" in node and a_name in state:
+            out = dict(node)
+            out["lora_a"] = jnp.asarray(np.ascontiguousarray(state[a_name].T))
+            out["lora_b"] = jnp.asarray(
+                np.ascontiguousarray(state[f"{base}.lora_B.weight"].T)
+            )
+            out["lora_scale"] = jnp.asarray(scale)
+            return out
+        return {k: walk(v, f"{prefix}.{k}" if prefix else k) for k, v in node.items()}
+
+    return walk(params, "")

@@ -1,0 +1,269 @@
+"""Pipeline parallelism (GPipe schedule) over a ``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 marks it absent;
+the MPMD-pipeline paper in PAPERS.md is its design pointer). This is the
+TPU-native expression: not MPMD processes with send/recv, but ONE SPMD
+program over a ``pipe`` mesh axis where
+
+- each stage device holds a contiguous slice of the transformer blocks
+  (stacked layer-major, so the per-stage compute is a ``lax.scan`` over its
+  own layers — one compiled block body regardless of depth);
+- activations move stage-to-stage with ``jax.lax.ppermute`` (ICI
+  neighbor-exchange, the cheapest collective on a TPU torus);
+- the GPipe timetable is a ``lax.scan`` over ``M + S - 1`` ticks: stage ``s``
+  processes microbatch ``t - s`` at tick ``t`` (bubble ticks compute on
+  zeros and are masked out);
+- the BACKWARD pipeline is not hand-written at all: ``jax.grad`` through the
+  scan + ppermute yields the reversed schedule automatically — the
+  correctness-by-construction benefit of a functional pipeline.
+
+Embedding/unembedding and the final norm live outside the pipelined blocks:
+embedding is applied to all microbatches up front (host of stage 0 data),
+the last stage's outputs are collected, and the loss closes over them. The
+embedding table is replicated across stages (it is ~3% of SmolLM3's params).
+
+Scope: first-class building block with exact-parity tests against the plain
+``forward`` path (tests/test_pipeline.py). Not yet wired into SFTTrainer's
+mesh config — TP/FSDP/SP cover the BASELINE.json configs; the pipeline axis
+targets models whose layer count, not width, is the scaling constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+import optax
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig
+from llm_fine_tune_distributed_tpu.models.transformer import _block, unembed
+from llm_fine_tune_distributed_tpu.ops.norms import rms_norm
+from llm_fine_tune_distributed_tpu.ops.rope import rope_cos_sin
+
+
+def stack_stage_params(params: Dict, config: ModelConfig, num_stages: int) -> Dict:
+    """Layer dicts -> leaves stacked [num_layers, ...] (layer-major).
+
+    Sharding the leading dim over ``pipe`` gives each stage its contiguous
+    block of layers; within a stage the compute scans over the local slice.
+    """
+    if config.num_layers % num_stages:
+        raise ValueError(
+            f"{config.num_layers} layers not divisible by {num_stages} stages"
+        )
+    layers = params["model"]["layers"]
+    return jax.tree.map(
+        lambda *leaves: jnp.stack(leaves),
+        *[layers[str(i)] for i in range(config.num_layers)],
+    )
+
+
+def stage_sharding(mesh: Mesh):
+    """Stacked layer leaves: leading (layer) dim sharded over ``pipe``."""
+    return NamedSharding(mesh, P("pipe"))
+
+
+def pipeline_forward(
+    params: Dict,
+    stacked_layers: Dict,
+    input_ids,
+    config: ModelConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    *,
+    padding_mask=None,
+    compute_dtype=jnp.bfloat16,
+    remat_blocks: bool = True,
+    output_hidden: bool = False,
+    return_aux: bool = False,
+):
+    """Pipelined forward: logits for ``input_ids [M * mb, seq]``.
+
+    ``params`` holds the non-pipelined leaves (embedding, final norm, lm_head
+    if untied), replicated; ``stacked_layers`` are the transformer blocks
+    stacked [L, ...] and sharded over ``pipe``. ``padding_mask [M*mb, seq]``
+    (1 = real token) travels the schedule alongside each microbatch.
+
+    MoE models work too: each stage accumulates its layers' router aux loss
+    in the scan carry, bubble ticks are masked out, and the psum over the
+    pipe axis yields the total. With ``return_aux=True`` the result is
+    ``(out, aux)`` where aux is the layer-SUM averaged over microbatches —
+    the same scale ``models/transformer.forward`` returns per microbatch.
+    (Experts are replicated within a stage — the pipe axis does not compose
+    with expert parallelism.)
+    """
+    S = mesh.shape["pipe"]
+    M = num_microbatches
+    B, seq = input_ids.shape
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    L_local = config.num_layers // S
+
+    embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
+    ids = input_ids.reshape(M, mb, seq)  # token ids, NOT embeddings: 4 bytes
+    # per position instead of 2*h — the schedule's replicated input stays tiny
+    if padding_mask is None:
+        padding_mask = jnp.ones((B, seq), jnp.float32)
+    pm = padding_mask.reshape(M, mb, seq)
+    positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None], (mb, seq))
+    cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
+    # Per-layer RoPE flags as DATA: the layer scan compiles one block body,
+    # and NoPE-interleaved models (SmolLM3) select rope/no-rope per layer.
+    # Uniform patterns (every preset except NoPE ones) skip the
+    # rotate-then-select and keep the static branch.
+    flags_list = [config.uses_rope(i) for i in range(config.num_layers)]
+    uniform_rope = all(flags_list) or not any(flags_list)
+    rope_flags = jnp.asarray(flags_list, jnp.bool_)
+
+    def run_stage(stage_layers, x, mask, stage_flags):
+        """Scan my L_local blocks over x [mb, seq, h]; returns (x, aux_sum)."""
+
+        def one_block(carry, args):
+            h, aux = carry
+            layer_params, flag = args
+            h, _, layer_aux = _block(
+                layer_params, h, cos, sin, mask, None, None, None, 0,
+                config=config, layer_idx=0, attention_impl="xla",
+                compute_dtype=compute_dtype,
+                rope_flag=None if uniform_rope else flag,
+            )
+            return (h, aux + layer_aux), None
+
+        body = jax.checkpoint(one_block) if remat_blocks else one_block
+        (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)), (stage_layers, stage_flags))
+        return x, aux
+
+    def spmd(stacked_local, embed_local, ids_local, pm_local, flags_local):
+        # stacked_local: this stage's layers [L_local, ...]; ids_local/
+        # pm_local: the full microbatch token ids + padding masks (replicated
+        # — int32/float32 [M, mb, seq], ~1000x smaller than embedded
+        # activations); embed_local: the embedding table (replicated, it is
+        # a param).
+        s = jax.lax.axis_index("pipe")
+        T = M + S - 1
+        h_dim = embed_local.shape[-1]
+
+        def tick(carry, t):
+            buf, aux_sum = carry  # [mb, seq, h] activation arriving at my stage
+            m = t - s    # microbatch index my stage works on this tick
+            m_safe = jnp.clip(m, 0, M - 1)
+            # stage 0 embeds its own microbatch; others use the received
+            # buffer. lax.cond (not where) so stages > 0 skip the [mb, seq, h]
+            # embedding gather at runtime — legal here because neither branch
+            # holds a collective.
+            my_ids = jax.lax.dynamic_index_in_dim(
+                ids_local, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+            )
+            x_in = jax.lax.cond(
+                s == 0,
+                lambda: embed_local[my_ids].astype(buf.dtype),
+                lambda: buf,
+            )
+            # my microbatch's padding mask rides the same timetable
+            mask = jax.lax.dynamic_index_in_dim(pm_local, m_safe, axis=0, keepdims=False)
+            y, aux_tick = run_stage(stacked_local, x_in, mask, flags_local)
+            # mask bubble ticks so garbage never enters the ring (or the aux)
+            valid = (m >= 0) & (m < M)
+            y = jnp.where(valid, y, jnp.zeros_like(y))
+            aux_sum = aux_sum + jnp.where(valid, aux_tick, 0.0)
+            # pass to the next stage (last stage's output falls off the end)
+            y_next = jax.lax.ppermute(
+                y, "pipe", [(i, (i + 1) % S) for i in range(S)]
+            )
+            # last stage emits microbatch m_out = t - (S - 1)
+            out = jnp.where(s == S - 1, y, jnp.zeros_like(y))
+            return (y_next, aux_sum), out
+
+        (_, aux_local), outs = jax.lax.scan(
+            tick,
+            (jnp.zeros((mb, seq, h_dim), compute_dtype), jnp.float32(0.0)),
+            jnp.arange(T),
+        )
+        # total router aux over every (stage, microbatch), averaged over
+        # microbatches -> the per-microbatch layer-sum scale forward() uses
+        aux = jax.lax.psum(aux_local, "pipe") / M
+        # outs [T, mb, seq, h]: last stage's real outputs live at ticks
+        # t = m + S - 1; drop the S-1 bubble rows first so the collective
+        # moves only real data. When M divides S-ways, reduce-scatter leaves
+        # each stage 1/S of the output (sharded over pipe) instead of a full
+        # all-reduce copy per stage.
+        outs = outs[S - 1 :]
+        if M % S == 0:
+            return (
+                jax.lax.psum_scatter(outs, "pipe", scatter_dimension=0, tiled=True),
+                aux,
+            )
+        return jax.lax.psum(outs, "pipe"), aux
+
+    out_spec = P("pipe") if M % S == 0 else P()
+    outs, aux = shard_map(
+        spmd,
+        mesh=mesh,
+        in_specs=(P("pipe"), P(), P(), P(), P("pipe")),
+        out_specs=(out_spec, P()),
+        check_vma=False,
+    )(stacked_layers, embed, ids, pm, rope_flags)
+
+    # [M, mb, seq, h] -> final norm (+ unembed unless the caller chunks the
+    # loss; same code path as the plain forward for exact parity)
+    h = outs.reshape(B, seq, -1)
+    h = rms_norm(h, params["model"]["norm"]["weight"], config.rms_norm_eps)
+    if output_hidden:
+        out = h.astype(compute_dtype)
+    else:
+        out = unembed(params, h, config, compute_dtype=compute_dtype, logits_dtype=jnp.float32)
+    return (out, aux) if return_aux else out
+
+
+def pipeline_loss_fn(
+    params: Dict,
+    stacked_layers: Dict,
+    batch: Dict,
+    config: ModelConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    compute_dtype=jnp.bfloat16,
+    loss_chunk_size=None,
+):
+    """Masked next-token CE through the pipeline (same objective as
+    train/step.py's make_loss_fn, including the chunked large-vocab path and
+    the MoE router aux term at the same layer-mean scale).
+    Differentiable: jax.grad through this yields the reverse-schedule
+    backward pipeline automatically."""
+    targets = batch["input_ids"][:, 1:]
+    mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+    tokens = jnp.maximum(mask.sum(), 1.0)
+    want_aux = config.num_experts > 0
+
+    def add_aux(loss, aux):
+        if not want_aux:
+            return loss
+        return loss + config.router_aux_coef * aux / config.num_layers
+
+    if loss_chunk_size is not None:
+        # never materialize [B, seq, vocab] logits (128k-vocab models):
+        # unembed chunk-by-chunk exactly like train/step.py
+        from llm_fine_tune_distributed_tpu.train.step import chunked_ce_sum
+
+        hidden, aux = pipeline_forward(
+            params, stacked_layers, batch["input_ids"], config, mesh,
+            num_microbatches, padding_mask=batch.get("attention_mask"),
+            compute_dtype=compute_dtype, output_hidden=True, return_aux=True,
+        )
+        ce_sum = chunked_ce_sum(
+            params, hidden[:, :-1], targets, mask, config, loss_chunk_size,
+            compute_dtype,
+        )
+        return add_aux(ce_sum / tokens, aux)
+    logits, aux = pipeline_forward(
+        params, stacked_layers, batch["input_ids"], config, mesh,
+        num_microbatches, padding_mask=batch.get("attention_mask"),
+        compute_dtype=compute_dtype, return_aux=True,
+    )
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
+    return add_aux((ce * mask).sum() / tokens, aux)

@@ -1,0 +1,159 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has NO long-context path — context is fixed at 1024 tokens and
+its only attention optimization is flash-attn-2 for memory (SURVEY.md §5.7;
+reference ``training.py:282``, ``requirements.txt:10``). This module is the
+TPU-native long-context design the survey calls for: each device in the
+``seq`` mesh axis holds one contiguous chunk of the sequence, K/V chunks
+rotate around the ICI ring with ``jax.lax.ppermute``, and every device
+accumulates its queries' attention with the blockwise online-softmax
+recurrence (the same math as the Pallas flash kernel in
+ops/flash_attention.py, lifted from VMEM blocks to mesh shards).
+
+Peak memory per device is O(seq/N * seq/N) score tiles instead of O(seq^2),
+and the N-1 ppermute hops overlap with the blockwise compute — XLA pipelines
+the collective-permute against the einsums, which is what makes this the
+idiomatic TPU expression of context parallelism (vs. all-gathering K/V).
+
+Called inside ``jax.shard_map`` (fully manual over all mesh axes): batch is
+sharded over (data, fsdp), heads over tensor, sequence over seq. Gradients
+flow through ``ppermute`` (reverse permutation on backward), so the same code
+path trains — no separate backward kernel needed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -2.0e38  # finite: (-inf) arithmetic breeds NaNs in the recurrence
+
+
+def _local_ring_attention(q, k, v, padding_mask, *, axis_name: str, axis_size: int, causal: bool):
+    """Blockwise attention over ring-rotated K/V chunks.
+
+    Runs on ONE device's shards inside shard_map:
+      q: [b, lq, h, d]   — this device's query chunk (lq = seq / axis_size)
+      k, v: [b, lk, hk, d] — this device's K/V chunk, rotated each step
+      padding_mask: [b, lk] (1 = real token) rotated alongside, or None.
+    """
+    my_idx = jax.lax.axis_index(axis_name)
+    b, lq, num_heads, d = q.shape
+    lk, num_kv = k.shape[1], k.shape[2]
+    groups = num_heads // num_kv
+
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    # [b, lq, hk, g, d] — GQA grouping computed once.
+    qg = (q.astype(jnp.float32) * scale).reshape(b, lq, num_kv, groups, d)
+    q_pos = my_idx * lq + jnp.arange(lq)
+
+    # Online-softmax carry: running max m, denominator l, weighted output o.
+    o = jnp.zeros((b, num_kv, groups, lq, d), jnp.float32)
+    m = jnp.full((b, num_kv, groups, lq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, num_kv, groups, lq), jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    cur_k, cur_v, cur_pad = k, v, padding_mask
+
+    for t in range(axis_size):
+        # After t forward rotations this device holds chunk (my_idx - t).
+        kv_idx = (my_idx - t) % axis_size
+        k_pos = kv_idx * lk + jnp.arange(lk)
+
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, cur_k.astype(jnp.float32)
+        )  # [b, hk, g, lq, lk]
+        if causal:
+            cmask = k_pos[None, :] <= q_pos[:, None]  # [lq, lk]
+            scores = jnp.where(cmask[None, None, None], scores, _NEG_INF)
+        if cur_pad is not None:
+            pm = cur_pad.astype(bool)[:, None, None, None, :]
+            scores = jnp.where(pm, scores, _NEG_INF)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhgqk,bkhd->bhgqd", p, cur_v.astype(jnp.float32))
+        m = m_new
+
+        if t < axis_size - 1:
+            cur_k = jax.lax.ppermute(cur_k, axis_name, perm)
+            cur_v = jax.lax.ppermute(cur_v, axis_name, perm)
+            if cur_pad is not None:
+                cur_pad = jax.lax.ppermute(cur_pad, axis_name, perm)
+
+    # Fully-masked rows (pad queries) have l == 0; their output is dropped by
+    # the loss mask, so any finite value works.
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    # [b, hk, g, lq, d] -> [b, lq, h, d]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, lq, num_heads, d)
+    return out.astype(q.dtype)
+
+
+def seq_parallel_preconditions(q, k, mesh: Optional[Mesh], *, axis_name: str = "seq",
+                               sliding_window: Optional[int] = None,
+                               causal: bool = True) -> bool:
+    """Checks shared by BOTH sequence-parallel strategies (ring here, Ulysses
+    in parallel/ulysses.py): a live seq axis, causal non-windowed training
+    attention (no decode q_len != kv_len), and shapes divisible by the mesh.
+    Keeping one source of truth stops the two ``*_supported`` predicates from
+    drifting apart."""
+    if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] <= 1:
+        return False
+    if sliding_window is not None or not causal:
+        return False  # cross-chunk window bookkeeping not implemented
+    if q.shape[1] != k.shape[1]:
+        return False  # decode/KV-cache path (q_len != kv_len): positions would lie
+    n_seq = mesh.shape[axis_name]
+    tensor = mesh.shape.get("tensor", 1)
+    batch_ways = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    b, s, num_heads, _ = q.shape
+    num_kv = k.shape[2]
+    return (
+        s % n_seq == 0
+        and b % batch_ways == 0
+        and num_heads % tensor == 0
+        and num_kv % tensor == 0
+        and (num_heads // tensor) % max(num_kv // tensor, 1) == 0
+    )
+
+
+def ring_attention_supported(q, k, mesh: Optional[Mesh], *, axis_name: str = "seq",
+                             sliding_window: Optional[int] = None, causal: bool = True) -> bool:
+    return seq_parallel_preconditions(
+        q, k, mesh, axis_name=axis_name, sliding_window=sliding_window, causal=causal
+    )
+
+
+def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = "seq", padding_mask=None,
+                   causal: bool = True):
+    """Global-view entry: shard q/k/v over the mesh and run the ring.
+
+    q: [batch, seq, heads, dim]; k, v: [batch, seq, kv_heads, dim];
+    padding_mask: optional [batch, seq], 1 = real token.
+    Layout contract matches ops/attention.py; call sites go through
+    ``ops.attention.attention(impl="ring", mesh=...)``.
+    """
+    axis_size = mesh.shape[axis_name]
+    qkv_spec = P(("data", "fsdp"), axis_name, "tensor", None)
+    pad_spec = P(("data", "fsdp"), axis_name)
+
+    local = partial(
+        _local_ring_attention, axis_name=axis_name, axis_size=axis_size, causal=causal
+    )
+
+    has_pad = padding_mask is not None
+    fn = jax.shard_map(
+        (lambda q_, k_, v_, p_: local(q_, k_, v_, p_)) if has_pad
+        else (lambda q_, k_, v_: local(q_, k_, v_, None)),
+        mesh=mesh,
+        in_specs=(qkv_spec,) * 3 + ((pad_spec,) if has_pad else ()),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, padding_mask) if has_pad else fn(q, k, v)

@@ -1,0 +1,130 @@
+"""Sharding rules: param-path -> PartitionSpec over the (data, fsdp, tensor, seq) mesh.
+
+This module is the TPU-native replacement for the reference's entire
+parallelism story (DDP-only, ``ddp_backend="nccl"`` reference
+``training.py:285``) and its aspired FSDP next step (external-doc article):
+
+- DP    : params replicated; batch split over (data, fsdp); gradients psum'd
+          by XLA (the analog of NCCL bucketed all-reduce,
+          ``docs/architecture-diagram.md:119-135``).
+- FSDP  : each param's largest dim additionally sharded over ``fsdp``
+          (ZeRO-3); XLA turns the gradient psum into reduce-scatter +
+          all-gather automatically.
+- TP    : Megatron-style — attention q/k/v and MLP gate/up shard their output
+          dim over ``tensor``; o_proj and down shard their input dim, so each
+          block needs exactly two psums (inserted by XLA from the annotations).
+- seq   : reserved for ring attention (parallel/ring_attention.py).
+
+Rules are by HF param path, so they apply to every model in models/configs.py.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llm_fine_tune_distributed_tpu.utils.tree import map_with_path
+
+# (path regex, spec builder) — first match wins. Specs are (dim0, dim1) for
+# matrices, (dim0,) for vectors. None = replicated on that dim.
+# "tensor-column": output dim over tensor; "tensor-row": input dim over tensor.
+# NF4-quantized kernels (ops/nf4.py) keep the base kernel's orientation:
+# packed [in/8, out] and absmax [in/block, out] shard like kernel [in, out]
+# (_validate_spec drops any axis the smaller dims no longer divide).
+_QK = r"kernel(_nf4|_absmax|_absmax_q)?$"
+_MATRIX_RULES = [
+    # attention projections
+    (re.compile(r".*self_attn/(q_proj|k_proj|v_proj)/" + _QK), ("fsdp", "tensor")),
+    (re.compile(r".*self_attn/o_proj/" + _QK), ("tensor", "fsdp")),
+    # MLP
+    (re.compile(r".*mlp/(gate_proj|up_proj)/" + _QK), ("fsdp", "tensor")),
+    (re.compile(r".*mlp/down_proj/" + _QK), ("tensor", "fsdp")),
+    # embeddings: [vocab, hidden] — shard vocab over tensor, hidden over fsdp
+    (re.compile(r".*embed_tokens/weight$"), ("tensor", "fsdp")),
+    (re.compile(r".*lm_head/kernel$"), ("fsdp", "tensor")),
+    # LoRA adapters: A [in, r] shard in-dim like the base kernel's in-dim;
+    # B [r, out] shard out-dim. Conservative: fsdp only (r is tiny).
+    (re.compile(r".*/lora_a$"), ("fsdp", None)),
+    (re.compile(r".*/lora_b$"), (None, "fsdp")),
+    # MoE (ops/moe.py): stacked expert weights shard the expert dim over the
+    # "expert" axis (expert parallelism) plus the usual fsdp/tensor dims;
+    # the router gate [h, E] is tiny — fsdp on the input dim only.
+    # NF4-quantized experts ([E, in/8, out] packed + [E, in/block, out]
+    # absmax) keep the same orientation; _validate_spec drops any dim the
+    # packed shapes no longer divide.
+    (re.compile(r".*block_sparse_moe/experts/(w1|w3)(_nf4|_absmax|_absmax_q)?$"),
+     ("expert", "fsdp", "tensor")),
+    (re.compile(r".*block_sparse_moe/experts/w2(_nf4|_absmax|_absmax_q)?$"),
+     ("expert", "tensor", "fsdp")),
+    (re.compile(r".*block_sparse_moe/gate/kernel$"), ("fsdp", None)),
+]
+
+
+def param_spec(path: str, ndim: int) -> P:
+    """PartitionSpec for one param."""
+    if ndim <= 1:
+        # norms / biases / scalars: replicated (tiny).
+        return P()
+    for pat, dims in _MATRIX_RULES:
+        if pat.match(path):
+            return P(*dims)
+    return P()
+
+
+def param_sharding_rules(params, mesh: Mesh):
+    """Pytree of NamedSharding matching ``params``' structure.
+
+    Falls back to replication for any dim whose size does not divide the mesh
+    axis (e.g. tiny test models on an 8-way fsdp axis).
+    """
+
+    def rule(path: str, leaf) -> NamedSharding:
+        spec = param_spec(path, getattr(leaf, "ndim", 0))
+        spec = _validate_spec(spec, getattr(leaf, "shape", ()), mesh)
+        return NamedSharding(mesh, spec)
+
+    return map_with_path(rule, params)
+
+
+def _validate_spec(spec: P, shape, mesh: Mesh) -> P:
+    fixed = []
+    for i, axis in enumerate(spec):
+        if axis is None:
+            fixed.append(None)
+            continue
+        if axis == "expert" and axis not in mesh.shape:
+            # the one axis that is legitimately optional (meshes built before
+            # MoE support have 4 axes): replicate the expert dim. Any OTHER
+            # unknown axis is a bug in the rules and raises below.
+            fixed.append(None)
+            continue
+        size = mesh.shape[axis]
+        if i < len(shape) and shape[i] % size == 0:
+            fixed.append(axis)
+        else:
+            fixed.append(None)
+    while fixed and fixed[-1] is None:
+        fixed.pop()
+    return P(*fixed)
+
+
+def shard_params(params, mesh: Mesh):
+    """Place a (host-local) params pytree onto the mesh per the rules."""
+    shardings = param_sharding_rules(params, mesh)
+    return jax.device_put(params, shardings)
+
+
+def batch_spec(mesh: Mesh, seq_axis: bool = False) -> P:
+    """Batch arrays [batch, seq, ...]: batch over (data, fsdp), optionally
+    sequence over seq (ring attention)."""
+    if seq_axis and mesh.shape["seq"] > 1:
+        return P(("data", "fsdp"), "seq")
+    return P(("data", "fsdp"))
+
+
+def logical_batch_sharding(mesh: Mesh, seq_axis: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh, seq_axis))

@@ -1,0 +1,151 @@
+"""Ulysses-style all-to-all sequence/context parallelism.
+
+The second context-parallel strategy beside ring attention
+(parallel/ring_attention.py). The reference has no long-context path at all
+(SURVEY.md §5.7 — fixed 1024-token context, reference ``training.py:282``);
+this module and the ring are the TPU-native designs that subsume it.
+
+Where the ring keeps queries resident and rotates K/V chunks around the ICI
+ring, Ulysses (DeepSpeed-Ulysses, arXiv:2309.14509 — pattern reference only)
+re-partitions with two ``all_to_all`` collectives:
+
+  [batch, seq/N, heads, dim]  --all_to_all-->  [batch, seq, heads/N, dim]
+      (sequence-sharded)                         (head-sharded)
+
+so every device runs an ordinary *full-sequence* attention over its subset of
+heads — which means the Pallas flash kernel (ops/flash_attention.py) runs
+unmodified on the head-sharded view, something the ring's online-softmax
+recurrence cannot reuse. After attention, the inverse all_to_all restores the
+sequence sharding for the (sequence-sharded) o_proj matmul.
+
+Trade-offs vs the ring, honestly:
+- Ulysses moves O(seq * heads * dim / N) bytes twice per layer regardless of
+  masking; the ring moves K/V (kv_heads, typically ≤ heads/4 under GQA) N-1
+  times but cannot use the flash kernel. On ICI both are cheap; Ulysses wins
+  when the flash kernel's VMEM blocking beats XLA attention (long seq), the
+  ring wins when kv_heads << heads and seq is extreme.
+- Ulysses parallelism degree is capped by ``num_kv_heads`` (each device needs
+  ≥1 KV head); the ring is capped only by sequence length.
+
+Gradients flow through ``all_to_all`` natively (its transpose is the inverse
+all_to_all), so the same code path trains.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _a2a_seq_to_heads(x, axis_name: str):
+    """[b, seq/N, h, d] (seq-sharded) -> [b, seq, h/N, d] (head-sharded)."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _a2a_heads_to_seq(x, axis_name: str):
+    """[b, seq, h/N, d] (head-sharded) -> [b, seq/N, h, d] (seq-sharded)."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _local_ulysses_attention(
+    q, k, v, padding_mask, *, axis_name: str, causal: bool, attention_impl: str
+):
+    """Runs on ONE device's shards inside shard_map.
+
+    q: [b, lq, h, d], k/v: [b, lq, hk, d] — this device's sequence chunk
+    (lq = seq / N). padding_mask: [b, lq] (1 = real token) or None.
+    """
+    # Re-partition: full sequence, 1/N of the heads.
+    q = _a2a_seq_to_heads(q, axis_name)  # [b, s, h/N, d]
+    k = _a2a_seq_to_heads(k, axis_name)  # [b, s, hk/N, d]
+    v = _a2a_seq_to_heads(v, axis_name)
+    if padding_mask is not None:
+        # Every device needs the whole mask for its full-sequence attention.
+        padding_mask = jax.lax.all_gather(
+            padding_mask, axis_name, axis=1, tiled=True
+        )  # [b, s]
+
+    # Ordinary attention on the head-sharded view. The flash kernel applies
+    # when shapes allow; otherwise the dispatch falls back to XLA attention.
+    from llm_fine_tune_distributed_tpu.ops.attention import attention
+
+    out = attention(
+        q, k, v, impl=attention_impl, padding_mask=padding_mask, causal=causal
+    )  # [b, s, h/N, d]
+
+    # Restore sequence sharding for the residual stream.
+    return _a2a_heads_to_seq(out, axis_name)  # [b, lq, h, d]
+
+
+def ulysses_attention_supported(
+    q,
+    k,
+    mesh: Optional[Mesh],
+    *,
+    axis_name: str = "seq",
+    sliding_window: Optional[int] = None,
+    causal: bool = True,
+) -> bool:
+    """Same contract as ``ring_attention_supported``: the dispatch calls this
+    with global-view shapes and falls back to XLA attention when False.
+    Beyond the shared preconditions, the all_to_all re-partition needs each
+    seq-axis device to receive whole (query and KV) heads."""
+    from llm_fine_tune_distributed_tpu.parallel.ring_attention import (
+        seq_parallel_preconditions,
+    )
+
+    if not seq_parallel_preconditions(
+        q, k, mesh, axis_name=axis_name, sliding_window=sliding_window, causal=causal
+    ):
+        return False
+    n_seq = mesh.shape[axis_name]
+    tensor = mesh.shape.get("tensor", 1)
+    heads_local = q.shape[2] // max(tensor, 1)
+    kv_local = k.shape[2] // max(tensor, 1)
+    # (post-a2a GQA grouping needs no extra check: the preconditions give
+    # heads_local % kv_local == 0, so whole groups divide alongside kv heads)
+    return heads_local % n_seq == 0 and kv_local % n_seq == 0
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    *,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    padding_mask=None,
+    causal: bool = True,
+    attention_impl: str = "flash",
+):
+    """Global-view entry: shard q/k/v over the mesh and run Ulysses.
+
+    q: [batch, seq, heads, dim]; k, v: [batch, seq, kv_heads, dim];
+    padding_mask: optional [batch, seq], 1 = real token. Layout contract
+    matches ops/attention.py; call sites go through
+    ``ops.attention.attention(impl="ulysses", mesh=...)``.
+    """
+    qkv_spec = P(("data", "fsdp"), axis_name, "tensor", None)
+    pad_spec = P(("data", "fsdp"), axis_name)
+
+    local = partial(
+        _local_ulysses_attention,
+        axis_name=axis_name,
+        causal=causal,
+        attention_impl=attention_impl,
+    )
+
+    has_pad = padding_mask is not None
+    fn = jax.shard_map(
+        (lambda q_, k_, v_, p_: local(q_, k_, v_, p_)) if has_pad
+        else (lambda q_, k_, v_: local(q_, k_, v_, None)),
+        mesh=mesh,
+        in_specs=(qkv_spec,) * 3 + ((pad_spec,) if has_pad else ()),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, padding_mask) if has_pad else fn(q, k, v)

@@ -1,0 +1,6 @@
+from llm_fine_tune_distributed_tpu.runtime.mesh import make_mesh, MESH_AXES  # noqa: F401
+from llm_fine_tune_distributed_tpu.runtime.distributed import (  # noqa: F401
+    initialize_distributed,
+    is_primary_host,
+    runtime_info,
+)

@@ -1,0 +1,85 @@
+"""Cross-host parameter-desync detection.
+
+The reference's runbook diagnoses gradient desync by eyeballing "different
+loss on master vs worker" logs (reference
+docs/single-vs-distributed-comparison.md:571-580; SURVEY.md §5.2). The
+systematic version: every N steps each host computes one scalar checksum of
+its addressable trainable shards and all hosts exchange them. Two invariants
+are enforced:
+
+1. finiteness — NaN/Inf anywhere in the trainable set fails fast;
+2. replication agreement — for fully-replicated params (pure DP), every
+   host's checksum must be bit-comparable; a mismatch means the hosts'
+   "identical" replicas diverged (input skew, restore mixup, bitflip).
+
+Sharded (FSDP/TP) params legitimately differ per host, so invariant 2 only
+applies to the replicated subset.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+
+
+def _host_checksums(trainable) -> Tuple[float, float]:
+    """(replicated_sum, all_local_sum) over this host's addressable shards."""
+    replicated = np.float64(0.0)
+    everything = np.float64(0.0)
+    for path in sorted(trainable):
+        arr = trainable[path]
+        shards = getattr(arr, "addressable_shards", None)
+        if shards is None:  # plain numpy/unsharded array
+            s = np.sum(np.asarray(arr, dtype=np.float64))
+            replicated += s
+            everything += s
+            continue
+        local = np.float64(0.0)
+        for shard in shards:
+            # np.sum (not nansum): NaN must PROPAGATE to trip invariant 1.
+            local += np.sum(np.asarray(shard.data, dtype=np.float64))
+        everything += local
+        if getattr(arr, "is_fully_replicated", False):
+            replicated += local
+    return float(replicated), float(everything)
+
+
+def check_param_sync(trainable, rtol: float = 0.0) -> Tuple[bool, list]:
+    """Returns (in_sync, per_host_replicated_checksums)."""
+    rep_sum, all_sum = _host_checksums(trainable)
+    if not np.isfinite(all_sum):
+        return False, [rep_sum]
+    if jax.process_count() == 1:
+        return True, [rep_sum]
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(
+        multihost_utils.process_allgather(np.array([rep_sum]))
+    ).reshape(-1)
+    if not np.isfinite(gathered).all():
+        return False, gathered.tolist()
+    ref = gathered[0]
+    tol = abs(ref) * rtol
+    return bool(np.all(np.abs(gathered - ref) <= tol)), gathered.tolist()
+
+
+class DesyncMonitor:
+    """Step-cadenced wrapper used by the trainer."""
+
+    def __init__(self, every_n_steps: int):
+        self.every = every_n_steps
+        self.last_checksums: list = []
+
+    def maybe_check(self, step: int, trainable) -> bool:
+        if not self.every or step % self.every:
+            return True
+        ok, sums = check_param_sync(trainable)
+        self.last_checksums = sums
+        if not ok:
+            raise RuntimeError(
+                f"parameter desync/corruption detected at step {step}: "
+                f"per-host checksums {sums}"
+            )
+        return ok

@@ -1,0 +1,89 @@
+"""Failure detector: Python facade over the native heartbeat mesh
+(native/heartbeat.cc).
+
+The reference's failure story is "Kubernetes restarts the pod"
+(``restartPolicy: OnFailure``, reference deploy/pytorchjob.yaml:14,94) plus a
+hand-run runbook for NCCL hangs (reference
+docs/single-vs-distributed-comparison.md:528-592). Here host 0 runs a TCP
+coordinator, every host heartbeats into it, and the trainer polls
+``dead_ranks()`` between steps — so a wedged host is *detected* (and the run
+can checkpoint-and-exit for the JobSet to restart) instead of hanging in a
+collective until the cluster-level timeout.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from llm_fine_tune_distributed_tpu.runtime import native
+
+
+class FailureDetector:
+    """Start on every host; host 0 additionally hosts the coordinator.
+
+    ``coordinator_host`` plays the MASTER_ADDR role (reference
+    training.py:19-23); ``port`` its heartbeat analog of master port 23456.
+    """
+
+    def __init__(
+        self,
+        *,
+        rank: int,
+        world_size: int,
+        coordinator_host: str = "127.0.0.1",
+        port: int = 23457,
+        interval_ms: int = 500,
+        timeout_ms: int = 5000,
+    ):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError(f"native runtime unavailable: {native.build_error()}")
+        self.rank = rank
+        self.world_size = world_size
+        self.timeout_ms = timeout_ms
+        self._coord = None
+        if rank == 0:
+            self._coord = self._lib.hb_start_coordinator(port, world_size)
+            if not self._coord:
+                raise RuntimeError(f"heartbeat coordinator failed to bind port {port}")
+            port = self._lib.hb_coordinator_port(self._coord)
+        self.port = port
+        self._worker = self._lib.hb_start_worker(
+            coordinator_host.encode(), port, rank, interval_ms
+        )
+
+    def dead_ranks(self, timeout_ms: Optional[int] = None) -> List[int]:
+        """Ranks silent past the timeout (coordinator only; [] on workers)."""
+        if self._coord is None:
+            return []
+        mask = self._lib.hb_dead_mask(self._coord, timeout_ms or self.timeout_ms)
+        return [r for r in range(min(self.world_size, 64)) if mask & (1 << min(r, 63))]
+
+    def rank_age_ms(self, rank: int) -> int:
+        """ms since ``rank`` last heartbeat (-1: never seen; coordinator only)."""
+        if self._coord is None:
+            return -1
+        return int(self._lib.hb_rank_age_ms(self._coord, rank))
+
+    def all_alive(self) -> bool:
+        return not self.dead_ranks()
+
+    def stop(self) -> None:
+        if getattr(self, "_worker", None):
+            self._lib.hb_stop_worker(self._worker)
+            self._worker = None
+        if getattr(self, "_coord", None):
+            self._lib.hb_stop_coordinator(self._coord)
+            self._coord = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass

@@ -1,0 +1,2 @@
+from llm_fine_tune_distributed_tpu.train.state import TrainState  # noqa: F401
+from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer  # noqa: F401

@@ -1,0 +1,86 @@
+"""Checkpointing: Orbax multi-host sharded save/restore with keep-N rotation,
+best-eval-loss tracking, and explicit resume.
+
+Reference parity (C9/C10 + SURVEY.md §5.4):
+- ``save_steps=500`` / ``save_total_limit=3`` rotation (``training.py:268,276``)
+  -> CheckpointManagerOptions(max_to_keep, save_interval_steps handled by caller);
+- best-model tracking on eval_loss (``load_best_model_at_end``,
+  ``training.py:273-275``) -> best_fn over per-step metrics, and the manager
+  additionally keeps the best step;
+- the reference has NO explicit resume path (SURVEY.md §5.4) — here
+  ``latest_step``/restore make resume-from-latest a first-class flag;
+- rank-0-only torch.save is replaced by a sharded multi-host Orbax save
+  (every host writes its shard — no single-host bottleneck), while the
+  single-file safetensors export for the inference contract
+  (``best_model/``, ``training.py:310-311``) is done separately at end of
+  training via models/hf_io.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from llm_fine_tune_distributed_tpu.train.state import TrainState
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        metric_name: str = "eval_loss",
+        greater_is_better: bool = False,
+    ):
+        directory = os.path.abspath(directory)
+        if jax.process_index() == 0:
+            os.makedirs(directory, exist_ok=True)
+        self.metric_name = metric_name
+        self.greater_is_better = greater_is_better
+        # Missing metric maps to the WORST value for the configured mode so a
+        # metric-less checkpoint can never rank best.
+        worst = -float("inf") if greater_is_better else float("inf")
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            best_fn=(lambda m: m.get(metric_name, worst)) if metric_name else None,
+            best_mode="max" if greater_is_better else "min",
+            keep_checkpoints_without_metrics=True,
+            create=True,
+        )
+        self._mgr = ocp.CheckpointManager(directory, options=options)
+
+    def save(self, step: int, state: TrainState, metrics: Optional[Dict[str, float]] = None):
+        # metrics=None stays None (not {}) so Orbax's
+        # keep_checkpoints_without_metrics applies to metric-less saves.
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(state=ocp.args.StandardSave(state)),
+            metrics=metrics,
+        )
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    @property
+    def best_step(self) -> Optional[int]:
+        return self._mgr.best_step()
+
+    def restore(self, step: int, abstract_state: TrainState) -> TrainState:
+        """Restore into the given abstract state (jax.eval_shape of the real
+        one, carrying shardings) so arrays land directly on the right devices."""
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract_state)),
+        )
+        return restored["state"]
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

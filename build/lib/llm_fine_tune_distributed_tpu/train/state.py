@@ -1,0 +1,23 @@
+"""Training state pytree.
+
+Unlike the reference (where DDP/optimizer state is hidden inside TRL/HF
+Trainer, reference ``training.py:289-300``), the state here is an explicit,
+shardable pytree: trainable and frozen params are SEPARATE flat dicts so that
+gradients, Adam moments, and buffer donation only ever touch the trainable
+13.62% (the freezing policy's memory contract, reference ``claude.md:241-245``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.struct
+import jax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array            # scalar int32 — global optimizer step
+    trainable: Dict[str, Any]  # flat {path: leaf}, param_dtype (f32 master)
+    frozen: Dict[str, Any]     # flat {path: leaf}, compute_dtype (bf16)
+    opt_state: Any             # optax state over `trainable` only

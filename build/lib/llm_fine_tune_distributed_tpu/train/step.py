@@ -1,0 +1,198 @@
+"""The jit-compiled train/eval steps — the hot loop the reference delegates to
+TRL/HF (``trainer.train()``, reference ``training.py:300``; loop anatomy in
+SURVEY.md §3.1). One XLA program per optimizer step:
+
+  scan over grad-accum microbatches (fwd+bwd, remat'd blocks)
+  -> mean grads -> clip(1.0) -> AdamW on trainable subset -> new state
+
+Gradient synchronization across data-parallel devices is NOT explicit: the
+loss averages over the (sharded) global microbatch, so jax.grad's psum is
+emitted by XLA from the sharding annotations — the compiler-native equivalent
+of DDP's bucketed NCCL all-reduce (reference ``docs/architecture-diagram.md:119-135``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig, TrainConfig, str_to_dtype
+from llm_fine_tune_distributed_tpu.models.transformer import forward, unembed
+from llm_fine_tune_distributed_tpu.train.state import TrainState
+from llm_fine_tune_distributed_tpu.utils.tree import merge_flat
+
+
+def chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfig, chunk_size: int, compute_dtype, mesh=None):
+    """Masked cross-entropy SUM computed in sequence chunks.
+
+    Unembeds ``chunk_size`` positions at a time (each chunk rematerialized on
+    backward) so peak HBM holds one [batch, chunk, vocab] f32 tile instead of
+    the full [batch, seq, vocab] logits — what makes 128k-vocab models
+    trainable on a 16GB chip at seq 1024.
+    """
+    b, s, h = hidden.shape
+    pad = (-s) % chunk_size
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk_size
+    # [n_chunks, batch, chunk, ...] so lax.map scans over chunks
+    hc = hidden.reshape(b, n, chunk_size, h).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n, chunk_size).transpose(1, 0, 2)
+    mc = mask.reshape(b, n, chunk_size).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def one_chunk(args):
+        h_c, t_c, m_c = args
+        logits = unembed(params, h_c, model_config, compute_dtype=compute_dtype, mesh=mesh)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, t_c)
+        return (ce * m_c).sum()
+
+    return jax.lax.map(one_chunk, (hc, tc, mc)).sum()
+
+
+def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activation_sharding=None,
+                 quant_impl: Optional[str] = None, include_router_aux: bool = True):
+    compute_dtype = str_to_dtype(train_config.compute_dtype)
+    chunk = train_config.loss_chunk_size
+    quant_impl = quant_impl or train_config.quant_matmul_impl
+    # MoE: add the load-balancing aux loss to the TRAIN objective only (eval
+    # loss stays pure CE so perplexity/best-model tracking is comparable with
+    # dense runs). Dense models skip the plumbing entirely.
+    want_aux = include_router_aux and model_config.num_experts > 0
+
+    def loss_fn(trainable, frozen, batch):
+        """Masked next-token cross-entropy (token-mean within the batch) —
+        the SFT objective TRL computes for packing=False full-sequence LM
+        loss (reference ``training.py:282-283``). Returns (loss, token_count)."""
+        params = merge_flat(trainable, frozen)
+        packed_kw = {}
+        if "segment_ids" in batch:  # packing=True path (data/packing.py)
+            packed_kw = {
+                "segment_ids": batch["segment_ids"],
+                "positions": batch["positions"],
+            }
+        result = forward(
+            params,
+            batch["input_ids"],
+            model_config,
+            padding_mask=batch["attention_mask"],
+            **packed_kw,
+            attention_impl=train_config.attention_impl,
+            compute_dtype=compute_dtype,
+            remat=train_config.gradient_checkpointing,
+            remat_policy=train_config.resolved_remat_policy(model_config),
+            activation_sharding=activation_sharding,
+            logits_dtype=jnp.float32,
+            output_hidden=chunk is not None,
+            quant_impl=quant_impl,
+            return_aux=want_aux,
+        )
+        out = result[0]
+        targets = batch["input_ids"][:, 1:]
+        mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+        tokens = jnp.maximum(mask.sum(), 1.0)
+        if chunk is not None:
+            ce_sum = chunked_ce_sum(
+                params, out[:, :-1], targets, mask, model_config, chunk, compute_dtype,
+                mesh=getattr(activation_sharding, "mesh", None),
+            )
+        else:
+            ce = optax.softmax_cross_entropy_with_integer_labels(out[:, :-1], targets)
+            ce_sum = (ce * mask).sum()
+        loss = ce_sum / tokens
+        if want_aux:
+            # layer-MEAN of the per-layer aux (forward returns the sum), so
+            # router_aux_coef is depth-independent — matching the effective
+            # scale of HF Mixtral's router_aux_loss_coef rather than growing
+            # the balancing pressure 32x on a 32-layer model
+            aux = result[2] / model_config.num_layers
+            loss = loss + model_config.router_aux_coef * aux
+        return loss, tokens
+
+    return loss_fn
+
+
+def build_train_step(
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    optimizer: optax.GradientTransformation,
+    activation_sharding=None,
+    quant_impl: Optional[str] = None,
+) -> Callable:
+    """Returns train_step(state, batch) -> (state, metrics).
+
+    ``batch`` arrays are [grad_accum, per_device_or_host_batch, seq]; the
+    accumulation loop is a lax.scan so XLA compiles ONE program regardless of
+    the accumulation factor (reference ``gradient_accumulation_steps=4``,
+    ``training.py:262``).
+    """
+    loss_fn = make_loss_fn(model_config, train_config, activation_sharding, quant_impl)
+    accum = train_config.gradient_accumulation_steps
+
+    def train_step(state: TrainState, batch):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def micro_step(carry, micro):
+            g_acc, loss_acc = carry
+            (loss, _tokens), grads = grad_fn(state.trainable, state.frozen, micro)
+            g_acc = jax.tree.map(jnp.add, g_acc, grads)
+            return (g_acc, loss_acc + loss), None
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.trainable)
+        (g_sum, loss_sum), _ = jax.lax.scan(micro_step, (zeros, jnp.float32(0.0)), batch)
+
+        # Mean over accumulation steps (HF semantics: mean of microbatch means).
+        grads = jax.tree.map(lambda g: g / accum, g_sum)
+        loss = loss_sum / accum
+
+        grad_norm = optax.global_norm(grads)  # pre-clip, matches HF's logged grad_norm
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.trainable)
+        new_trainable = optax.apply_updates(state.trainable, updates)
+
+        new_state = state.replace(
+            step=state.step + 1,
+            trainable=new_trainable,
+            opt_state=new_opt_state,
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+        }
+        return new_state, metrics
+
+    return train_step
+
+
+def build_eval_step(
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    activation_sharding=None,
+    quant_impl: Optional[str] = None,
+) -> Callable:
+    """eval_step(state, batch[b, s]) -> (sum_ce, token_count).
+
+    Returns sums (not means) so the caller aggregates a token-weighted eval
+    loss over the whole validation set — the quantity behind
+    ``eval_loss``/best-model tracking (reference ``training.py:273-275``)."""
+    loss_fn = make_loss_fn(
+        model_config, train_config, activation_sharding, quant_impl,
+        include_router_aux=False,
+    )
+
+    def eval_step(state: TrainState, batch):
+        loss, tokens = loss_fn(state.trainable, state.frozen, batch)
+        return loss * tokens, tokens
+
+    return eval_step
+
+
+def jit_train_step(train_step, donate_state: bool = True):
+    """Jit with state donation — the step's output state reuses the input
+    buffers (param + opt-state memory is not duplicated during the update)."""
+    return jax.jit(train_step, donate_argnums=(0,) if donate_state else ())

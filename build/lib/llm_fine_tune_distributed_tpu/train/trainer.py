@@ -1,0 +1,780 @@
+"""First-party SFT trainer — the replacement for the reference's entire
+L1 delegation to TRL SFTTrainer + Accelerate (reference ``training.py:289-300``
+and SURVEY.md §3.1 hot loop).
+
+End-to-end responsibilities (reference parity points cited inline):
+- model init or HF-checkpoint load, bf16 compute (``training.py:97-102``)
+- freezing policy: last-2 blocks + lm_head (``training.py:113-149``)
+- dataset: parquet -> 90/10 seed-42 split -> ChatML (``training.py:155-212``)
+- jitted train loop: grad-accum 4, clip 1.0, lr x dp_size, linear decay
+  (``training.py:258-287``), eval every 10 steps, log every 2 + first
+  (``training.py:266-271``)
+- best-eval-loss tracking + load-best-at-end (``training.py:273-275``)
+- Orbax checkpoint rotation keep-3 (``training.py:268,276``) + explicit resume
+  (absent in the reference, SURVEY.md §5.4)
+- host-0 artifact contract: ``best_model/`` safetensors + tokenizer,
+  ``training_history.json``, ``training_summary.json`` (``training.py:307-339``)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llm_fine_tune_distributed_tpu.config import ModelConfig, TrainConfig, str_to_dtype
+from llm_fine_tune_distributed_tpu.data.dataset import (
+    build_sft_arrays,
+    load_qa_dataset,
+    train_validation_split,
+)
+from llm_fine_tune_distributed_tpu.data.loader import SFTBatchLoader
+from llm_fine_tune_distributed_tpu.data.tokenizer import load_tokenizer
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.hf_io import load_hf_checkpoint, save_hf_checkpoint
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.observe.metrics import MetricLogger
+from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter
+from llm_fine_tune_distributed_tpu.parallel.freeze import describe_trainable, trainable_mask
+from llm_fine_tune_distributed_tpu.parallel.optimizer import build_lr_schedule, build_optimizer
+from llm_fine_tune_distributed_tpu.parallel.sharding import param_spec
+from llm_fine_tune_distributed_tpu.runtime.distributed import (
+    device_preflight,
+    is_primary_host,
+)
+from llm_fine_tune_distributed_tpu.runtime.mesh import data_parallel_size, make_mesh
+from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+from llm_fine_tune_distributed_tpu.train.state import TrainState
+from llm_fine_tune_distributed_tpu.train.step import (
+    build_eval_step,
+    build_train_step,
+    jit_train_step,
+)
+from llm_fine_tune_distributed_tpu.utils.tree import merge_flat, split_by_mask
+
+
+class SFTTrainer:
+    def __init__(
+        self,
+        config: TrainConfig,
+        model_config: Optional[ModelConfig] = None,
+        tokenizer=None,
+        mesh=None,
+        rng_seed: Optional[int] = None,
+    ):
+        self.config = config
+        self.model_config = model_config or get_preset(config.model_preset)
+        self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
+        self.dp_size = data_parallel_size(self.mesh)
+        self.tokenizer = tokenizer or load_tokenizer(
+            config.tokenizer_path or config.model_name
+        )
+        self.rng = jax.random.PRNGKey(config.seed if rng_seed is None else rng_seed)
+        # subclasses (DPO) stash extra eval-time scalars here; merged into the
+        # metric sinks whenever an eval fires
+        self.extra_eval_logs: Dict[str, float] = {}
+        self.metrics = MetricLogger(
+            config.output_dir,
+            aim_repo=config.aim_repo,
+            experiment=config.experiment_name,
+        )
+        if is_primary_host():
+            os.makedirs(os.path.join(config.output_dir, "best_model"), exist_ok=True)
+        device_preflight()
+
+        self._prepare_data()
+        self._prepare_state()
+        self._prepare_steps()
+
+    # ------------------------------------------------------------------ data
+
+    def _prompt_kwargs(self) -> Dict[str, Any]:
+        """system_prompt override for the array builders (shared SFT/DPO)."""
+        if self.config.system_prompt is not None:
+            return {"system_prompt": self.config.system_prompt}
+        return {}
+
+    def _loader_kwargs(self) -> Dict[str, Any]:
+        """Batch-loader kwargs (shared SFT/DPO so sharding semantics can't drift)."""
+        cfg = self.config
+        return dict(
+            per_device_batch_size=cfg.per_device_batch_size,
+            grad_accum_steps=cfg.gradient_accumulation_steps,
+            data_parallel_size=self.dp_size,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            seed=cfg.seed,
+            drop_last=cfg.drop_last,
+        )
+
+    def _prepare_data(self) -> None:
+        cfg = self.config
+        dataset_path = os.path.join(cfg.data_dir, cfg.dataset_file)
+        rows = load_qa_dataset(dataset_path)
+        if is_primary_host():
+            print(f"Total dataset size: {len(rows):,} Q&A pairs")
+        train_rows, val_rows = train_validation_split(
+            rows, test_size=cfg.validation_fraction, seed=cfg.split_seed
+        )
+        self.n_train, self.n_val = len(train_rows), len(val_rows)
+        if is_primary_host():
+            print(f"Training samples: {self.n_train:,}")
+            print(f"Validation samples: {self.n_val:,}")
+
+        prompt_kw = self._prompt_kwargs()
+        if cfg.packing:
+            # packing=True: multiple examples per fixed-length row with
+            # segment ids / per-segment positions (data/packing.py). Rows
+            # shrink, so steps_per_epoch and the sample counters reflect
+            # PACKED rows, matching TRL's packing accounting.
+            from llm_fine_tune_distributed_tpu.data.packing import (
+                build_packed_sft_arrays,
+                packing_efficiency,
+            )
+
+            self.train_arrays = build_packed_sft_arrays(
+                train_rows, self.tokenizer, cfg.max_seq_length,
+                cfg.completion_only_loss, **prompt_kw,
+            )
+            self.val_arrays = build_packed_sft_arrays(
+                val_rows, self.tokenizer, cfg.max_seq_length,
+                cfg.completion_only_loss, **prompt_kw,
+            )
+            self.n_train = self.train_arrays["input_ids"].shape[0]
+            self.n_val = self.val_arrays["input_ids"].shape[0]
+            if is_primary_host():
+                print(
+                    f"Packing: {len(train_rows):,} examples -> {self.n_train:,} "
+                    f"rows ({100 * packing_efficiency(self.train_arrays):.1f}% "
+                    f"token occupancy)"
+                )
+        else:
+            self.train_arrays = build_sft_arrays(
+                train_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
+                **prompt_kw,
+            )
+            self.val_arrays = build_sft_arrays(
+                val_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
+                **prompt_kw,
+            )
+        loader_kw = self._loader_kwargs()
+        self.loader = None
+        if cfg.use_native_loader and cfg.packing:
+            if is_primary_host():
+                print("[data] packing=True uses the Python loader (the C++ "
+                      "pipeline assembles the unpacked key triplet)")
+        elif cfg.use_native_loader:
+            # C++ prefetch pipeline (native/loader.cc): batch assembly overlaps
+            # device step time. Falls back to the Python loader without g++.
+            # The two engines use different (each deterministic) permutations,
+            # so the choice must be UNANIMOUS across hosts — a mixed fleet
+            # would shard different epoch orders and silently desync the data.
+            from llm_fine_tune_distributed_tpu.runtime import native
+
+            use_native = native.available()
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                votes = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.array([1 if use_native else 0], np.int32)
+                    )
+                ).reshape(-1)
+                use_native = bool(votes.min())
+            if use_native:
+                from llm_fine_tune_distributed_tpu.data.native_loader import (
+                    NativeBatchLoader,
+                )
+
+                self.loader = NativeBatchLoader(self.train_arrays, **loader_kw)
+            elif is_primary_host():
+                print(f"[data] native loader unavailable on >=1 host "
+                      f"({native.build_error()}); all hosts using Python loader")
+        if self.loader is None:
+            self.loader = SFTBatchLoader(self.train_arrays, **loader_kw)
+        self.steps_per_epoch = self.loader.steps_per_epoch
+        self.total_steps = self.steps_per_epoch * cfg.epochs
+
+    # ----------------------------------------------------------------- state
+
+    def _load_or_init_params(self):
+        cfg, mc = self.config, self.model_config
+        compute_dtype = str_to_dtype(cfg.compute_dtype)
+        source = cfg.model_name
+        if source and (os.path.isdir(source) or source.endswith(".safetensors")):
+            if is_primary_host():
+                print(f"Loading model weights from: {source}")
+            return load_hf_checkpoint(source, mc, dtype=np.float32)
+        if is_primary_host():
+            print(
+                f"No local checkpoint at {source!r}; random-initializing "
+                f"{mc.name} ({mc.num_params:,} params)"
+            )
+        return init_params(self.rng, mc, dtype=jnp.float32)
+
+    def _prepare_state(self) -> None:
+        cfg, mc = self.config, self.model_config
+        params = self._load_or_init_params()
+        if cfg.freeze_strategy in ("lora", "qlora"):
+            # Attach adapters (A kaiming, B zero: step-0 model == base model);
+            # only lora_a/lora_b train (parallel/freeze.py), so optimizer
+            # state shrinks to the adapter footprint.
+            from llm_fine_tune_distributed_tpu.parallel.lora import add_lora_from_config
+
+            params = add_lora_from_config(params, self.rng, cfg)
+        mask = trainable_mask(params, mc, cfg)
+        self.trainable_report = describe_trainable(params, mask)
+        if is_primary_host():
+            r = self.trainable_report
+            print(
+                f"Trainable: {r['trainable_parameters']:,}/{r['total_parameters']:,} "
+                f"({r['trainable_percent']}%)"
+            )
+
+        trainable, frozen = split_by_mask(params, mask)
+        del params
+        param_dtype = str_to_dtype(cfg.param_dtype)
+        compute_dtype = str_to_dtype(cfg.compute_dtype)
+        # Master copies: trainable in f32, frozen in compute dtype (bf16) —
+        # frozen params carry no optimizer state and need no f32 master.
+        trainable = {k: jnp.asarray(v, param_dtype) for k, v in trainable.items()}
+        if cfg.freeze_strategy == "qlora":
+            # NF4-quantize the frozen block linears (from full precision —
+            # quantizing an already-bf16 cast would double the rounding).
+            # MoE models included: stacked [E, h, f] expert weights quantize
+            # per-expert (ops/nf4.quantize_nf4_stacked).
+            from llm_fine_tune_distributed_tpu.parallel.qlora import (
+                quantize_frozen,
+                quantized_fraction,
+            )
+
+            frozen = quantize_frozen(
+                frozen, cfg.quant_block_size, cfg.quant_double_quant
+            )
+            if is_primary_host():
+                print(
+                    f"QLoRA: {100 * quantized_fraction(frozen):.1f}% of frozen "
+                    f"bytes in NF4 (block {cfg.quant_block_size}, "
+                    f"double_quant={cfg.quant_double_quant})"
+                )
+        frozen = {
+            k: jnp.asarray(v, compute_dtype)
+            # scales stay f32; packed codes / int8 absmax keep their dtype
+            if jnp.issubdtype(v.dtype, jnp.floating) and "absmax" not in k
+            else jnp.asarray(v)
+            for k, v in frozen.items()
+        }
+
+        # Shard onto the mesh per path rules.
+        def put(flat):
+            return {
+                k: jax.device_put(
+                    v,
+                    NamedSharding(
+                        self.mesh, self._validated_spec(k, v)
+                    ),
+                )
+                for k, v in flat.items()
+            }
+
+        trainable = put(trainable)
+        frozen = put(frozen)
+
+        self.optimizer = build_optimizer(
+            cfg, None, total_steps=self.total_steps, data_parallel_size=self.dp_size
+        )
+        opt_state = jax.jit(self.optimizer.init)(trainable)
+        # Adam moments inherit the param shardings via propagation, but
+        # scalar leaves (e.g. the Adam step count) come out single-device;
+        # replicate them over the mesh so the whole state shares one device
+        # set (restore-from-checkpoint builds shardings from this state).
+        full_device_set = set(np.asarray(self.mesh.devices).flat)
+
+        def on_full_mesh(x):
+            if getattr(x, "sharding", None) and set(x.sharding.device_set) == full_device_set:
+                return x
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+        opt_state = jax.tree.map(on_full_mesh, opt_state)
+        self.state = TrainState(
+            # replicated over the mesh so restore() places it consistently
+            step=jax.device_put(
+                jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())
+            ),
+            trainable=trainable,
+            frozen=frozen,
+            opt_state=opt_state,
+        )
+        self.lr_schedule = build_lr_schedule(cfg, self.total_steps, self.dp_size)
+
+    def _validated_spec(self, path: str, leaf) -> P:
+        from llm_fine_tune_distributed_tpu.parallel.sharding import _validate_spec
+
+        return _validate_spec(param_spec(path, leaf.ndim), leaf.shape, self.mesh)
+
+    # ----------------------------------------------------------------- steps
+
+    def _make_shardings(self) -> NamedSharding:
+        """Set batch/eval shardings; return the activation sharding.
+
+        Sequence parallelism: when a seq axis is live and a sequence-parallel
+        attention impl ("ring" or "ulysses") is selected, activations and
+        batches shard the sequence dim too — the ring
+        (parallel/ring_attention.py) rotates K/V over that axis; ulysses
+        (parallel/ulysses.py) re-partitions heads with all_to_all.
+        Shared by the SFT and DPO step builders so the rules can't drift.
+        """
+        if self.config.packing and self.config.attention_impl in ("ring", "ulysses"):
+            raise ValueError(
+                f"packing=True is incompatible with attention_impl="
+                f"{self.config.attention_impl!r} (sequence parallelism has no "
+                "segment support); use flash/xla attention for packed runs, "
+                "or disable packing for sequence-parallel long-context runs"
+            )
+        seq_sharded = (
+            self.config.attention_impl in ("ring", "ulysses")
+            and self.mesh.shape["seq"] > 1
+        )
+        if (
+            seq_sharded
+            and jax.process_count() > 1
+            and self.mesh.shape["seq"] * self.mesh.shape["tensor"]
+            > jax.local_device_count()
+        ):
+            # The loader hands each process host-complete sequences; a seq
+            # axis crossing process boundaries would need seq-sliced host
+            # data too. Keep the ring within a host (ICI) for now.
+            raise NotImplementedError(
+                "multi-host runs require the seq axis to fit within one "
+                f"host's devices (seq*tensor={self.mesh.shape['seq'] * self.mesh.shape['tensor']}"
+                f" > local devices {jax.local_device_count()}); reshape the mesh"
+            )
+        seq_ax = "seq" if seq_sharded else None
+        act = NamedSharding(self.mesh, P(("data", "fsdp"), seq_ax, None))
+        self._batch_sharding = NamedSharding(self.mesh, P(None, ("data", "fsdp"), seq_ax))
+        self._eval_sharding = NamedSharding(self.mesh, P(("data", "fsdp"), seq_ax))
+        return act
+
+    def _tokens_per_sample(self) -> int:
+        """Data tokens one 'sample' consumes (DPO overrides: a pair is 2 seqs)."""
+        return self.config.max_seq_length
+
+    def _resolved_quant_impl(self) -> str:
+        """The fused Pallas decode kernel is not SPMD-partitionable by the
+        sharding propagator; sharded runs take the XLA dequant path (still
+        4-bit at rest in HBM, one layer decoded at a time under remat)."""
+        if self.config.quant_matmul_impl == "auto" and self.mesh.size > 1:
+            return "xla"
+        return self.config.quant_matmul_impl
+
+    def _prepare_steps(self) -> None:
+        act = self._make_shardings()
+        quant_impl = self._resolved_quant_impl()
+        train_step = build_train_step(
+            self.model_config, self.config, self.optimizer, activation_sharding=act,
+            quant_impl=quant_impl,
+        )
+        self.train_step = jit_train_step(train_step)
+        self.eval_step = jax.jit(
+            build_eval_step(self.model_config, self.config, activation_sharding=act,
+                            quant_impl=quant_impl)
+        )
+
+    def _device_batch(
+        self, batch: Dict[str, np.ndarray], sharding, local_shards: bool = False
+    ) -> Dict[str, jax.Array]:
+        # "lengths" never reaches here: the loader strips it before yielding.
+        #
+        # Two multi-process cases:
+        # - local_shards=True (training): each process holds only ITS column
+        #   of the global batch (data/loader.py shards by process_index), so
+        #   the global array is assembled from per-process pieces.
+        # - local_shards=False (eval): every process builds the identical full
+        #   batch, and device_put's global semantics take each host's shard.
+        if local_shards and jax.process_count() > 1:
+            # Global shape is the loader contract — batch dim (axis 1 of
+            # [accum, per_host_batch, seq]) is split contiguously by process
+            # index, everything else host-complete. Passing it explicitly
+            # (instead of letting inference guess from the sharding) keeps
+            # this correct for meshes whose batch axes do not span every
+            # process uniformly.
+            return {
+                k: jax.make_array_from_process_local_data(
+                    sharding,
+                    v,
+                    (v.shape[0], v.shape[1] * jax.process_count(), *v.shape[2:]),
+                )
+                for k, v in batch.items()
+            }
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+
+    # ------------------------------------------------------------------ eval
+
+    def evaluate(self) -> float:
+        """Token-weighted eval loss over the validation split
+        (eval cadence contract: reference ``training.py:270-271``)."""
+        cfg = self.config
+        bs = cfg.per_device_batch_size * self.dp_size
+        n = self.val_arrays["input_ids"].shape[0]
+        if n == 0:
+            return float("nan")
+        total_ce, total_tokens = 0.0, 0.0
+        for lo in range(0, n, bs):
+            batch = {
+                k: v[lo : lo + bs]
+                for k, v in self.val_arrays.items()
+                if k != "lengths"
+            }
+            short = bs - batch["input_ids"].shape[0]
+            if short > 0:
+                # pad the tail batch; padded rows carry zero loss_mask so they
+                # contribute no tokens to the token-weighted loss. Pad rows
+                # must not produce fully-masked attention rows: attention_mask
+                # is set real, and (packing) segment_ids nonzero so each pad
+                # token still attends to itself.
+                for key in batch:
+                    pad_block = np.zeros((short,) + batch[key].shape[1:], batch[key].dtype)
+                    if key in ("attention_mask", "segment_ids"):
+                        pad_block[:] = 1
+                    batch[key] = np.concatenate([batch[key], pad_block])
+            batch = self._device_batch(batch, self._eval_sharding)
+            ce, tokens = self.eval_step(self.state, batch)
+            total_ce += float(ce)
+            total_tokens += float(tokens)
+        return total_ce / max(total_tokens, 1.0)
+
+    # ------------------------------------------------------------------ train
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        ckpt_dir = os.path.join(cfg.output_dir, "checkpoints")
+        ckpt = CheckpointManager(
+            ckpt_dir,
+            max_to_keep=cfg.save_total_limit,
+            metric_name=cfg.metric_for_best_model,
+            greater_is_better=cfg.greater_is_better,
+        )
+
+        resumed_step = 0
+        if cfg.resume_from_checkpoint:
+            resumed_step = self._resume(ckpt)
+        start_epoch = resumed_step // self.steps_per_epoch
+        # Mid-epoch resume: skip the batches this epoch already consumed
+        # (loader epochs are deterministic) so no sample trains twice and the
+        # lr schedule ends exactly at total_steps.
+        skip_batches = resumed_step % self.steps_per_epoch
+
+        best_eval = float("inf") if not cfg.greater_is_better else -float("inf")
+        best_trainable = None
+        last_eval: Optional[float] = None
+        meter = ThroughputMeter(
+            n_chips=self.mesh.size, tokens_per_sample=self._tokens_per_sample()
+        )
+        samples_per_step = cfg.per_device_batch_size * cfg.gradient_accumulation_steps * self.dp_size
+
+        if is_primary_host():
+            print(
+                f"Starting SFT: {cfg.epochs} epochs x {self.steps_per_epoch} steps, "
+                f"effective batch {samples_per_step}, mesh {dict(self.mesh.shape)}"
+            )
+
+        # Failure detection (native/heartbeat.cc): auto-on for multi-host runs
+        # so a wedged peer is detected instead of hanging in a collective.
+        detector = None
+        if cfg.heartbeat or jax.process_count() > 1:
+            try:
+                from llm_fine_tune_distributed_tpu.runtime.failure import FailureDetector
+
+                coordinator = os.environ.get("MASTER_ADDR", "127.0.0.1")
+                detector = FailureDetector(
+                    rank=jax.process_index(),
+                    world_size=jax.process_count(),
+                    coordinator_host=coordinator,
+                    port=cfg.heartbeat_port,
+                    timeout_ms=cfg.heartbeat_timeout_ms,
+                )
+            except RuntimeError as e:
+                if is_primary_host():
+                    print(f"[runtime] heartbeat unavailable: {e}")
+        from llm_fine_tune_distributed_tpu.observe.profiler import StepProfiler
+        from llm_fine_tune_distributed_tpu.runtime.desync import DesyncMonitor
+
+        desync = DesyncMonitor(cfg.desync_check_steps)
+        profiler = StepProfiler(cfg.profile_dir)
+
+        t_start = time.perf_counter()
+        step = int(self.state.step)
+        final_loss = None
+
+        try:
+            for epoch in range(start_epoch, cfg.epochs):
+                batches = self.loader.epoch(epoch)
+                if epoch == start_epoch and skip_batches:
+                    import itertools
+
+                    batches = itertools.islice(batches, skip_batches, None)
+                for batch in batches:
+                    dev_batch = self._device_batch(
+                        batch, self._batch_sharding, local_shards=True
+                    )
+                    self.state, metrics = self.train_step(self.state, dev_batch)
+                    # sync before stamping the meter: under async dispatch the
+                    # step returns at ENQUEUE time, and per-step host gaps
+                    # would otherwise measure dispatch, not device time —
+                    # making the steady-state median meaningless. One small
+                    # host sync per multi-second step is noise.
+                    jax.block_until_ready(metrics["loss"])
+                    step += 1
+                    meter.update(samples_per_step)
+                    profiler.step(step)
+
+                    desync.maybe_check(step, self.state.trainable)
+                    if detector is not None and not detector.all_alive():
+                        dead = detector.dead_ranks()
+                        # Fail fast so the job manager restarts the fleet and
+                        # resumes from the last periodic checkpoint. No save
+                        # here: a sharded Orbax save needs EVERY host to
+                        # participate, and with a peer dead it would hang —
+                        # the exact collective-timeout limbo this detector
+                        # exists to avoid.
+                        raise RuntimeError(
+                            f"hosts {dead} stopped heartbeating at step {step}; "
+                            "aborting for restart+resume"
+                        )
+
+                    do_log = (
+                        (cfg.logging_first_step and step == 1)
+                        or (cfg.logging_steps and step % cfg.logging_steps == 0)
+                    )
+                    do_eval = cfg.eval_steps and step % cfg.eval_steps == 0 and self.n_val > 0
+                    do_save = cfg.save_steps and step % cfg.save_steps == 0
+
+                    if do_eval:
+                        last_eval = self.evaluate()
+                        improved = (
+                            last_eval > best_eval if cfg.greater_is_better else last_eval < best_eval
+                        )
+                        if improved:
+                            best_eval = last_eval
+                            if cfg.load_best_model_at_end:
+                                # single-process: snapshot to host RAM (free
+                                # HBM). Multi-process: param shards are not
+                                # host-fetchable — keep an on-device copy
+                                # with the same shardings instead.
+                                if jax.process_count() == 1:
+                                    best_trainable = jax.tree.map(
+                                        lambda x: np.asarray(x), self.state.trainable
+                                    )
+                                else:
+                                    best_trainable = jax.tree.map(
+                                        jnp.copy, self.state.trainable
+                                    )
+
+                    if do_log or do_eval:
+                        final_loss = float(metrics["loss"])
+                        logs = {
+                            "loss": final_loss,
+                            "learning_rate": float(self.lr_schedule(step - 1)),
+                            **meter.snapshot(),
+                        }
+                        # every scalar the step emits (grad_norm always;
+                        # rewards_* for DPO) rides into the metric sinks
+                        for k, v in metrics.items():
+                            if k != "loss" and getattr(v, "ndim", 0) == 0:
+                                logs[k] = float(v)
+                        if do_eval:
+                            logs["eval_loss"] = last_eval
+                            logs.update(self.extra_eval_logs)
+                        self.metrics.log(step, step / self.steps_per_epoch, logs)
+
+                    if do_save:
+                        ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+        finally:
+            profiler.close()
+            if detector is not None:
+                detector.stop()
+
+        # end of training: final checkpoint + optional best-model restore
+        if last_eval is None and self.n_val > 0:
+            last_eval = self.evaluate()
+            if cfg.load_best_model_at_end and (
+                last_eval < best_eval if not cfg.greater_is_better else last_eval > best_eval
+            ):
+                best_eval = last_eval
+                best_trainable = None  # current state IS best
+        ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+        ckpt.wait()
+
+        if cfg.load_best_model_at_end and best_trainable is not None:
+            # reload best-eval weights (reference load_best_model_at_end,
+            # training.py:273-275)
+            self.state = self.state.replace(
+                trainable={
+                    k: jax.device_put(v, self.state.trainable[k].sharding)
+                    for k, v in best_trainable.items()
+                }
+            )
+
+        wall = time.perf_counter() - t_start
+        throughput = meter.snapshot()
+        summary = self._save_artifacts(final_loss, last_eval, wall, throughput)
+        ckpt.close()
+        self.metrics.close()
+        return summary
+
+    def _resume(self, ckpt: CheckpointManager) -> int:
+        target = self.config.resume_from_checkpoint
+        step = ckpt.latest_step if target in ("latest", "true", "1") else int(target)
+        if step is None:
+            if is_primary_host():
+                print("No checkpoint found to resume from; starting fresh")
+            return 0
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            self.state,
+        )
+        self.state = ckpt.restore(step, abstract)
+        resumed_step = int(self.state.step)
+        if is_primary_host():
+            print(f"Resumed from checkpoint step {resumed_step}")
+        return resumed_step
+
+    # -------------------------------------------------------------- artifacts
+
+    def _host_fetch(self, flat: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
+        """Flat param dict -> host numpy, correct under multi-process.
+
+        Sharded leaves of a multi-process mesh are not host-fetchable
+        directly; reshard them to fully-replicated first (an all-gather
+        collective — so when process_count > 1 EVERY host must call this,
+        see _save_artifacts).
+        """
+        if jax.process_count() == 1:
+            return {k: np.asarray(v) for k, v in flat.items()}
+        replicated = NamedSharding(self.mesh, P())
+        out = {}
+        primary = is_primary_host()
+        for k, v in flat.items():
+            if not v.sharding.is_fully_replicated:
+                v = jax.device_put(v, replicated)
+            if primary:
+                # only the writing host pays the device->host transfer and
+                # host RAM; the others just participated in the collective
+                out[k] = np.asarray(v)
+        return out
+
+    def _save_artifacts(
+        self,
+        final_loss: Optional[float],
+        eval_loss: Optional[float],
+        wall_seconds: float,
+        throughput: Dict[str, float],
+    ) -> Dict[str, Any]:
+        """Artifact contract of reference ``training.py:307-339`` (host 0):
+        best_model/ safetensors + tokenizer, training_history.json,
+        training_summary.json with the same keys (+ TPU-native extras)."""
+        cfg = self.config
+        summary = {
+            "model_name": cfg.model_name,
+            "dataset_path": os.path.join(cfg.data_dir, cfg.dataset_file),
+            "epochs": cfg.epochs,
+            "batch_size": cfg.per_device_batch_size,
+            "learning_rate": cfg.learning_rate,
+            "trainable_params": self.trainable_report["trainable_parameters"],
+            "total_params": self.trainable_report["total_parameters"],
+            "training_samples": self.n_train,
+            "validation_samples": self.n_val,
+            "final_train_loss": final_loss,
+            "world_size": self.dp_size,
+            "distributed_training": self.dp_size > 1,
+            # TPU-native extras (north-star instrumentation)
+            "final_eval_loss": eval_loss,
+            "wall_clock_seconds": round(wall_seconds, 2),
+            "mesh": dict(self.mesh.shape),
+            **{k: round(v, 4) for k, v in throughput.items()},
+        }
+        # Host fetch runs on EVERY host: resharding a multi-process array to
+        # replicated is a collective, and a host-0-only collective deadlocks.
+        frozen_flat = self._host_fetch(self.state.frozen)
+        trainable_flat = self._host_fetch(self.state.trainable)
+        if not is_primary_host():
+            return summary
+
+        best_dir = os.path.join(cfg.output_dir, "best_model")
+        if cfg.freeze_strategy == "qlora":
+            # Export contract is plain safetensors (reference training.py:310):
+            # decode the NF4 base back to bf16 so the inference CLI / HF
+            # loaders see ordinary kernels.
+            from llm_fine_tune_distributed_tpu.parallel.qlora import dequantize_frozen
+
+            frozen_flat = {
+                k: np.asarray(v)
+                for k, v in dequantize_frozen(frozen_flat, jnp.float32).items()
+            }
+        params = merge_flat(trainable_flat, frozen_flat)
+        if cfg.freeze_strategy in ("lora", "qlora"):
+            # Export both forms: standalone PEFT adapter (small, composable)
+            # and the merged model (what the serving path actually loads —
+            # rank-16 side matmuls would waste MXU occupancy at inference).
+            from llm_fine_tune_distributed_tpu.parallel.lora import (
+                merge_lora,
+                save_lora_adapter,
+            )
+
+            save_lora_adapter(params, os.path.join(cfg.output_dir, "adapter"), cfg)
+            params = merge_lora(params)
+        import ml_dtypes
+
+        save_hf_checkpoint(
+            params,
+            best_dir,
+            save_dtype=ml_dtypes.bfloat16,
+            metadata={"framework": "llm_fine_tune_distributed_tpu"},
+        )
+        if hasattr(self.tokenizer, "save_pretrained"):
+            self.tokenizer.save_pretrained(best_dir)
+        self._save_model_config(best_dir)
+        print(f"Best model saved to {best_dir}")
+
+        self.metrics.save_history(os.path.join(cfg.output_dir, "training_history.json"))
+        with open(os.path.join(cfg.output_dir, "training_summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        return summary
+
+    def _save_model_config(self, path: str) -> None:
+        """Write a config.json so the inference CLI can rebuild the model."""
+        mc = self.model_config
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(
+                {
+                    "model_type": mc.name,
+                    "vocab_size": mc.vocab_size,
+                    "hidden_size": mc.hidden_size,
+                    "intermediate_size": mc.intermediate_size,
+                    "num_hidden_layers": mc.num_layers,
+                    "num_attention_heads": mc.num_heads,
+                    "num_key_value_heads": mc.num_kv_heads,
+                    "head_dim": mc.head_dim,
+                    "rope_theta": mc.rope_theta,
+                    "max_position_embeddings": mc.max_position_embeddings,
+                    "rms_norm_eps": mc.rms_norm_eps,
+                    "tie_word_embeddings": mc.tie_word_embeddings,
+                    "attention_bias": mc.attention_bias,
+                    "mlp_bias": mc.mlp_bias,
+                    "no_rope_layers": list(mc.no_rope_layers),
+                    "sliding_window": mc.sliding_window,
+                    # MoE round trip (HF MixtralConfig naming — consumed by
+                    # models/configs.from_hf_config at inference load time)
+                    "num_local_experts": mc.num_experts,
+                    "num_experts_per_tok": mc.num_experts_per_tok,
+                    "router_aux_loss_coef": mc.router_aux_coef,
+                },
+                f,
+                indent=2,
+            )

@@ -1,0 +1,5 @@
+from llm_fine_tune_distributed_tpu.utils.tree import (  # noqa: F401
+    tree_paths,
+    map_with_path,
+    count_params,
+)

@@ -1,0 +1,85 @@
+"""Pytree helpers keyed by parameter path (used by freezing, sharding, LoRA)."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Tuple
+
+import jax
+import numpy as np
+
+
+def _path_str(key_path) -> str:
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def tree_paths(tree) -> list:
+    """List of (path_str, leaf) for every leaf."""
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    return [(_path_str(kp), leaf) for kp, leaf in leaves]
+
+
+def map_with_path(fn: Callable[[str, object], object], tree):
+    """tree_map where fn receives ('model/layers/0/self_attn/q_proj/kernel', leaf)."""
+    return jax.tree_util.tree_map_with_path(lambda kp, leaf: fn(_path_str(kp), leaf), tree)
+
+
+def flatten_dict(tree, prefix: str = "") -> dict:
+    """Nested dict -> {'a/b/c': leaf} flat dict."""
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(flatten_dict(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def unflatten_dict(flat: dict) -> dict:
+    """{'a/b/c': leaf} -> nested dict."""
+    tree: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def split_by_mask(params, mask):
+    """Split a params pytree into (trainable_flat, frozen_flat) dicts keyed by
+    path. Keeping them as separate pytrees means autodiff, optimizer state and
+    donation operate on the trainable subset ONLY — frozen params never get
+    f32 gradient buffers or Adam moments (the TPU-memory expression of the
+    reference's freezing policy, training.py:113-149)."""
+    flat_p = flatten_dict(params)
+    flat_m = flatten_dict(mask)
+    trainable = {k: v for k, v in flat_p.items() if flat_m[k]}
+    frozen = {k: v for k, v in flat_p.items() if not flat_m[k]}
+    return trainable, frozen
+
+
+def merge_flat(trainable: dict, frozen: dict) -> dict:
+    """Inverse of split_by_mask: rebuild the nested params pytree."""
+    return unflatten_dict({**trainable, **frozen})
+
+
+def count_params(tree) -> int:
+    return sum(int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def count_params_where(tree, predicate: Callable[[str], bool]) -> int:
+    total = 0
+    for path, leaf in tree_paths(tree):
+        if predicate(path):
+            total += int(np.prod(leaf.shape))
+    return total

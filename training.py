@@ -4,118 +4,16 @@
 DATA_DIR, OUTPUT_DIR, AIM_REPO, WORLD_SIZE/RANK/MASTER_ADDR/MASTER_PORT;
 reference ``training.py:19-23,54-60``).
 
-Differences by design (TPU-first):
-- multi-host rendezvous is ``jax.distributed.initialize`` (coordinator =
-  MASTER_ADDR analog), not NCCL (SURVEY.md §2.5);
-- parallelism is a device mesh (data/fsdp/tensor/seq) instead of flat DDP —
-  shape via MESH_DATA/MESH_FSDP/MESH_TENSOR/MESH_SEQ/MESH_EXPERT env vars;
-- runs on TPU, CPU (simulation), or any JAX backend — no hard CUDA assert
-  (reference hard-fails without CUDA at ``training.py:81-83``).
+Thin shim over the installable console script ``smollm3-train``
+(llm_fine_tune_distributed_tpu/cli.py) kept for reference-style invocation:
 
-Usage:
   python training.py                      # env-var config, like the reference
   python training.py --config cfg.json    # config-file mode
 """
 
-import argparse
-import os
 import sys
 
-
-def main() -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--config", help="JSON/YAML TrainConfig file")
-    parser.add_argument("--model-preset", help="model preset override")
-    parser.add_argument(
-        "--resume", nargs="?", const="latest", default=None,
-        help="resume from checkpoint ('latest' or a step number)",
-    )
-    parser.add_argument(
-        "--platform", default=None,
-        help="force a jax platform (e.g. 'cpu' for simulation runs; overrides "
-             "any sitecustomize/env pinning)",
-    )
-    parser.add_argument(
-        "--virtual-devices", type=int, default=None,
-        help="with --platform cpu: number of virtual host devices "
-             "(XLA_FLAGS --xla_force_host_platform_device_count)",
-    )
-    args = parser.parse_args()
-
-    if args.virtual_devices:
-        import re
-
-        flags = re.sub(
-            r"--xla_force_host_platform_device_count=\d+", "",
-            os.environ.get("XLA_FLAGS", ""),
-        ).strip()
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={args.virtual_devices}"
-        ).strip()
-    if args.platform:
-        import jax
-
-        # config.update (not the env var) wins even when a sitecustomize
-        # registered a hardware plugin at interpreter startup
-        jax.config.update("jax_platforms", args.platform)
-
-    # Multi-host bootstrap MUST run before any jax backend use
-    # (reference analog: setup_distributed, training.py:16-42).
-    from llm_fine_tune_distributed_tpu.runtime.distributed import (
-        initialize_distributed,
-        is_primary_host,
-    )
-
-    info = initialize_distributed()
-
-    from llm_fine_tune_distributed_tpu.config import MeshConfig, TrainConfig
-
-    config = TrainConfig.load(args.config) if args.config else TrainConfig()
-    config.apply_env_overrides()
-    if args.model_preset:
-        config.model_preset = args.model_preset
-    if args.resume is not None:
-        config.resume_from_checkpoint = args.resume
-    mesh_env = {
-        k: os.environ.get(f"MESH_{k.upper()}")
-        for k in ("data", "fsdp", "tensor", "seq", "expert")
-    }
-    if any(v is not None for v in mesh_env.values()):
-        config.mesh = MeshConfig(
-            **{k: int(v) for k, v in mesh_env.items() if v is not None}
-        )
-
-    if is_primary_host():
-        print("=" * 60)
-        print("TPU-native distributed SFT")
-        print(f"  process {info.process_index}/{info.process_count}, "
-              f"{info.global_device_count} devices ({info.platform})")
-        print(f"  epochs={config.epochs} batch={config.per_device_batch_size} "
-              f"lr={config.learning_rate} accum={config.gradient_accumulation_steps}")
-        print(f"  data={config.data_dir} output={config.output_dir}")
-        print("=" * 60)
-
-    if config.objective not in ("sft", "dpo"):
-        raise SystemExit(
-            f"unknown OBJECTIVE {config.objective!r}; expected 'sft' or 'dpo'"
-        )
-    if config.objective == "dpo":
-        # preference-pair path (OBJECTIVE=dpo): BASELINE.json config #4
-        from llm_fine_tune_distributed_tpu.train.dpo import DPOTrainer as Trainer
-    else:
-        from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer as Trainer
-
-    trainer = Trainer(config)
-    summary = trainer.train()
-
-    if is_primary_host():
-        print("\nDistributed Q&A fine-tuning completed successfully!")
-        print(f"Training artifacts saved to {config.output_dir}/")
-        steady = summary.get("samples_per_second_per_chip_steady")
-        print(f"samples/sec/chip: {summary.get('samples_per_second_per_chip')}"
-              + (f" (steady-state: {steady})" if steady else ""))
-    return 0
-
+from llm_fine_tune_distributed_tpu.cli import train_main
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(train_main())
