@@ -75,7 +75,7 @@ def train_main(argv: Optional[list] = None) -> int:
         config.resume_from_checkpoint = args.resume
     mesh_env = {
         k: os.environ.get(f"MESH_{k.upper()}")
-        for k in ("data", "fsdp", "tensor", "seq", "expert")
+        for k in ("data", "fsdp", "tensor", "seq", "expert", "pipe")
     }
     if any(v is not None for v in mesh_env.values()):
         config.mesh = MeshConfig(

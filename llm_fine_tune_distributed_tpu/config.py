@@ -115,6 +115,10 @@ class MeshConfig:
                    all-to-all, selected by ``attention_impl`` (optional)
       - ``expert``: expert parallelism for MoE models — expert weights and the
                    dispatched token blocks shard over this axis (ops/moe.py)
+      - ``pipe`` : pipeline parallelism — transformer blocks stacked
+                   [num_layers, ...] and sharded by depth; microbatch
+                   activations flow stage-to-stage with ppermute
+                   (parallel/pipeline.py)
 
     Sizes of -1 mean "absorb remaining devices" (at most one axis may be -1).
     This replaces the reference's implicit 1-D DDP world
@@ -126,10 +130,11 @@ class MeshConfig:
     tensor: int = 1
     seq: int = 1
     expert: int = 1
+    pipe: int = 1
 
     def axis_sizes(self, n_devices: int) -> dict:
         sizes = {"data": self.data, "fsdp": self.fsdp, "tensor": self.tensor,
-                 "seq": self.seq, "expert": self.expert}
+                 "seq": self.seq, "expert": self.expert, "pipe": self.pipe}
         unknown = [k for k, v in sizes.items() if v == -1]
         if len(unknown) > 1:
             raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
@@ -313,6 +318,9 @@ class TrainConfig:
         "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
         "OBJECTIVE": ("objective", str),
         "DPO_BETA": ("dpo_beta", float),
+        "LOGGING_STEPS": ("logging_steps", int),
+        "EVAL_STEPS": ("eval_steps", int),
+        "EXPERIMENT_NAME": ("experiment_name", str),
     }
 
     def apply_env_overrides(self, environ=None) -> "TrainConfig":

@@ -18,8 +18,10 @@ framework:
   draw depends on its row index, so co-batching would make seeded responses
   depend on arrival timing — each sampled request keeps exactly the
   (request, seed) reproducibility the serial server had;
-- incompatible requests are simply returned to the queue and picked up in a
-  later group.
+- incompatible requests drained during a group's window are parked on a
+  deferred list that is serviced BEFORE the queue on the next cycle, so
+  mixed-config traffic keeps FIFO fairness (a sampled request never waits
+  behind greedy requests that arrived after it).
 
 Greedy batched rows are bit-identical to solo runs (see
 ``Generator.generate_batch``), so enabling batching does not change
@@ -61,18 +63,33 @@ class BatchingEngine:
         self._max_batch = max(1, int(max_batch))
         self._window_s = window_ms / 1000.0
         self._q: "queue.Queue[_Pending]" = queue.Queue()
+        # incompatible requests parked by the worker between cycles; worker-
+        # thread-only state (no lock needed)
+        self._deferred: List[_Pending] = []
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
     # ---------------------------------------------------------------- public
 
     def submit(
-        self, prompt_ids: Sequence[int], gen: GenerationConfig, seed: int = 0
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int = 0,
+        timeout: Optional[float] = None,
     ) -> List[int]:
-        """Blocking: enqueue one request, wait for its batch to finish."""
+        """Blocking: enqueue one request, wait for its batch to finish.
+
+        ``timeout`` (seconds) bounds the wait: if the device wedges
+        mid-generate, handler threads shed load with a TimeoutError (the
+        server maps it to 503) instead of accumulating forever."""
         p = _Pending(list(prompt_ids), gen, seed)
         self._q.put(p)
-        p.done.wait()
+        if not p.done.wait(timeout):
+            raise TimeoutError(
+                f"generate request not served within {timeout}s "
+                f"(queue depth {self._q.qsize()})"
+            )
         if p.error is not None:
             raise p.error
         return p.result
@@ -88,9 +105,19 @@ class BatchingEngine:
         import time
 
         while True:
-            first = self._q.get()
+            # deferred requests are older than anything in the queue: the
+            # oldest one seeds the next group (FIFO fairness under mixed
+            # greedy/sampled traffic)
+            first = self._deferred.pop(0) if self._deferred else self._q.get()
             batch = [first]
-            put_back: List[_Pending] = []
+            # compatible deferred requests join before the queue is drained
+            still_deferred: List[_Pending] = []
+            for p in self._deferred:
+                if len(batch) < self._max_batch and self._compatible(first, p):
+                    batch.append(p)
+                else:
+                    still_deferred.append(p)
+            self._deferred = still_deferred
             deadline = time.monotonic() + self._window_s
             while len(batch) < self._max_batch and not first.gen.do_sample:
                 remaining = deadline - time.monotonic()
@@ -103,9 +130,7 @@ class BatchingEngine:
                 if self._compatible(first, nxt):
                     batch.append(nxt)
                 else:
-                    put_back.append(nxt)
-            for p in put_back:  # mixed-config traffic: next group's problem
-                self._q.put(p)
+                    self._deferred.append(nxt)
 
             prompts = [p.prompt for p in batch]
             # pad to a power-of-two batch so generate_batch compiles at most

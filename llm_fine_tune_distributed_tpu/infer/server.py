@@ -36,6 +36,7 @@ def serve(
     batch_window_ms: float = 10.0,
     quantize: str = "none",
     template_kwargs: Optional[dict] = None,
+    request_timeout_s: Optional[float] = 600.0,
 ) -> None:
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
@@ -128,8 +129,13 @@ def serve(
                 # chat helpers, so CLI and server cannot diverge); only the
                 # device work goes through the batching engine's worker
                 prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
-                ids = engine.submit(prompt_ids, gen, seed=seed)
+                ids = engine.submit(
+                    prompt_ids, gen, seed=seed, timeout=request_timeout_s
+                )
                 answer = generator.decode_reply(ids)
+            except TimeoutError as e:  # wedged device: shed load, don't pile up
+                self._send(503, {"error": str(e)})
+                return
             except Exception as e:  # surface generation errors as 500s
                 self._send(500, {"error": str(e)})
                 return
@@ -167,12 +173,18 @@ def main(argv: Optional[list] = None) -> int:
         "--quantize", choices=["none", "int8"], default="none",
         help="weight-only inference quantization (ops/int8.py)",
     )
+    parser.add_argument(
+        "--request-timeout-s", type=float, default=600.0,
+        help="max seconds a request waits for the device before a 503 "
+             "(0 = wait forever)",
+    )
     args = parser.parse_args(argv)
     if not os.path.isdir(args.model_dir):
         print(f"Error: model directory not found: {args.model_dir!r}")
         return 1
     serve(args.model_dir, args.host, args.port, args.max_batch,
-          args.batch_window_ms, args.quantize)
+          args.batch_window_ms, args.quantize,
+          request_timeout_s=args.request_timeout_s or None)
     return 0
 
 

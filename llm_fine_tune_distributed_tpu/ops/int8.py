@@ -50,8 +50,11 @@ def dequantize_int8(q: Dict, dtype=jnp.bfloat16):
 
 def int8_matmul(x, q: Dict, compute_dtype=jnp.bfloat16):
     """``x [..., in] @ dequant(q)``. The convert+scale fuses into the matmul
-    operand read under XLA; the HBM stream is the int8 codes."""
-    w = q["int8"].astype(compute_dtype) * q["int8_scale"].astype(compute_dtype)[None, :]
+    operand read under XLA; the HBM stream is the int8 codes. The scale is
+    applied in f32 and the product cast once, so this path and
+    ``dequantize_int8`` agree exactly (up to the single cast) instead of
+    compounding a bf16-rounded scale on top of the 8-bit rounding."""
+    w = (q["int8"].astype(jnp.float32) * q["int8_scale"][None, :]).astype(compute_dtype)
     return x.astype(compute_dtype) @ w
 
 

@@ -22,14 +22,35 @@ embedding is applied to all microbatches up front (host of stage 0 data),
 the last stage's outputs are collected, and the loss closes over them. The
 embedding table is replicated across stages (it is ~3% of SmolLM3's params).
 
-Scope: first-class building block with exact-parity tests against the plain
-``forward`` path (tests/test_pipeline.py). Not yet wired into SFTTrainer's
-mesh config — TP/FSDP/SP cover the BASELINE.json configs; the pipeline axis
-targets models whose layer count, not width, is the scaling constraint.
+Wired into SFTTrainer via the ``pipe`` mesh axis (``MESH_PIPE=2 python
+training.py``): ``build_pipeline_train_step`` / ``build_pipeline_eval_step``
+below are the drop-in step builders, with the stacked-layer state
+representation handled by ``stack_flat_layer_leaves`` and partial-layer
+freezing by a per-layer gradient mask. The pipe axis composes with
+data/fsdp data parallelism (the microbatch dim shards over them inside the
+schedule's shard_map).
+
+Schedule note (why GPipe, not 1F1B): differentiating the tick scan yields
+the exact time-reversed pipeline, so one optimizer step costs
+``2*(M + S - 1)`` stage-ticks against an ideal ``2*M`` — the same bubble
+fraction ``(S-1)/(M+S-1)`` 1F1B has (1F1B reorders the SAME work; its
+advantage is peak activation memory, capped at S in-flight microbatches
+instead of M). Here that memory pressure is addressed where XLA can see it:
+``remat_blocks`` saves only stage-boundary activations ([mb, seq, h] per
+tick) and recomputes block internals, so in-flight cost is one boundary
+tensor per microbatch — smaller than 1F1B's S full stage residuals whenever
+h is small relative to per-block state. Cutting the bubble itself requires
+interleaved virtual stages (Megatron-style), which trades v× more ppermute
+volume for a v× smaller bubble — worth it only at large S; the mesh sizes
+this framework targets (pipe ≤ 8) prefer raising M (grad-accum) instead.
+
+Scope bounds (raised loudly by the trainer): packing, LoRA/QLoRA, DPO, and
+sequence-parallel attention do not compose with the pipe axis yet.
 """
 
 from __future__ import annotations
 
+import re
 from typing import Dict
 
 import jax
@@ -98,19 +119,36 @@ def pipeline_forward(
     """
     S = mesh.shape["pipe"]
     M = num_microbatches
-    B, seq = input_ids.shape
-    if B % M:
-        raise ValueError(f"batch {B} not divisible by {M} microbatches")
-    mb = B // M
+    # 3D input [M, mb, seq] keeps the microbatch dims through the whole
+    # computation (loss included) — the sharded-trainer path, where flattening
+    # would mix the pipe-sharded M dim into the dp-sharded row dim and force
+    # GSPMD resharding of the batch. 2D input [M * mb, seq] is the
+    # building-block API (parity tests vs the flat forward).
+    micro_dims = input_ids.ndim == 3
+    if micro_dims:
+        if input_ids.shape[0] != M:
+            raise ValueError(
+                f"leading dim {input_ids.shape[0]} != num_microbatches {M}"
+            )
+        _, mb, seq = input_ids.shape
+        ids = input_ids
+    else:
+        B, seq = input_ids.shape
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = B // M
+        ids = input_ids.reshape(M, mb, seq)  # token ids, NOT embeddings: 4
+        # bytes per position instead of 2*h — the schedule's input stays tiny
     L_local = config.num_layers // S
 
     embed = params["model"]["embed_tokens"]["weight"].astype(compute_dtype)
-    ids = input_ids.reshape(M, mb, seq)  # token ids, NOT embeddings: 4 bytes
-    # per position instead of 2*h — the schedule's replicated input stays tiny
     if padding_mask is None:
-        padding_mask = jnp.ones((B, seq), jnp.float32)
-    pm = padding_mask.reshape(M, mb, seq)
-    positions = jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32)[None], (mb, seq))
+        pm = jnp.ones((M, mb, seq), jnp.float32)
+    else:
+        pm = padding_mask if micro_dims else padding_mask.reshape(M, mb, seq)
+    # [1, seq]: broadcasts over however many microbatch rows a device holds
+    # (the mb dim shards over data/fsdp inside the shard_map)
+    positions = jnp.arange(seq, dtype=jnp.int32)[None]
     cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
     # Per-layer RoPE flags as DATA: the layer scan compiles one block body,
     # and NoPE-interleaved models (SmolLM3) select rope/no-rope per layer.
@@ -140,16 +178,17 @@ def pipeline_forward(
 
     def spmd(stacked_local, embed_local, ids_local, pm_local, flags_local):
         # stacked_local: this stage's layers [L_local, ...]; ids_local/
-        # pm_local: the full microbatch token ids + padding masks (replicated
-        # — int32/float32 [M, mb, seq], ~1000x smaller than embedded
-        # activations); embed_local: the embedding table (replicated, it is
-        # a param).
+        # pm_local: this device's microbatch COLUMN of token ids + padding
+        # masks ([M, mb_local, seq] — the mb dim shards over data/fsdp, so
+        # the pipe axis composes with data parallelism); embed_local: the
+        # embedding table (replicated, it is a param).
         s = jax.lax.axis_index("pipe")
         T = M + S - 1
         h_dim = embed_local.shape[-1]
+        mb_local = ids_local.shape[1]
 
         def tick(carry, t):
-            buf, aux_sum = carry  # [mb, seq, h] activation arriving at my stage
+            buf, aux_sum = carry  # [mb_local, seq, h] activation at my stage
             m = t - s    # microbatch index my stage works on this tick
             m_safe = jnp.clip(m, 0, M - 1)
             # stage 0 embeds its own microbatch; others use the received
@@ -181,12 +220,16 @@ def pipeline_forward(
 
         (_, aux_local), outs = jax.lax.scan(
             tick,
-            (jnp.zeros((mb, seq, h_dim), compute_dtype), jnp.float32(0.0)),
+            (jnp.zeros((mb_local, seq, h_dim), compute_dtype), jnp.float32(0.0)),
             jnp.arange(T),
         )
         # total router aux over every (stage, microbatch), averaged over
-        # microbatches -> the per-microbatch layer-sum scale forward() uses
+        # microbatches -> the per-microbatch layer-sum scale forward() uses.
+        # With the mb dim sharded, each dp column saw different rows: pmean
+        # over the dp axes makes the scalar truly replicated.
         aux = jax.lax.psum(aux_local, "pipe") / M
+        if dp_axes:
+            aux = jax.lax.pmean(aux, dp_axes)
         # outs [T, mb, seq, h]: last stage's real outputs live at ticks
         # t = m + S - 1; drop the S-1 bubble rows first so the collective
         # moves only real data. When M divides S-ways, reduce-scatter leaves
@@ -200,18 +243,26 @@ def pipeline_forward(
             )
         return jax.lax.psum(outs, "pipe"), aux
 
-    out_spec = P("pipe") if M % S == 0 else P()
+    # the microbatch dim shards over any live data-parallel axes (pipe + dp
+    # composition); meshes without those axes (unit tests) stay replicated
+    dp_axes = tuple(
+        a for a in ("data", "fsdp") if a in mesh.shape and mesh.shape[a] > 1
+    )
+    mb_spec = dp_axes if dp_axes else None
+    out_spec = P("pipe", mb_spec) if M % S == 0 else P(None, mb_spec)
     outs, aux = shard_map(
         spmd,
         mesh=mesh,
-        in_specs=(P("pipe"), P(), P(), P(), P("pipe")),
+        in_specs=(P("pipe"), P(), P(None, mb_spec), P(None, mb_spec), P("pipe")),
         out_specs=(out_spec, P()),
         check_vma=False,
     )(stacked_layers, embed, ids, pm, rope_flags)
 
     # [M, mb, seq, h] -> final norm (+ unembed unless the caller chunks the
-    # loss; same code path as the plain forward for exact parity)
-    h = outs.reshape(B, seq, -1)
+    # loss; same code path as the plain forward for exact parity). With
+    # micro_dims the [M, mb, ...] layout survives to the caller so the M dim
+    # stays cleanly pipe-sharded all the way into the loss.
+    h = outs if micro_dims else outs.reshape(M * mb, seq, -1)
     h = rms_norm(h, params["model"]["norm"]["weight"], config.rms_norm_eps)
     if output_hidden:
         out = h.astype(compute_dtype)
@@ -229,16 +280,23 @@ def pipeline_loss_fn(
     num_microbatches: int,
     compute_dtype=jnp.bfloat16,
     loss_chunk_size=None,
+    include_router_aux: bool = True,
 ):
     """Masked next-token CE through the pipeline (same objective as
     train/step.py's make_loss_fn, including the chunked large-vocab path and
     the MoE router aux term at the same layer-mean scale).
     Differentiable: jax.grad through this yields the reverse-schedule
-    backward pipeline automatically."""
-    targets = batch["input_ids"][:, 1:]
-    mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+    backward pipeline automatically.
+
+    Batch arrays may be [B, seq] (building-block API) or [M, mb, seq]
+    (trainer path — keeps the pipe-sharded M dim separate from the
+    dp-sharded mb dim so no array ever needs a cross-axis reshard)."""
+    ids = batch["input_ids"]
+    micro_dims = ids.ndim == 3
+    targets = ids[..., 1:]
+    mask = batch["loss_mask"][..., 1:].astype(jnp.float32)
     tokens = jnp.maximum(mask.sum(), 1.0)
-    want_aux = config.num_experts > 0
+    want_aux = include_router_aux and config.num_experts > 0
 
     def add_aux(loss, aux):
         if not want_aux:
@@ -251,19 +309,222 @@ def pipeline_loss_fn(
         from llm_fine_tune_distributed_tpu.train.step import chunked_ce_sum
 
         hidden, aux = pipeline_forward(
-            params, stacked_layers, batch["input_ids"], config, mesh,
+            params, stacked_layers, ids, config, mesh,
             num_microbatches, padding_mask=batch.get("attention_mask"),
             compute_dtype=compute_dtype, output_hidden=True, return_aux=True,
         )
-        ce_sum = chunked_ce_sum(
-            params, hidden[:, :-1], targets, mask, config, loss_chunk_size,
-            compute_dtype,
-        )
+        if micro_dims:
+            # one chunked-CE pass per microbatch (lax.map keeps a single
+            # compiled body and one [mb, chunk, vocab] tile live at a time)
+            ce_sum = jax.lax.map(
+                lambda args: chunked_ce_sum(
+                    params, args[0][:, :-1], args[1], args[2], config,
+                    loss_chunk_size, compute_dtype,
+                ),
+                (hidden, targets, mask),
+            ).sum()
+        else:
+            ce_sum = chunked_ce_sum(
+                params, hidden[:, :-1], targets, mask, config, loss_chunk_size,
+                compute_dtype,
+            )
         return add_aux(ce_sum / tokens, aux)
     logits, aux = pipeline_forward(
-        params, stacked_layers, batch["input_ids"], config, mesh,
+        params, stacked_layers, ids, config, mesh,
         num_microbatches, padding_mask=batch.get("attention_mask"),
         compute_dtype=compute_dtype, return_aux=True,
     )
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits[..., :-1, :], targets)
     return add_aux((ce * mask).sum() / tokens, aux)
+
+
+# ---------------------------------------------------------------------------
+# trainer wiring: stacked flat-state representation + step builders
+# ---------------------------------------------------------------------------
+
+# Flat state keys for the stacked transformer blocks live under this marker
+# ("model/layers/@stacked/self_attn/q_proj/kernel" -> one [L, h, qd] leaf).
+STACKED_PREFIX = "model/layers/@stacked/"
+_LAYER_KEY = re.compile(r"^model/layers/(\d+)/(.+)$")
+
+
+def stack_flat_layer_leaves(flat: Dict, num_layers: int) -> Dict:
+    """Per-layer flat leaves -> one stacked [num_layers, ...] leaf each.
+
+    The trainer's flat state dicts keep their non-layer leaves (embedding,
+    final norm, lm_head) untouched; every ``model/layers/<i>/<rest>`` group
+    must be present for all ``num_layers`` (uniform architectures only —
+    which every preset is)."""
+    groups: Dict[str, Dict[int, jnp.ndarray]] = {}
+    out = {}
+    for k, v in flat.items():
+        m = _LAYER_KEY.match(k)
+        if m is None:
+            out[k] = v
+        else:
+            groups.setdefault(m.group(2), {})[int(m.group(1))] = v
+    for rest, by_layer in groups.items():
+        if len(by_layer) != num_layers:
+            raise ValueError(
+                f"layer leaf {rest!r} present for {sorted(by_layer)} but the "
+                f"model has {num_layers} layers"
+            )
+        out[STACKED_PREFIX + rest] = jnp.stack(
+            [by_layer[i] for i in range(num_layers)]
+        )
+    return out
+
+
+def unstack_flat_layer_leaves(flat: Dict) -> Dict:
+    """Inverse of stack_flat_layer_leaves (host-side: used for artifact
+    export and checkpoint interop with non-pipelined meshes)."""
+    out = {}
+    for k, v in flat.items():
+        if not k.startswith(STACKED_PREFIX):
+            out[k] = v
+            continue
+        rest = k[len(STACKED_PREFIX):]
+        for i in range(v.shape[0]):
+            out[f"model/layers/{i}/{rest}"] = v[i]
+    return out
+
+
+def split_stacked_flat(flat: Dict):
+    """Merged flat params -> (rest_nested, stacked_layers_nested) for
+    pipeline_forward."""
+    from llm_fine_tune_distributed_tpu.utils.tree import unflatten_dict
+
+    stacked = {
+        k[len(STACKED_PREFIX):]: v
+        for k, v in flat.items()
+        if k.startswith(STACKED_PREFIX)
+    }
+    rest = {k: v for k, v in flat.items() if not k.startswith(STACKED_PREFIX)}
+    return unflatten_dict(rest), unflatten_dict(stacked)
+
+
+def pipeline_param_spec(path: str, leaf, mesh: Mesh) -> P:
+    """Sharding for the pipe-mode state: stacked block leaves shard their
+    leading (layer) dim over ``pipe``; everything else (embedding, norms,
+    lm_head) is replicated — those leaves enter the schedule's shard_map
+    with replicated in_specs. (FSDP-within-stage is a possible refinement;
+    the at-rest cost of replicating non-block leaves is the embedding only.)"""
+    if path.startswith(STACKED_PREFIX):
+        return P("pipe")
+    return P()
+
+
+def layer_trainable_vector(flat_mask: Dict, num_layers: int):
+    """[num_layers] 0/1 vector: layer i is trainable iff any of its leaves
+    is trainable under the freezing policy (parallel/freeze.py). Applied as
+    a gradient/update mask on the stacked leaves, which keeps optax's
+    whole-leaf masking semantics while freezing layer slices."""
+    import numpy as np
+
+    vec = np.zeros((num_layers,), np.float32)
+    for k, v in flat_mask.items():
+        m = _LAYER_KEY.match(k)
+        if m is not None and v:
+            vec[int(m.group(1))] = 1.0
+    return jnp.asarray(vec)
+
+
+def _mask_stacked(tree: Dict, layer_vec):
+    """Multiply stacked-leaf entries by the per-layer mask (broadcast over
+    the trailing dims); non-stacked leaves pass through."""
+    out = {}
+    for k, g in tree.items():
+        if k.startswith(STACKED_PREFIX):
+            vec = layer_vec.reshape((-1,) + (1,) * (g.ndim - 1))
+            g = g * vec.astype(g.dtype)
+        out[k] = g
+    return out
+
+
+def build_pipeline_train_step(model_config, train_config, optimizer, mesh, layer_vec):
+    """train_step(state, batch) -> (state, metrics) over the pipe mesh axis.
+
+    ``batch`` arrays are [grad_accum, global_batch, seq] (the standard loader
+    layout); the accumulation dim becomes the pipeline's microbatch stream
+    (M = grad_accum), so one optimizer step is ONE schedule of
+    M + S - 1 ticks — accumulation and pipelining are the same loop.
+
+    Loss semantics: global token-mean over the whole per-step batch (the flat
+    path computes the mean of per-microbatch means; the two agree exactly
+    when microbatches carry equal token counts, and to < 1e-3 relative on
+    this dataset's padding distribution).
+
+    Freezing: grads AND updates on stacked leaves are masked by
+    ``layer_vec`` — masking updates too keeps AdamW's decoupled weight decay
+    off frozen layers."""
+    from llm_fine_tune_distributed_tpu.config import str_to_dtype
+
+    compute_dtype = str_to_dtype(train_config.compute_dtype)
+    M = train_config.gradient_accumulation_steps
+    chunk = train_config.loss_chunk_size
+
+    def loss_fn(trainable, frozen, flat_batch):
+        params, stacked_layers = split_stacked_flat({**trainable, **frozen})
+        return pipeline_loss_fn(
+            params, stacked_layers, flat_batch, model_config, mesh, M,
+            compute_dtype=compute_dtype, loss_chunk_size=chunk,
+        )
+
+    def train_step(state, batch):
+        # batch arrays stay [accum, B, seq]: microbatch m of the schedule is
+        # exactly accumulation slice m, and the (pipe-sharded) accum dim is
+        # never reshaped into the (dp-sharded) batch dim
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.trainable, state.frozen, batch
+        )
+        grads = _mask_stacked(grads, layer_vec)
+        grad_norm = optax.global_norm(grads)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.trainable
+        )
+        updates = _mask_stacked(updates, layer_vec)
+        new_trainable = optax.apply_updates(state.trainable, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            trainable=new_trainable,
+            opt_state=new_opt_state,
+        )
+        return new_state, {"loss": loss, "grad_norm": grad_norm}
+
+    return train_step
+
+
+def build_pipeline_eval_step(model_config, train_config, mesh):
+    """eval_step(state, batch[b, s]) -> (ce_sum, token_count), matching
+    train/step.build_eval_step's contract (pure CE, no router aux)."""
+    from llm_fine_tune_distributed_tpu.config import str_to_dtype
+
+    compute_dtype = str_to_dtype(train_config.compute_dtype)
+    chunk = train_config.loss_chunk_size
+    S = mesh.shape["pipe"]
+
+    def eval_step(state, batch):
+        params, stacked_layers = split_stacked_flat(
+            {**state.trainable, **state.frozen}
+        )
+        b = batch["input_ids"].shape[0]
+        m = S if b % S == 0 else 1  # degenerate M=1 keeps any batch size legal
+        micro_batch = {
+            k: v.reshape((m, b // m) + v.shape[1:]) for k, v in batch.items()
+        }
+        loss = pipeline_loss_fn(
+            params, stacked_layers, micro_batch, model_config, mesh, m,
+            compute_dtype=compute_dtype, loss_chunk_size=chunk,
+            include_router_aux=False,
+        )
+        tokens = jnp.maximum(batch["loss_mask"][:, 1:].astype(jnp.float32).sum(), 1.0)
+        return loss * tokens, tokens
+
+    return eval_step
+
+
+def bubble_fraction(num_microbatches: int, num_stages: int) -> float:
+    """Idle fraction of the GPipe timetable: (S-1)/(M+S-1) per pass (the
+    backward pass, being the scan's exact transpose, has the same fraction).
+    The trainer warns when grad_accum makes this large."""
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)

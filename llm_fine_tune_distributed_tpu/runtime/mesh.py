@@ -22,7 +22,7 @@ from jax.sharding import Mesh
 
 from llm_fine_tune_distributed_tpu.config import MeshConfig
 
-MESH_AXES = ("data", "fsdp", "tensor", "seq", "expert")
+MESH_AXES = ("data", "pipe", "fsdp", "tensor", "seq", "expert")
 
 
 def make_mesh(
@@ -45,7 +45,7 @@ def make_mesh(
         # the devices (tests / deliberate under-subscription).
         explicit = {"data": config.data, "fsdp": config.fsdp,
                     "tensor": config.tensor, "seq": config.seq,
-                    "expert": config.expert}
+                    "expert": config.expert, "pipe": config.pipe}
         if -1 in explicit.values():
             raise
         product = 1

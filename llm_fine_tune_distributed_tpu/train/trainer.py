@@ -237,7 +237,33 @@ class SFTTrainer:
                 f"({r['trainable_percent']}%)"
             )
 
+        self._pipe_size = (
+            self.mesh.shape["pipe"] if "pipe" in self.mesh.axis_names else 1
+        )
+        if self._pipe_size > 1:
+            self._validate_pipeline_config()
+
         trainable, frozen = split_by_mask(params, mask)
+        if self._pipe_size > 1:
+            # Pipeline state representation: per-layer block leaves stacked
+            # [num_layers, ...] and sharded over `pipe` (parallel/pipeline.py).
+            # A stacked leaf spans frozen AND trainable layers, so the whole
+            # leaf lives in `trainable` and the per-layer freeze mask becomes
+            # a gradient/update mask inside the pipeline train step.
+            from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+                layer_trainable_vector,
+                stack_flat_layer_leaves,
+            )
+            from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+            flat_mask = flatten_dict(mask)
+            self._layer_vec = layer_trainable_vector(flat_mask, mc.num_layers)
+            merged = stack_flat_layer_leaves({**trainable, **frozen}, mc.num_layers)
+            trainable = {
+                k: v for k, v in merged.items()
+                if k.startswith("model/layers/@stacked/") or flat_mask.get(k, False)
+            }
+            frozen = {k: v for k, v in merged.items() if k not in trainable}
         del params
         param_dtype = str_to_dtype(cfg.param_dtype)
         compute_dtype = str_to_dtype(cfg.compute_dtype)
@@ -316,7 +342,44 @@ class SFTTrainer:
     def _validated_spec(self, path: str, leaf) -> P:
         from llm_fine_tune_distributed_tpu.parallel.sharding import _validate_spec
 
+        if getattr(self, "_pipe_size", 1) > 1:
+            from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+                pipeline_param_spec,
+            )
+
+            spec = pipeline_param_spec(path, leaf, self.mesh)
+            return _validate_spec(spec, leaf.shape, self.mesh)
         return _validate_spec(param_spec(path, leaf.ndim), leaf.shape, self.mesh)
+
+    def _validate_pipeline_config(self) -> None:
+        cfg, mc = self.config, self.model_config
+        problems = []
+        if cfg.packing:
+            problems.append("packing=True (the schedule has no segment support)")
+        if cfg.freeze_strategy in ("lora", "qlora"):
+            problems.append(f"freeze_strategy={cfg.freeze_strategy!r}")
+        if cfg.attention_impl in ("ring", "ulysses"):
+            problems.append(
+                f"attention_impl={cfg.attention_impl!r} (stages attend locally)"
+            )
+        if cfg.objective != "sft":
+            problems.append(f"objective={cfg.objective!r}")
+        if mc.num_layers % self._pipe_size:
+            problems.append(
+                f"{mc.num_layers} layers not divisible by pipe={self._pipe_size}"
+            )
+        accum = cfg.gradient_accumulation_steps
+        if accum < self._pipe_size:
+            # legal but mostly bubble: (S-1)/(M+S-1) of every step idle
+            print(
+                f"[pipeline] grad_accum={accum} < pipe={self._pipe_size}: "
+                f"bubble fraction {(self._pipe_size - 1) / (accum + self._pipe_size - 1):.0%}"
+                " — raise gradient_accumulation_steps for efficiency"
+            )
+        if problems:
+            raise ValueError(
+                "pipe mesh axis does not compose with: " + "; ".join(problems)
+            )
 
     # ----------------------------------------------------------------- steps
 
@@ -375,6 +438,22 @@ class SFTTrainer:
 
     def _prepare_steps(self) -> None:
         act = self._make_shardings()
+        if self._pipe_size > 1:
+            from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+                build_pipeline_eval_step,
+                build_pipeline_train_step,
+            )
+
+            self.train_step = jit_train_step(
+                build_pipeline_train_step(
+                    self.model_config, self.config, self.optimizer, self.mesh,
+                    self._layer_vec,
+                )
+            )
+            self.eval_step = jax.jit(
+                build_pipeline_eval_step(self.model_config, self.config, self.mesh)
+            )
+            return
         quant_impl = self._resolved_quant_impl()
         train_step = build_train_step(
             self.model_config, self.config, self.optimizer, activation_sharding=act,
@@ -705,6 +784,17 @@ class SFTTrainer:
         trainable_flat = self._host_fetch(self.state.trainable)
         if not is_primary_host():
             return summary
+
+        if getattr(self, "_pipe_size", 1) > 1:
+            # pipe-mode state stacks block leaves [L, ...]; the export
+            # contract (plain per-layer safetensors) unstacks them so the
+            # artifact is identical to a flat-mesh run's
+            from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+                unstack_flat_layer_leaves,
+            )
+
+            trainable_flat = unstack_flat_layer_leaves(trainable_flat)
+            frozen_flat = unstack_flat_layer_leaves(frozen_flat)
 
         best_dir = os.path.join(cfg.output_dir, "best_model")
         if cfg.freeze_strategy == "qlora":

@@ -106,3 +106,62 @@ def test_sampled_requests_keep_solo_seeding():
     for t in threads:
         t.join(timeout=180)
     assert results == solo
+
+
+def test_submit_timeout_sheds_load():
+    """A wedged device must not block handler threads forever (ADVICE r1):
+    submit raises TimeoutError after the configured wait."""
+    import pytest
+
+    class Wedged:
+        def generate_batch(self, *a, **kw):
+            import time
+
+            time.sleep(60)
+
+    engine = BatchingEngine(Wedged(), max_batch=2, window_ms=1.0)
+    with pytest.raises(TimeoutError):
+        engine.submit([1, 2, 3], GenerationConfig(max_new_tokens=2), timeout=0.2)
+
+
+def test_deferred_requests_keep_fifo_order():
+    """An incompatible request drained during another group's window is
+    serviced on the NEXT cycle, before requests that arrived after it
+    (ADVICE r1: no re-enqueue-at-tail reordering)."""
+    import time
+
+    order = []
+    lock = threading.Lock()
+
+    class Recorder:
+        def generate_batch(self, prompts, gen, seed=0):
+            with lock:
+                order.extend(tuple(p) for p in prompts)
+            return [[0] * gen.max_new_tokens for _ in prompts]
+
+    greedy_a = GenerationConfig(max_new_tokens=2, do_sample=False)
+    sampled = GenerationConfig(max_new_tokens=2, do_sample=True)
+    greedy_b = GenerationConfig(max_new_tokens=3, do_sample=False)
+    engine = BatchingEngine(Recorder(), max_batch=4, window_ms=150.0)
+
+    # greedy_a opens a 150ms window; a sampled request arrives inside the
+    # window (incompatible -> deferred), then an also-incompatible greedy_b
+    # request arrives after it. The old re-enqueue-at-tail behavior served
+    # greedy_b first; the deferred list must serve the sampled one first.
+    threads = []
+
+    def submit_after(delay, prompt, cfg):
+        def run():
+            time.sleep(delay)
+            engine.submit(prompt, cfg)
+
+        t = threading.Thread(target=run)
+        t.start()
+        return t
+
+    threads.append(submit_after(0.0, [1], greedy_a))
+    threads.append(submit_after(0.03, [2], sampled))
+    threads.append(submit_after(0.06, [3], greedy_b))
+    for t in threads:
+        t.join(timeout=30)
+    assert order.index((2,)) < order.index((3,)), order

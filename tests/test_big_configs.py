@@ -97,6 +97,7 @@ def test_llama3_8b_fsdp_step_traces():
     assert jax.tree.structure(new_state.trainable) == jax.tree.structure(state.trainable)
 
 
+@pytest.mark.slow
 def test_llama3_70b_qlora_step_traces():
     mc = get_preset("llama3_70b")
     assert mc.num_params == pytest.approx(70.55e9, rel=0.01)
@@ -124,6 +125,7 @@ def test_llama3_70b_qlora_step_traces():
     assert state.frozen[k0].dtype == jnp.int32
 
 
+@pytest.mark.slow
 def test_mistral_7b_dpo_step_traces():
     from llm_fine_tune_distributed_tpu.train.dpo import build_dpo_train_step
 
@@ -217,6 +219,7 @@ def test_sharding_rules_cover_all_big_model_params():
 
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_smollm3_long_context_seq_parallel_traces(impl, eight_devices):
     """Long-context capability at flagship scale: the FULL train step traces
     at seq 32768 with the sequence dim sharded 4-ways (ring / ulysses).

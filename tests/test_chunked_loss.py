@@ -17,6 +17,7 @@ from llm_fine_tune_distributed_tpu.utils.tree import split_by_mask
 
 
 @pytest.mark.parametrize("chunk", [40, 96, 128])  # non-divisor, divisor, > seq
+@pytest.mark.slow
 def test_chunked_ce_matches_full(chunk):
     mc = get_preset("tiny")
     common = dict(model_preset="tiny", max_seq_length=96, compute_dtype="float32")

@@ -98,6 +98,7 @@ def test_dpo_loss_at_init_is_log2(setup):
     assert abs(float(aux["rewards_margin"])) < 1e-2
 
 
+@pytest.mark.slow
 def test_dpo_chunked_matches_full(setup):
     """loss_chunk_size path must agree with the single-unembed path."""
     _, config, params, batch = setup
@@ -152,6 +153,7 @@ def test_preference_synthesis_and_loading(tmp_path):
     assert loaded == rows
 
 
+@pytest.mark.slow
 def test_dpo_end_to_end(tmp_path):
     """Tiny DPOTrainer run on the 8-device mesh: loss below log2, accuracy
     above chance, SFT artifact contract preserved."""

@@ -40,6 +40,7 @@ def _launch(cfg_path, resume: bool):
 
 
 @pytest.mark.slow
+@pytest.mark.slow
 def test_sigkill_then_resume(tmp_path):
     from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
 

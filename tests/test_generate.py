@@ -23,6 +23,7 @@ def tiny_setup():
     return mc, params, tok
 
 
+@pytest.mark.slow
 def test_greedy_decode_matches_full_forward(tiny_setup):
     """Token t from the KV-cache loop == token t from re-running the whole
     prefix through the cache-free forward (numerical parity of the cache)."""

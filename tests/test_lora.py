@@ -2,6 +2,8 @@
 freeze_strategy='lora', merge-for-serving, PEFT adapter round-trip. Config
 parity: external-doc article r=16/alpha=8/7 targets (SURVEY.md C23)."""
 
+import pytest
+
 import os
 
 import jax
@@ -115,6 +117,7 @@ def test_peft_roundtrip(tmp_path):
     assert float(scale) == 2.0  # alpha 8 / r 4, NOT the default alpha/r = 0.5
 
 
+@pytest.mark.slow
 def test_lora_sft_trains_and_exports(tmp_path):
     """End-to-end: freeze_strategy='lora' trains (loss decreases) and exports
     both the merged best_model and the PEFT adapter dir."""

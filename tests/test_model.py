@@ -51,6 +51,7 @@ def test_padding_mask_changes_nothing_for_valid_tokens(tiny):
     )
 
 
+@pytest.mark.slow
 def test_kv_cache_decode_matches_full_forward(tiny):
     """Prefill + one-token-at-a-time decode must reproduce the full forward
     pass logits (the correctness gate for infer/generate.py)."""
@@ -75,6 +76,7 @@ def test_kv_cache_decode_matches_full_forward(tiny):
         )
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat(tiny):
     cfg, params = tiny
     ids = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)

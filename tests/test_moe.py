@@ -255,6 +255,7 @@ def test_expert_weights_get_expert_axis_spec():
     assert spec2[0] == "expert"
 
 
+@pytest.mark.slow
 def test_pipeline_moe_matches_plain(eight_devices):
     """GPipe schedule on tiny_moe == plain forward (logits AND router aux):
     capacity queues are per batch row, so microbatching changes nothing."""
@@ -410,6 +411,7 @@ def test_moe_dropless_matches_reference():
     )
 
 
+@pytest.mark.slow
 def test_moe_kv_cache_decode_matches_full_forward():
     """Greedy KV-cache decode on tiny_moe == re-running the growing prefix
     through the cache-free forward. The decode path is dropless (HF Mixtral
@@ -496,6 +498,7 @@ def test_qlora_moe_quantizes_experts():
     assert np.abs(w1 - w1_back).max() < 0.1  # NF4 reconstruction error
 
 
+@pytest.mark.slow
 def test_qlora_moe_trainer_e2e(tmp_path):
     """Full QLoRA training on tiny_moe: adapters train against an
     NF4-quantized base (experts included), artifacts export."""
@@ -540,6 +543,7 @@ def test_qlora_moe_trainer_e2e(tmp_path):
     assert (tmp_path / "out" / "best_model" / "model.safetensors").exists()
 
 
+@pytest.mark.slow
 def test_trainer_e2e_with_expert_axis(tmp_path):
     """SFTTrainer glue with a live expert axis: 8-device mesh
     (data=2, fsdp=2, expert=2), tiny_moe, full training loop + artifacts."""
@@ -585,6 +589,7 @@ def test_trainer_e2e_with_expert_axis(tmp_path):
     assert (tmp_path / "out" / "best_model" / "model.safetensors").exists()
 
 
+@pytest.mark.slow
 def test_mixtral_8x7b_qlora_traces():
     """QLoRA at 8x7B scale, abstractly: experts quantize to the NF4 layout
     (only adapters trainable), and the full train step traces."""

@@ -137,6 +137,7 @@ def test_qlora_forward_matches_dequantized_dense():
     np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_d), rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_qlora_end_to_end(tmp_path):
     """QLoRA SFT on the 8-device mesh: NF4 frozen base + trainable adapters,
     loss decreases, export decodes back to plain safetensors."""

@@ -71,6 +71,7 @@ def test_pack_examples_layout(tok):
     assert 0.0 < packing_efficiency(packed) <= 1.0
 
 
+@pytest.mark.slow
 def test_packed_forward_matches_individual(tok):
     """Logits of each packed segment == logits of the example run alone."""
     config = get_preset("tiny")
@@ -139,6 +140,7 @@ def test_packed_arrays_loss_mask_never_crosses_segments(tok):
     assert (lm[:, 1:][starts] == 0).all()
 
 
+@pytest.mark.slow
 def test_packed_sft_end_to_end(tmp_path):
     from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
     from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer

@@ -54,6 +54,7 @@ def test_pipeline_forward_matches_plain(setup, n_stages, n_micro):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_plain(setup):
     """Gradients through the pipelined schedule == plain-model gradients,
     for both the replicated params and the stacked (stage-sharded) layers."""
@@ -165,6 +166,7 @@ def test_pipeline_padded_batch_matches_plain(setup):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_chunked_loss_matches_full(setup):
     """loss_chunk_size path (large-vocab HBM saver) == full-unembed path."""
     config, params, ids = setup

@@ -1,0 +1,183 @@
+"""Pipeline parallelism wired into SFTTrainer (VERDICT r1 #3): a `pipe` mesh
+axis trains end-to-end with loss parity against the flat mesh, composes with
+data parallelism, honors the freezing policy via the per-layer gradient
+mask, and exports the identical per-layer artifact contract."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from llm_fine_tune_distributed_tpu.config import MeshConfig, TrainConfig
+from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+    STACKED_PREFIX,
+    bubble_fraction,
+    layer_trainable_vector,
+    stack_flat_layer_leaves,
+    unstack_flat_layer_leaves,
+)
+
+from tests.test_train_e2e import make_config, qa_parquet  # noqa: F401 (fixture)
+
+
+def test_stack_unstack_roundtrip():
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+    mc = get_preset("tiny")
+    flat = flatten_dict(init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32))
+    stacked = stack_flat_layer_leaves(flat, mc.num_layers)
+    stacked_keys = [k for k in stacked if k.startswith(STACKED_PREFIX)]
+    assert stacked_keys, "no stacked leaves produced"
+    for k in stacked_keys:
+        assert stacked[k].shape[0] == mc.num_layers
+    back = unstack_flat_layer_leaves(stacked)
+    assert set(back) == set(flat)
+    for k in flat:
+        np.testing.assert_array_equal(np.asarray(back[k]), np.asarray(flat[k]))
+
+
+def test_layer_trainable_vector_last_two():
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.parallel.freeze import trainable_mask
+    from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+    mc = get_preset("tiny")  # 4 layers
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    cfg = TrainConfig(model_preset="tiny")  # default last-2+head freezing
+    vec = layer_trainable_vector(flatten_dict(trainable_mask(params, mc, cfg)), mc.num_layers)
+    np.testing.assert_array_equal(np.asarray(vec), [0.0, 0.0, 1.0, 1.0])
+
+
+def test_bubble_fraction():
+    assert bubble_fraction(1, 4) == pytest.approx(3 / 4)
+    assert bubble_fraction(8, 2) == pytest.approx(1 / 9)
+    assert bubble_fraction(16, 1) == 0.0
+
+
+def test_schedule_tick_count():
+    """The compiled schedule is a scan of exactly M + S - 1 ticks (the GPipe
+    timetable) — pinned so a schedule regression is loud."""
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+        pipeline_forward,
+        stack_stage_params,
+        stage_sharding,
+    )
+    from jax.sharding import Mesh
+
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+    stacked = jax.device_put(stack_stage_params(params, mc, 2), stage_sharding(mesh))
+    ids = jnp.zeros((4, 16), jnp.int32)  # M=4 microbatches of 1
+    jaxpr = str(
+        jax.make_jaxpr(
+            lambda p, st, i: pipeline_forward(
+                p, st, i, mc, mesh, 4, compute_dtype=jnp.float32
+            )
+        )(params, stacked, ids)
+    )
+    M, S = 4, 2
+    assert f"length={M + S - 1}" in jaxpr, "GPipe timetable length changed"
+
+
+@pytest.mark.slow
+def test_pipe_trainer_e2e_loss_parity(qa_parquet, tmp_path):  # noqa: F811
+    """MESH_PIPE-style run: same tiny recipe on (a) a flat 1-device mesh and
+    (b) a pipe=4 mesh; first-step loss agrees (same init, same data), both
+    decrease, and the pipeline's exported best_model/ has the same per-layer
+    safetensors contract."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+
+    flat_cfg = make_config(
+        tmp_path / "flat", data_dir, dataset_file,
+        epochs=1,
+        mesh=MeshConfig(data=1, fsdp=1, tensor=1, seq=1),
+    )
+    pipe_cfg = make_config(
+        tmp_path / "pipe", data_dir, dataset_file,
+        epochs=1,
+        mesh=MeshConfig(data=1, fsdp=1, tensor=1, seq=1, pipe=4),
+    )
+
+    flat = SFTTrainer(flat_cfg)
+    flat_summary = flat.train()
+    pipe = SFTTrainer(pipe_cfg)
+    pipe_summary = pipe.train()
+
+    flat_losses = [h["loss"] for h in flat.metrics.history if "loss" in h]
+    pipe_losses = [h["loss"] for h in pipe.metrics.history if "loss" in h]
+    assert len(flat_losses) >= 3 and len(pipe_losses) >= 3
+    # same initial params + same first batch: the first logged loss must
+    # agree up to the mean-of-means vs global-token-mean difference
+    assert pipe_losses[0] == pytest.approx(flat_losses[0], rel=2e-2)
+    assert pipe_losses[-1] < pipe_losses[0], "pipeline run did not learn"
+    # end-of-training losses in the same neighborhood
+    assert pipe_losses[-1] == pytest.approx(flat_losses[-1], rel=0.15)
+    assert np.isfinite(pipe_summary["final_train_loss"])
+
+    # artifact contract identical to the flat run (per-layer keys, no
+    # @stacked leak)
+    from safetensors import safe_open
+
+    def keys(out_dir):
+        with safe_open(
+            os.path.join(out_dir, "best_model", "model.safetensors"), "np"
+        ) as f:
+            return set(f.keys())
+
+    k_flat, k_pipe = keys(str(tmp_path / "flat")), keys(str(tmp_path / "pipe"))
+    assert k_flat == k_pipe
+    assert not any("@stacked" in k for k in k_pipe)
+
+    # freezing parity: frozen layers (0, 1) bit-identical to init in the
+    # exported pipeline model is covered by test_train_e2e for the flat
+    # path; here assert the summary reports the same trainable fraction
+    assert pipe_summary["trainable_params"] == flat_summary["trainable_params"]
+
+
+@pytest.mark.slow
+def test_pipe_composes_with_dp(qa_parquet, tmp_path):  # noqa: F811
+    """pipe=2 x fsdp=2 mesh: microbatch columns shard over fsdp inside the
+    schedule; training runs and learns."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path / "pipedp", data_dir, dataset_file,
+        epochs=1,
+        mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=1, pipe=2),
+    )
+    trainer = SFTTrainer(cfg)
+    summary = trainer.train()
+    losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(summary["final_train_loss"])
+
+
+def test_pipe_rejects_unsupported_combos(qa_parquet, tmp_path):  # noqa: F811
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    for bad in (
+        {"packing": True},
+        {"freeze_strategy": "lora"},
+        {"attention_impl": "ring"},
+    ):
+        cfg = make_config(
+            tmp_path / "bad", data_dir, dataset_file,
+            mesh=MeshConfig(data=1, fsdp=1, tensor=1, seq=1, pipe=2),
+            **bad,
+        )
+        with pytest.raises(ValueError, match="pipe mesh axis"):
+            SFTTrainer(cfg)

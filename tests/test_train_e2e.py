@@ -62,6 +62,7 @@ def make_config(tmp_out, data_dir, dataset_file, **overrides):
     return TrainConfig(**base)
 
 
+@pytest.mark.slow
 def test_sft_end_to_end(qa_parquet, tmp_path):
     from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
 
@@ -99,6 +100,7 @@ def test_sft_end_to_end(qa_parquet, tmp_path):
     assert len([c for c in ckpts if c.isdigit()]) <= 3
 
 
+@pytest.mark.slow
 def test_freezing_only_updates_last_layers(qa_parquet, tmp_path):
     """Frozen layer params must be bit-identical after training; unfrozen must move."""
     from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
@@ -118,6 +120,7 @@ def test_freezing_only_updates_last_layers(qa_parquet, tmp_path):
     assert moved, "no trainable parameter moved during training"
 
 
+@pytest.mark.slow
 def test_resume_from_checkpoint(qa_parquet, tmp_path):
     from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
 

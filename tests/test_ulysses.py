@@ -3,6 +3,8 @@ forward and gradients — since it is ordinary attention computed on a
 head-sharded re-partition (SURVEY.md §5.7: the long-context capability the
 reference lacks entirely; companion strategy to tests/test_ring_attention.py)."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -129,6 +131,7 @@ def test_model_forward_with_ulysses(eight_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4)
 
 
+@pytest.mark.slow
 def test_train_step_with_ulysses_matches_xla(eight_devices):
     """One full train step (grad-accum scan, freezing, AdamW) with
     seq-sharded activations + ulysses attention must produce the same loss
