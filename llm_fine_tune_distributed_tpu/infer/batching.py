@@ -46,6 +46,10 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[List[int]] = None
     error: Optional[BaseException] = None
+    # set by a timed-out submit: the waiter is gone, so the worker drops the
+    # request instead of decoding for nobody (a recovered device would
+    # otherwise burn minutes on dead work before serving live traffic)
+    abandoned: bool = False
 
 
 def _pad_batch_size(n: int, max_batch: int) -> int:
@@ -86,6 +90,7 @@ class BatchingEngine:
         p = _Pending(list(prompt_ids), gen, seed)
         self._q.put(p)
         if not p.done.wait(timeout):
+            p.abandoned = True
             raise TimeoutError(
                 f"generate request not served within {timeout}s "
                 f"(queue depth {self._q.qsize()})"
@@ -104,16 +109,27 @@ class BatchingEngine:
     def _run(self) -> None:
         import time
 
-        while True:
+        def next_live():
             # deferred requests are older than anything in the queue: the
             # oldest one seeds the next group (FIFO fairness under mixed
-            # greedy/sampled traffic)
-            first = self._deferred.pop(0) if self._deferred else self._q.get()
+            # greedy/sampled traffic). Abandoned (timed-out) requests are
+            # dropped here — decoding for a disconnected waiter would starve
+            # live traffic after a device stall.
+            while True:
+                p = self._deferred.pop(0) if self._deferred else self._q.get()
+                if not p.abandoned:
+                    return p
+                p.done.set()
+
+        while True:
+            first = next_live()
             batch = [first]
             # compatible deferred requests join before the queue is drained
             still_deferred: List[_Pending] = []
             for p in self._deferred:
-                if len(batch) < self._max_batch and self._compatible(first, p):
+                if p.abandoned:
+                    p.done.set()
+                elif len(batch) < self._max_batch and self._compatible(first, p):
                     batch.append(p)
                 else:
                     still_deferred.append(p)
@@ -127,7 +143,9 @@ class BatchingEngine:
                     nxt = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
-                if self._compatible(first, nxt):
+                if nxt.abandoned:
+                    nxt.done.set()
+                elif self._compatible(first, nxt):
                     batch.append(nxt)
                 else:
                     self._deferred.append(nxt)

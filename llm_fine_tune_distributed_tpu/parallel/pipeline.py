@@ -403,6 +403,25 @@ def split_stacked_flat(flat: Dict):
     return unflatten_dict(rest), unflatten_dict(stacked)
 
 
+def build_pipeline_state_leaves(trainable: Dict, frozen: Dict, flat_mask: Dict, num_layers: int):
+    """Stack the per-layer block leaves of a flat (trainable, frozen) state
+    split and re-partition for pipe mode.
+
+    A stacked leaf spans frozen AND trainable layers, so every stacked leaf
+    lives in ``trainable`` and the per-layer freeze mask becomes the
+    gradient/update mask the pipeline train step applies. Returns
+    ``(trainable, frozen, layer_vec)``. Single source for the trainer and
+    the dryrun harness."""
+    merged = stack_flat_layer_leaves({**trainable, **frozen}, num_layers)
+    new_trainable = {
+        k: v
+        for k, v in merged.items()
+        if k.startswith(STACKED_PREFIX) or flat_mask.get(k, False)
+    }
+    new_frozen = {k: v for k, v in merged.items() if k not in new_trainable}
+    return new_trainable, new_frozen, layer_trainable_vector(flat_mask, num_layers)
+
+
 def pipeline_param_spec(path: str, leaf, mesh: Mesh) -> P:
     """Sharding for the pipe-mode state: stacked block leaves shard their
     leading (layer) dim over ``pipe``; everything else (embedding, norms,
@@ -502,13 +521,22 @@ def build_pipeline_eval_step(model_config, train_config, mesh):
     compute_dtype = str_to_dtype(train_config.compute_dtype)
     chunk = train_config.loss_chunk_size
     S = mesh.shape["pipe"]
+    # the schedule's shard_map shards the microbatch dim over live dp axes,
+    # so b/m must stay divisible by them (b itself always is: the loader's
+    # global batch is per_device x dp)
+    dp = 1
+    for ax in ("data", "fsdp"):
+        if ax in mesh.shape:
+            dp *= mesh.shape[ax]
 
     def eval_step(state, batch):
         params, stacked_layers = split_stacked_flat(
             {**state.trainable, **state.frozen}
         )
         b = batch["input_ids"].shape[0]
-        m = S if b % S == 0 else 1  # degenerate M=1 keeps any batch size legal
+        # M=S fills the schedule when legal; degenerate M=1 keeps any batch
+        # size valid (full bubble, correct result)
+        m = S if b % S == 0 and (b // S) % dp == 0 else 1
         micro_batch = {
             k: v.reshape((m, b // m) + v.shape[1:]) for k, v in batch.items()
         }

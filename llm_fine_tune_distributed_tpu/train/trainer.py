@@ -246,24 +246,16 @@ class SFTTrainer:
         trainable, frozen = split_by_mask(params, mask)
         if self._pipe_size > 1:
             # Pipeline state representation: per-layer block leaves stacked
-            # [num_layers, ...] and sharded over `pipe` (parallel/pipeline.py).
-            # A stacked leaf spans frozen AND trainable layers, so the whole
-            # leaf lives in `trainable` and the per-layer freeze mask becomes
-            # a gradient/update mask inside the pipeline train step.
+            # [num_layers, ...] and sharded over `pipe` (parallel/pipeline.py),
+            # with the freeze policy expressed as a per-layer gradient mask.
             from llm_fine_tune_distributed_tpu.parallel.pipeline import (
-                layer_trainable_vector,
-                stack_flat_layer_leaves,
+                build_pipeline_state_leaves,
             )
             from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
 
-            flat_mask = flatten_dict(mask)
-            self._layer_vec = layer_trainable_vector(flat_mask, mc.num_layers)
-            merged = stack_flat_layer_leaves({**trainable, **frozen}, mc.num_layers)
-            trainable = {
-                k: v for k, v in merged.items()
-                if k.startswith("model/layers/@stacked/") or flat_mask.get(k, False)
-            }
-            frozen = {k: v for k, v in merged.items() if k not in trainable}
+            trainable, frozen, self._layer_vec = build_pipeline_state_leaves(
+                trainable, frozen, flatten_dict(mask), mc.num_layers
+            )
         del params
         param_dtype = str_to_dtype(cfg.param_dtype)
         compute_dtype = str_to_dtype(cfg.compute_dtype)
@@ -450,20 +442,38 @@ class SFTTrainer:
                     self._layer_vec,
                 )
             )
-            self.eval_step = jax.jit(
-                build_pipeline_eval_step(self.model_config, self.config, self.mesh)
+            self._eval_step_fn = build_pipeline_eval_step(
+                self.model_config, self.config, self.mesh
             )
-            return
-        quant_impl = self._resolved_quant_impl()
-        train_step = build_train_step(
-            self.model_config, self.config, self.optimizer, activation_sharding=act,
-            quant_impl=quant_impl,
-        )
-        self.train_step = jit_train_step(train_step)
-        self.eval_step = jax.jit(
-            build_eval_step(self.model_config, self.config, activation_sharding=act,
-                            quant_impl=quant_impl)
-        )
+        else:
+            quant_impl = self._resolved_quant_impl()
+            train_step = build_train_step(
+                self.model_config, self.config, self.optimizer,
+                activation_sharding=act, quant_impl=quant_impl,
+            )
+            self.train_step = jit_train_step(train_step)
+            self._eval_step_fn = build_eval_step(
+                self.model_config, self.config, activation_sharding=act,
+                quant_impl=quant_impl,
+            )
+        self.eval_step = jax.jit(self._eval_step_fn)
+
+        def eval_all(state, staged):
+            """(ce_sum, token_sum) over every staged eval batch in ONE XLA
+            program: a lax.scan over [nb, bs, seq] slabs. One dispatch + one
+            host sync per eval instead of one per batch; the per-batch
+            compute is the same dp-sharded eval step."""
+            def body(carry, batch):
+                ce, tok = self._eval_step_fn(state, batch)
+                return (carry[0] + ce, carry[1] + tok), None
+
+            (ce, tok), _ = jax.lax.scan(
+                body, (jnp.float32(0.0), jnp.float32(0.0)), staged
+            )
+            return ce, tok
+
+        self._eval_all = jax.jit(eval_all)
+        self._staged_eval = None
 
     def _device_batch(
         self, batch: Dict[str, np.ndarray], sharding, local_shards: bool = False
@@ -495,14 +505,66 @@ class SFTTrainer:
 
     # ------------------------------------------------------------------ eval
 
+    # keep eval slabs device-resident only up to this size; larger validation
+    # sets stream batch-by-batch through eval_step instead
+    _EVAL_STAGE_BYTES = 256 * 1024 * 1024
+
+    @staticmethod
+    def _pad_eval_rows(key: str, arr: np.ndarray, pad_rows: int) -> np.ndarray:
+        """Append pad rows to one eval array. Padded rows carry zero
+        loss_mask so they contribute no tokens to the token-weighted loss,
+        but must not produce fully-masked attention rows: attention_mask is
+        set real, and (packing) segment_ids nonzero so each pad token still
+        attends to itself. Single source for the staged and streaming eval
+        paths."""
+        if pad_rows <= 0:
+            return arr
+        pad_block = np.zeros((pad_rows,) + arr.shape[1:], arr.dtype)
+        if key in ("attention_mask", "segment_ids"):
+            pad_block[:] = 1
+        return np.concatenate([arr, pad_block])
+
+    def _stage_eval_batches(self):
+        """Pad + reshape the validation arrays into device-resident
+        [nb, bs, seq] slabs, sharded like training batches (batch dim over
+        data x fsdp). Built once; every eval after the first is a single
+        dispatch with zero host-side array work."""
+        cfg = self.config
+        bs = cfg.per_device_batch_size * self.dp_size
+        n = self.val_arrays["input_ids"].shape[0]
+        nb = -(-n // bs)
+        staged = {
+            k: self._pad_eval_rows(k, v, nb * bs - n).reshape((nb, bs) + v.shape[1:])
+            for k, v in self.val_arrays.items()
+            if k != "lengths"
+        }
+        return {
+            k: jax.device_put(v, self._batch_sharding) for k, v in staged.items()
+        }
+
     def evaluate(self) -> float:
         """Token-weighted eval loss over the validation split
-        (eval cadence contract: reference ``training.py:270-271``)."""
+        (eval cadence contract: reference ``training.py:270-271``).
+
+        Distributed: the validation batch dim is sharded over the
+        data-parallel axes exactly like a training batch, so per-device work
+        is ~1/dp of the set (pinned by tests/test_distributed_eval.py), and
+        XLA inserts the (ce_sum, token_count) psum. The whole sweep compiles
+        to one scan program with a single host sync per eval."""
         cfg = self.config
         bs = cfg.per_device_batch_size * self.dp_size
         n = self.val_arrays["input_ids"].shape[0]
         if n == 0:
             return float("nan")
+        staged_bytes = sum(
+            v.nbytes for k, v in self.val_arrays.items() if k != "lengths"
+        )
+        if staged_bytes <= self._EVAL_STAGE_BYTES:
+            if self._staged_eval is None:
+                self._staged_eval = self._stage_eval_batches()
+            ce, tokens = self._eval_all(self.state, self._staged_eval)
+            return float(ce) / max(float(tokens), 1.0)
+        # very large validation sets: stream host->device batch by batch
         total_ce, total_tokens = 0.0, 0.0
         for lo in range(0, n, bs):
             batch = {
@@ -512,16 +574,9 @@ class SFTTrainer:
             }
             short = bs - batch["input_ids"].shape[0]
             if short > 0:
-                # pad the tail batch; padded rows carry zero loss_mask so they
-                # contribute no tokens to the token-weighted loss. Pad rows
-                # must not produce fully-masked attention rows: attention_mask
-                # is set real, and (packing) segment_ids nonzero so each pad
-                # token still attends to itself.
-                for key in batch:
-                    pad_block = np.zeros((short,) + batch[key].shape[1:], batch[key].dtype)
-                    if key in ("attention_mask", "segment_ids"):
-                        pad_block[:] = 1
-                    batch[key] = np.concatenate([batch[key], pad_block])
+                batch = {
+                    k: self._pad_eval_rows(k, v, short) for k, v in batch.items()
+                }
             batch = self._device_batch(batch, self._eval_sharding)
             ce, tokens = self.eval_step(self.state, batch)
             total_ce += float(ce)
