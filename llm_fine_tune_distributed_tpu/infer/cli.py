@@ -39,7 +39,9 @@ def run_ask_cli(
     parser.add_argument(
         "--speculative", type=int, default=0, metavar="K",
         help="prompt-lookup speculative decoding with K drafts/step "
-        "(greedy only; pays off when answers quote the context)",
+        "(greedy verifies by exact match; sampled by rejection sampling, "
+        "keeping the output distribution; pays off when answers quote "
+        "the context)",
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
@@ -57,9 +59,6 @@ def run_ask_cli(
     parser.add_argument("--port", type=int, default=8080, help="--serve port")
     args = parser.parse_args(argv)
     question = " ".join(args.question)
-    if args.speculative and not args.greedy and not args.serve:
-        # before the (multi-minute) model load
-        parser.error("--speculative requires --greedy (verification is greedy)")
     if not args.model_dir or not os.path.isdir(args.model_dir):
         # reference exits with guidance when the artifact is missing
         # (ask_tuned_model.py:17-20)
@@ -127,4 +126,9 @@ def run_ask_cli(
     print(f"\nQuestion: {question}\n")
     answer = generator.chat(messages, gen, seed=args.seed, **(template_kwargs or {}))
     print(f"Answer: {answer}")
+    if args.speculative and generator.last_acceptance_rate is not None:
+        print(
+            f"[speculative] {generator.last_spec_steps} sequential forwards, "
+            f"draft acceptance {100 * generator.last_acceptance_rate:.0f}%"
+        )
     return 0

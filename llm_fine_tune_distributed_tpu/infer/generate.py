@@ -49,9 +49,11 @@ class Generator:
             eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
         self.eos_token_ids = tuple(int(e) for e in eos)
         self._jit_cache = {}
-        # sequential-forward count of the last speculative run (telemetry;
-        # None when the last call took the plain batch path)
+        # sequential-forward count + draft acceptance rate of the last
+        # speculative run (telemetry; None when the last call took the plain
+        # batch path)
         self.last_spec_steps: Optional[int] = None
+        self.last_acceptance_rate: Optional[float] = None
 
     # ------------------------------------------------------------- jit build
 
@@ -135,18 +137,30 @@ class Generator:
         return run
 
     def _build_spec(self, prompt_bucket: int, gen: GenerationConfig):
-        """Compile the prompt-lookup speculative greedy decoder (batch 1).
+        """Compile the prompt-lookup speculative decoder (batch 1).
 
         Each step feeds ``[cur, d_1..d_K]`` (K = ``gen.speculative_lookup``
         drafts found by matching the newest bigram earlier in the context)
-        through ONE forward at cache slots ``pos-1 .. pos+K-1`` and accepts
-        the longest prefix of drafts that match the model's own greedy
-        choices. Algorithmically this IS plain greedy decode (bit-exact in
-        f32 — tests/test_generate.py); in bf16 the (K+1)-token verify can
-        resolve a near-tie differently than the 1-token step, so outputs may
-        diverge at tie points exactly as any chunked-verify speculative
-        decoder's do. Pays off when the OUTPUT repeats n-grams from the
-        context (extractive QA, code, summaries); on low-repetition text the
+        through ONE forward at cache slots ``pos-1 .. pos+K-1``.
+
+        GREEDY verify accepts the longest prefix of drafts that match the
+        model's own greedy choices — algorithmically plain greedy decode
+        (bit-exact in f32, tests/test_generate.py; bf16 near-ties at the
+        chunked verify may resolve differently, as in any chunked-verify
+        speculative decoder).
+
+        SAMPLED verify is rejection sampling against the warped target
+        distribution q (Leviathan et al. / SpecInfer, specialized to the
+        deterministic prompt-lookup proposal): accept draft d with
+        probability q(d); on rejection draw from the renormalized residual
+        q with d removed — which makes the emitted token exactly
+        q-distributed at every position, so the OUTPUT DISTRIBUTION equals
+        plain sampling's (pinned statistically by tests/test_generate.py).
+        Draft tokens outside the top-k/top-p support have q = 0 and always
+        reject.
+
+        Pays off when the OUTPUT repeats n-grams from the context
+        (extractive QA, code, summaries); on low-repetition text the
         K+1-wide verify is pure overhead — hence opt-in, default off.
         Rollback is free under the slot == position invariant: the next
         step's writes start at the last accepted position, overwriting every
@@ -161,7 +175,6 @@ class Generator:
 
         @jax.jit
         def run(params, prompt_ids, prompt_lens, rng):
-            del rng  # greedy
             prompt_len = prompt_lens[0]
             b, pb = prompt_ids.shape  # b == 1
             cache = init_cache(mc, b, buf_len, dtype=dtype)
@@ -187,14 +200,15 @@ class Generator:
                 ids_buf, jnp.where(valid, prompt_ids, 0)[0], (0,)
             )
 
-            first = sample_token(None, logits0, seen, gen)[0]
+            rng, sub = jax.random.split(rng)
+            first = sample_token(sub if gen.do_sample else None, logits0, seen, gen)[0]
             ids_buf = ids_buf.at[prompt_len].set(first)
             seen = seen.at[0, first].set(True)
             done = jnp.isin(first, eos) if eos is not None else jnp.bool_(False)
             n_gen = jnp.int32(1)
 
             def body(c):
-                n_gen, cache, ids_buf, seen, done, n_steps = c
+                n_gen, cache, ids_buf, seen, done, n_steps, rng = c
                 pos = prompt_len + n_gen  # position of the next token
 
                 # --- draft: most recent earlier occurrence of the newest bigram
@@ -219,10 +233,43 @@ class Generator:
                 )
                 logits_all = unembed(params, hidden[0], mc, compute_dtype=dtype)
 
-                # --- sequential greedy verify (evolving repetition-penalty set)
+                # --- sequential verify (evolving repetition-penalty set).
+                # Position i's token is ALWAYS valid when emitted (its logits
+                # condition only on accepted tokens); `active` gates whether
+                # position i+1 may still consume the next draft.
                 def verify(i, v):
-                    seen, ids_buf, n_acc, active, done = v
-                    tok = sample_token(None, logits_all[i][None], seen, gen)[0]
+                    seen, ids_buf, n_acc, active, done, rng = v
+                    d = draft[jnp.minimum(i, K - 1)]
+                    if gen.do_sample:
+                        from llm_fine_tune_distributed_tpu.infer.sampling import (
+                            warped_probs,
+                        )
+
+                        rng, sub_u, sub_c = jax.random.split(rng, 3)
+                        q = warped_probs(logits_all[i][None], seen, gen)[0]
+                        # rejection sampling vs the deterministic proposal:
+                        # accept d w.p. q(d); else draw from the residual
+                        # (q with d removed, renormalized) — emitted token is
+                        # exactly q-distributed either way
+                        is_bonus = jnp.asarray(i >= K)
+                        accept_draft = ~is_bonus & (
+                            jax.random.uniform(sub_u) < q[d]
+                        )
+                        residual = jnp.where(is_bonus, q, q.at[d].set(0.0))
+                        z = residual.sum()
+                        # z == 0 only when q is a point mass at d, where
+                        # accept_draft is (almost surely) True and alt unused
+                        residual = jnp.where(z > 0, residual / z, q)
+                        alt = jax.random.categorical(
+                            sub_c, jnp.log(residual + 1e-30)
+                        ).astype(jnp.int32)
+                        tok = jnp.where(accept_draft, d, alt)
+                        keep_going = accept_draft
+                    else:
+                        tok = sample_token(None, logits_all[i][None], seen, gen)[0]
+                        # token i+1 is valid only if draft i matched the
+                        # greedy choice (slot K has no draft to validate)
+                        keep_going = (i >= K) | (d == tok)
                     take = active & ~done & (n_gen + i < max_new)
                     seen = jnp.where(take, seen.at[0, tok].set(True), seen)
                     ids_buf = jnp.where(
@@ -231,25 +278,21 @@ class Generator:
                     n_acc = n_acc + jnp.where(take, 1, 0)
                     hit = jnp.isin(tok, eos) if eos is not None else jnp.bool_(False)
                     done = done | (take & hit)
-                    # token i+1 is valid only if draft i matched the choice
-                    # (the last slot K has no following draft to validate)
-                    active = active & (
-                        (i >= K) | (draft[jnp.minimum(i, K - 1)] == tok)
-                    )
-                    return (seen, ids_buf, n_acc, active, done)
+                    active = active & keep_going
+                    return (seen, ids_buf, n_acc, active, done, rng)
 
-                seen, ids_buf, n_acc, _, done = jax.lax.fori_loop(
+                seen, ids_buf, n_acc, _, done, rng = jax.lax.fori_loop(
                     0, K + 1, lambda i, v: verify(i, v),
-                    (seen, ids_buf, jnp.int32(0), jnp.bool_(True), done),
+                    (seen, ids_buf, jnp.int32(0), jnp.bool_(True), done, rng),
                 )
-                return (n_gen + n_acc, new_cache, ids_buf, seen, done, n_steps + 1)
+                return (n_gen + n_acc, new_cache, ids_buf, seen, done, n_steps + 1, rng)
 
             def cond(c):
-                n_gen, _, _, _, done, _ = c
+                n_gen, _, _, _, done, _, _ = c
                 return (n_gen < max_new) & ~done
 
-            n_gen, cache, ids_buf, seen, done, n_steps = jax.lax.while_loop(
-                cond, body, (n_gen, cache, ids_buf, seen, done, jnp.int32(1))
+            n_gen, cache, ids_buf, seen, done, n_steps, rng = jax.lax.while_loop(
+                cond, body, (n_gen, cache, ids_buf, seen, done, jnp.int32(1), rng)
             )
             out = jax.lax.dynamic_slice(ids_buf, (prompt_len,), (max_new,))
             # n_steps counts sequential forwards (prefill + spec steps);
@@ -273,10 +316,9 @@ class Generator:
             raise ValueError("generate_batch needs >= 1 non-empty prompt")
         longest = max(len(p) for p in prompts)
         bucket = -(-longest // _PROMPT_BUCKET) * _PROMPT_BUCKET
-        # prompt-lookup speculation: greedy, batch-1 (the latency case)
-        speculate = (
-            gen.speculative_lookup > 0 and not gen.do_sample and len(prompts) == 1
-        )
+        # prompt-lookup speculation: batch-1 (the latency case); greedy
+        # verifies by exact match, sampled by rejection sampling
+        speculate = gen.speculative_lookup > 0 and len(prompts) == 1
         if speculate:
             key = ("spec", bucket, gen)
             if key not in self._jit_cache:
@@ -304,6 +346,16 @@ class Generator:
         )
         out, n = res[0], res[1]  # spec path also returns n_steps at res[2]
         self.last_spec_steps = int(res[2]) if len(res) > 2 else None
+        if len(res) > 2:
+            # acceptance telemetry: each of the (n_steps - 1) spec steps
+            # drafted K tokens and emitted 1 + its accepted drafts, and the
+            # prefill emitted 1 — so accepted drafts total n_gen - n_steps
+            spec_steps = max(int(res[2]) - 1, 1)
+            drafted = spec_steps * gen.speculative_lookup
+            accepted = max(int(n) - int(res[2]), 0)
+            self.last_acceptance_rate = accepted / max(drafted, 1)
+        else:
+            self.last_acceptance_rate = None
         out = np.asarray(out)
         results: List[List[int]] = []
         for row in out:

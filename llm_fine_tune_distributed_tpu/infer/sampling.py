@@ -26,11 +26,16 @@ class GenerationConfig:
     top_p: float = 0.95
     top_k: Optional[int] = 40
     repetition_penalty: float = 1.1
-    # Prompt-lookup speculative decoding (greedy only): draft this many
-    # tokens per step by matching the latest bigram earlier in the context,
-    # verify them in ONE forward. 0 = off. Same greedy algorithm (bit-exact
-    # in f32; bf16 near-ties at the chunked verify may resolve differently);
-    # worthwhile when outputs repeat context n-grams (extractive QA, code).
+    # Prompt-lookup speculative decoding: draft this many tokens per step by
+    # matching the latest bigram earlier in the context, verify them in ONE
+    # forward. 0 = off. Greedy verify is the same greedy algorithm (bit-exact
+    # in f32; bf16 near-ties at the chunked verify may resolve differently).
+    # Sampled verify uses rejection sampling against the full warped target
+    # distribution (accept draft d with prob q(d), else draw from the
+    # renormalized residual), so the OUTPUT DISTRIBUTION equals plain
+    # sampling's (tests/test_generate.py pins this statistically) even
+    # though a given seed's draws differ. Worthwhile when outputs repeat
+    # context n-grams (extractive QA, code).
     speculative_lookup: int = 0
 
 
@@ -69,3 +74,29 @@ def sample_token(rng, logits, seen, config: GenerationConfig):
         vals = jnp.where(keep, vals, _NEG_INF)
     choice = jax.random.categorical(rng, vals, axis=-1)  # [batch]
     return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def warped_probs(logits, seen, config: GenerationConfig):
+    """Full-vocab target distribution q after the complete warp pipeline
+    (repetition penalty -> temperature -> top-k -> top-p), i.e. exactly what
+    ``sample_token`` samples from, scattered back to vocab space.
+
+    Needed by speculative rejection sampling, which must evaluate q(draft)
+    for arbitrary draft tokens (a draft outside the top-k/top-p support gets
+    q = 0 and is always rejected — the correct behavior). logits/seen are
+    [batch, vocab]; returns [batch, vocab] probabilities."""
+    if config.repetition_penalty != 1.0:
+        logits = apply_repetition_penalty(logits, seen, config.repetition_penalty)
+    logits = logits / jnp.maximum(config.temperature, 1e-6)
+    vocab = logits.shape[-1]
+    k = min(config.top_k or vocab, vocab)
+    vals, idx = jax.lax.top_k(logits, k)  # [batch, k] descending
+    if config.top_p < 1.0:
+        probs = jax.nn.softmax(vals, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < config.top_p
+        keep = keep.at[..., 0].set(True)
+        vals = jnp.where(keep, vals, _NEG_INF)
+    probs_k = jax.nn.softmax(vals, axis=-1)
+    out = jnp.zeros(logits.shape, probs_k.dtype)
+    return out.at[jnp.arange(logits.shape[0])[:, None], idx].set(probs_k)

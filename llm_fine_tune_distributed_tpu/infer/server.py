@@ -96,7 +96,8 @@ def serve(
                 "repetition_penalty": float,
             }
             # "speculative": K maps to GenerationConfig.speculative_lookup
-            # (greedy-only prompt-lookup decoding, infer/generate.py)
+            # (prompt-lookup decoding, infer/generate.py — greedy exact-match
+            # or sampled rejection-sampling verification)
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(length) or b"{}")
@@ -110,8 +111,6 @@ def serve(
                     gen_kwargs["do_sample"] = not req["greedy"]
                 if "speculative" in req:
                     gen_kwargs["speculative_lookup"] = int(req["speculative"])
-                    if gen_kwargs.get("do_sample", True):
-                        raise ValueError("speculative requires greedy: true")
                 seed = int(req.get("seed", 0))
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"bad request: {e}"})

@@ -219,21 +219,27 @@ def test_speculative_eos_stops(tiny_setup):
     assert gen.generate_ids(prompt, spec_cfg) == expect
 
 
-def test_speculative_falls_back_for_sampling_and_batch(tiny_setup):
-    """speculative_lookup is ignored for sampled or multi-prompt requests
-    (they use the standard batch path)."""
+def test_speculative_falls_back_for_batch(tiny_setup):
+    """speculative_lookup is ignored for multi-prompt requests (they use the
+    standard batch path); sampled single-prompt requests DO speculate
+    (rejection-sampling verification)."""
     mc, params, tok = tiny_setup
     gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
     p = tok.encode("hello")
-    sampled = GenerationConfig(max_new_tokens=4, do_sample=True, speculative_lookup=4)
-    assert gen.generate_ids(p, sampled, seed=1) == gen.generate_ids(
-        p, GenerationConfig(max_new_tokens=4, do_sample=True), seed=1
-    )
     greedy_spec = GenerationConfig(
         max_new_tokens=4, do_sample=False, repetition_penalty=1.0, speculative_lookup=4
     )
     two = gen.generate_batch([p, tok.encode("bye")], greedy_spec)
     assert len(two) == 2 and all(len(t) == 4 for t in two)
+    assert gen.last_spec_steps is None  # batch path, no speculation
+
+    sampled = GenerationConfig(max_new_tokens=4, do_sample=True, speculative_lookup=4)
+    out = gen.generate_ids(p, sampled, seed=1)
+    assert len(out) == 4 and all(0 <= t < mc.vocab_size for t in out)
+    assert gen.last_spec_steps is not None  # spec path ran
+    assert gen.last_acceptance_rate is not None
+    # seeded determinism still holds for the sampled spec path
+    assert out == gen.generate_ids(p, sampled, seed=1)
 
 
 def test_speculative_accepts_on_repetitive_output(tiny_setup):
@@ -263,3 +269,70 @@ def test_speculative_accepts_on_repetitive_output(tiny_setup):
             )
             return
     raise AssertionError("no repetitive greedy continuation found to test with")
+
+
+
+def test_sampled_speculative_near_greedy_temperature_matches(tiny_setup):
+    """At a temperature low enough that the warped distribution is a point
+    mass, rejection-sampling speculation must reproduce the deterministic
+    plain-sampling output exactly (accept probability q(argmax) == 1)."""
+    mc, params, tok = tiny_setup
+    gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    prompt = tok.encode("hello world")
+    base = dict(max_new_tokens=12, do_sample=True, temperature=1e-4,
+                top_k=40, top_p=0.95, repetition_penalty=1.1)
+    plain = GenerationConfig(**base)
+    spec = GenerationConfig(**base, speculative_lookup=3)
+    for seed in range(3):
+        assert gen.generate_ids(prompt, spec, seed=seed) == gen.generate_ids(
+            prompt, plain, seed=seed
+        )
+
+
+@pytest.mark.slow
+def test_sampled_speculative_matches_plain_distribution(tiny_setup):
+    """Rejection-sampling verification preserves the sampling distribution:
+    over many seeds, the marginal token distribution at each position matches
+    plain sampling's within the null noise level (calibrated by comparing
+    two disjoint plain-sampling seed ranges against each other)."""
+    mc, params, tok = tiny_setup
+    gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    prompt = tok.encode("ab ab ab ab")  # repeated bigrams -> drafts fire
+    n_pos = 3
+    base = dict(max_new_tokens=n_pos, do_sample=True, temperature=1.0,
+                top_k=20, top_p=0.95, repetition_penalty=1.1)
+    plain = GenerationConfig(**base)
+    spec = GenerationConfig(**base, speculative_lookup=3)
+
+    n = 400
+    from collections import Counter
+
+    plain_a = [Counter() for _ in range(n_pos)]
+    plain_b = [Counter() for _ in range(n_pos)]
+    spec_c = [Counter() for _ in range(n_pos)]
+    accepted_any = False
+    for seed in range(n):
+        a = gen.generate_ids(prompt, plain, seed=seed)
+        b = gen.generate_ids(prompt, plain, seed=n + seed)
+        c = gen.generate_ids(prompt, spec, seed=seed)
+        accepted_any = accepted_any or (gen.last_acceptance_rate or 0) > 0
+        for j in range(n_pos):
+            plain_a[j][a[j]] += 1
+            plain_b[j][b[j]] += 1
+            spec_c[j][c[j]] += 1
+    assert accepted_any, "no draft was ever accepted - the test has no power"
+
+    def tv(x, y):
+        support = set(x) | set(y)
+        return 0.5 * sum(abs(x[t] / n - y[t] / n) for t in support)
+
+    # position 0 precedes any speculation and shares the rng split layout:
+    # bit-identical draws
+    assert tv(plain_a[0], spec_c[0]) == 0.0
+    for j in range(1, n_pos):
+        null = tv(plain_a[j], plain_b[j])  # pure sampling noise at this n
+        got = tv(plain_a[j], spec_c[j])
+        assert got < 2.0 * null + 0.05, (
+            f"position {j}: TV(plain, spec) = {got:.3f} vs plain-vs-plain "
+            f"null {null:.3f} - speculative sampling skews the distribution"
+        )

@@ -152,8 +152,8 @@ def test_serve_int8(model_dir):
 
 
 def test_speculative_request_field(server):
-    """POST /v1/generate accepts "speculative": K with greedy, and rejects
-    it for sampled requests."""
+    """POST /v1/generate accepts "speculative": K for greedy AND sampled
+    requests (sampled verification is rejection sampling, infer/generate.py)."""
     def post(body):
         req = urllib.request.Request(
             f"{server}/v1/generate", data=json.dumps(body).encode(),
@@ -165,6 +165,5 @@ def test_speculative_request_field(server):
         {"question": "water?", "max_new_tokens": 4, "greedy": True, "speculative": 4}
     ) as r:
         assert isinstance(json.loads(r.read())["answer"], str)
-    with pytest.raises(urllib.error.HTTPError) as e:
-        post({"question": "water?", "max_new_tokens": 4, "speculative": 4})
-    assert e.value.code == 400
+    with post({"question": "water?", "max_new_tokens": 4, "speculative": 4}) as r:
+        assert isinstance(json.loads(r.read())["answer"], str)
