@@ -52,6 +52,11 @@ def run_ask_cli(
         "stream that bounds batch-1 decode (ops/int8.py)",
     )
     parser.add_argument(
+        "--tp", type=int, default=1, metavar="N",
+        help="tensor-parallel inference over N local devices (shards weights "
+        "and KV cache so models beyond one chip's HBM are servable)",
+    )
+    parser.add_argument(
         "--serve", action="store_true",
         help="run the HTTP server (infer/server.py) instead of answering once",
     )
@@ -89,6 +94,7 @@ def run_ask_cli(
         serve(
             args.model_dir, host=args.host, port=args.port,
             quantize=args.quantize, template_kwargs=template_kwargs,
+            tp=args.tp,
         )
         return 0
     if not question:
@@ -108,7 +114,13 @@ def run_ask_cli(
 
     params = maybe_quantize(params, args.quantize)
     tokenizer = load_tokenizer_dir(args.model_dir)
-    generator = Generator(params, model_config, tokenizer)
+    mesh = None
+    if args.tp > 1:
+        from llm_fine_tune_distributed_tpu.infer.generate import make_tp_mesh
+
+        mesh = make_tp_mesh(args.tp)
+        print(f"Tensor-parallel decode over {args.tp} devices")
+    generator = Generator(params, model_config, tokenizer, mesh=mesh)
 
     gen = GenerationConfig(
         max_new_tokens=args.max_new_tokens,

@@ -29,8 +29,26 @@ from llm_fine_tune_distributed_tpu.models.transformer import forward, init_cache
 _PROMPT_BUCKET = 256
 
 
+def make_tp_mesh(tp: int):
+    """Tensor-parallel inference mesh over the first ``tp`` local devices
+    (the `--tp` flag of ask_tuned_model.py / smollm3-serve)."""
+    from llm_fine_tune_distributed_tpu.config import MeshConfig
+    from llm_fine_tune_distributed_tpu.runtime.mesh import make_mesh
+
+    return make_mesh(MeshConfig(data=1, fsdp=1, tensor=tp, seq=1, expert=1, pipe=1))
+
+
 class Generator:
-    """Single-host generation engine over a params pytree."""
+    """Generation engine over a params pytree — single-chip by default, or
+    sharded over a device mesh.
+
+    With ``mesh`` (tensor/expert axes live), weights shard per the training
+    rules (parallel/sharding.py: Megatron column/row TP, stacked experts
+    over ``expert``) and the KV cache follows the kv-head sharding by
+    propagation — so llama3_70b / mixtral presets that exceed one chip's
+    HBM are servable. Single-chip is the degenerate ``mesh=None`` case; the
+    reference's analog is ``device_map="auto"`` multi-GPU loading
+    (reference ``ask_tuned_model.py:26-30``)."""
 
     def __init__(
         self,
@@ -39,7 +57,21 @@ class Generator:
         tokenizer,
         compute_dtype=jnp.bfloat16,
         eos_token_ids: Optional[Sequence[int]] = None,
+        mesh=None,
     ):
+        self.mesh = mesh
+        self._act_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from llm_fine_tune_distributed_tpu.parallel.sharding import shard_params
+
+            params = shard_params(params, mesh)
+            # batch-1 decode activations are tiny: keep them replicated and
+            # let the weight shardings drive the per-block psums. Passing
+            # the sharding also hands `forward` the mesh (embed/unembed
+            # vocab-sharded lookups, MoE expert dispatch).
+            self._act_sharding = NamedSharding(mesh, P())
         self.params = params
         self.config = model_config
         self.tokenizer = tokenizer
@@ -73,15 +105,16 @@ class Generator:
         """
         mc = self.config
         dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
         buf_len = prompt_bucket + gen.max_new_tokens
         eos = jnp.asarray(self.eos_token_ids, jnp.int32) if self.eos_token_ids else None
 
         def step_logits(params, token_ids, cache, cache_pos):
             hidden, cache = forward(
                 params, token_ids, mc, cache=cache, cache_pos=cache_pos,
-                compute_dtype=dtype, output_hidden=True,
+                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
             )
-            logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype)
+            logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype, mesh=mesh)
             return logits, cache
 
         @jax.jit
@@ -91,12 +124,12 @@ class Generator:
 
             hidden, cache = forward(
                 params, prompt_ids, mc, cache=cache, cache_pos=0,
-                compute_dtype=dtype, output_hidden=True,
+                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
             )
             last_h = jnp.take_along_axis(
                 hidden, (prompt_lens - 1)[:, None, None], axis=1
             )[:, 0]
-            logits0 = unembed(params, last_h, mc, compute_dtype=dtype)
+            logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
 
             valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
             safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
@@ -168,6 +201,7 @@ class Generator:
         """
         mc = self.config
         dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
         K = gen.speculative_lookup
         max_new = gen.max_new_tokens
         buf_len = prompt_bucket + max_new + K + 1
@@ -181,12 +215,12 @@ class Generator:
 
             hidden, cache = forward(
                 params, prompt_ids, mc, cache=cache, cache_pos=0,
-                compute_dtype=dtype, output_hidden=True,
+                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
             )
             last_h = jnp.take_along_axis(
                 hidden, (prompt_len - 1)[None, None, None], axis=1
             )[:, 0]
-            logits0 = unembed(params, last_h, mc, compute_dtype=dtype)
+            logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
 
             valid = jnp.arange(pb)[None, :] < prompt_len
             safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
@@ -229,9 +263,9 @@ class Generator:
                 inputs = jnp.concatenate([cur[None], draft])[None, :]  # [1, K+1]
                 hidden, new_cache = forward(
                     params, inputs, mc, cache=cache, cache_pos=pos - 1,
-                    compute_dtype=dtype, output_hidden=True,
+                    compute_dtype=dtype, output_hidden=True, activation_sharding=act,
                 )
-                logits_all = unembed(params, hidden[0], mc, compute_dtype=dtype)
+                logits_all = unembed(params, hidden[0][None], mc, compute_dtype=dtype, mesh=mesh)[0]
 
                 # --- sequential verify (evolving repetition-penalty set).
                 # Position i's token is ALWAYS valid when emitted (its logits

@@ -37,6 +37,7 @@ def serve(
     quantize: str = "none",
     template_kwargs: Optional[dict] = None,
     request_timeout_s: Optional[float] = 600.0,
+    tp: int = 1,
 ) -> None:
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
@@ -58,7 +59,13 @@ def serve(
     params, model_config = load_model_dir(model_dir)
     params = maybe_quantize(params, quantize)
     tokenizer = load_tokenizer_dir(model_dir)
-    generator = Generator(params, model_config, tokenizer)
+    mesh = None
+    if tp > 1:
+        from llm_fine_tune_distributed_tpu.infer.generate import make_tp_mesh
+
+        mesh = make_tp_mesh(tp)
+        print(f"Tensor-parallel decode over {tp} devices")
+    generator = Generator(params, model_config, tokenizer, mesh=mesh)
     engine = BatchingEngine(generator, max_batch=max_batch, window_ms=batch_window_ms)
     print(f"Model ready (max_batch={max_batch}, quantize={quantize}).")
 
@@ -173,6 +180,10 @@ def main(argv: Optional[list] = None) -> int:
         help="weight-only inference quantization (ops/int8.py)",
     )
     parser.add_argument(
+        "--tp", type=int, default=1, metavar="N",
+        help="tensor-parallel inference over N local devices",
+    )
+    parser.add_argument(
         "--request-timeout-s", type=float, default=600.0,
         help="max seconds a request waits for the device before a 503 "
              "(0 = wait forever)",
@@ -183,7 +194,7 @@ def main(argv: Optional[list] = None) -> int:
         return 1
     serve(args.model_dir, args.host, args.port, args.max_batch,
           args.batch_window_ms, args.quantize,
-          request_timeout_s=args.request_timeout_s or None)
+          request_timeout_s=args.request_timeout_s or None, tp=args.tp)
     return 0
 
 

@@ -35,7 +35,9 @@ from llm_fine_tune_distributed_tpu.utils.tree import map_with_path
 # NF4-quantized kernels (ops/nf4.py) keep the base kernel's orientation:
 # packed [in/8, out] and absmax [in/block, out] shard like kernel [in, out]
 # (_validate_spec drops any axis the smaller dims no longer divide).
-_QK = r"kernel(_nf4|_absmax|_absmax_q)?$"
+# int8 weight-only inference kernels (ops/int8.py) keep the base [in, out]
+# orientation; their 1-D scales fall through to the replicated default.
+_QK = r"kernel(_nf4|_absmax|_absmax_q|_int8)?$"
 _MATRIX_RULES = [
     # attention projections
     (re.compile(r".*self_attn/(q_proj|k_proj|v_proj)/" + _QK), ("fsdp", "tensor")),
