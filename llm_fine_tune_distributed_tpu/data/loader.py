@@ -32,6 +32,8 @@ class SFTBatchLoader:
         seed: int = 42,
         drop_last: bool = True,
         shuffle: bool = True,
+        row_start: Optional[int] = None,
+        row_count: Optional[int] = None,
     ):
         self.arrays = arrays
         self.n = next(iter(arrays.values())).shape[0]
@@ -50,13 +52,22 @@ class SFTBatchLoader:
             raise ValueError(
                 f"global batch {self.global_batch} exceeds dataset size {self.n}"
             )
-        # per-host slice of each global batch
-        if (per_device_batch_size * data_parallel_size) % process_count:
-            raise ValueError(
-                f"batch {per_device_batch_size}x{data_parallel_size} not divisible "
-                f"by {process_count} hosts"
-            )
-        self.per_host_batch = per_device_batch_size * data_parallel_size // process_count
+        # per-host slice of each global batch: explicit (row_start, row_count)
+        # when the trainer derives it from the mesh (a seq axis spanning
+        # processes makes several hosts load the SAME rows — their devices
+        # hold different sequence slices of them), else the classic
+        # contiguous-column-per-process split
+        if row_count is not None:
+            self.per_host_batch = row_count
+            self.row_start = row_start or 0
+        else:
+            if (per_device_batch_size * data_parallel_size) % process_count:
+                raise ValueError(
+                    f"batch {per_device_batch_size}x{data_parallel_size} not divisible "
+                    f"by {process_count} hosts"
+                )
+            self.per_host_batch = per_device_batch_size * data_parallel_size // process_count
+            self.row_start = process_index * self.per_host_batch
 
     @property
     def steps_per_epoch(self) -> int:
@@ -79,7 +90,7 @@ class SFTBatchLoader:
             # contiguous host shard of the global batch, over the accum axis:
             # layout [accum, world_batch] -> this host's columns
             idx = idx.reshape(self.grad_accum, -1)  # [accum, bs*dp]
-            lo = self.process_index * self.per_host_batch
+            lo = self.row_start
             hi = lo + self.per_host_batch
             idx = idx[:, lo:hi]
             # every array keyed by example index rides along (SFT:

@@ -42,6 +42,8 @@ class NativeBatchLoader:
         drop_last: bool = True,
         shuffle: bool = True,
         queue_depth: int = 2,
+        row_start=None,
+        row_count=None,
     ):
         lib = native.load()
         if lib is None:
@@ -62,13 +64,19 @@ class NativeBatchLoader:
             raise ValueError(
                 f"global batch {self.global_batch} exceeds dataset size {self.n}"
             )
-        if (per_device_batch_size * data_parallel_size) % process_count:
-            raise ValueError(
-                f"batch {per_device_batch_size}x{data_parallel_size} not divisible "
-                f"by {process_count} hosts"
-            )
-        self.per_host_batch = per_device_batch_size * data_parallel_size // process_count
-        host_lo = process_index * self.per_host_batch
+        if row_count is not None:
+            # mesh-derived per-host rows (seq axis spanning processes makes
+            # hosts share rows — see data/loader.py)
+            self.per_host_batch = row_count
+            host_lo = row_start or 0
+        else:
+            if (per_device_batch_size * data_parallel_size) % process_count:
+                raise ValueError(
+                    f"batch {per_device_batch_size}x{data_parallel_size} not divisible "
+                    f"by {process_count} hosts"
+                )
+            self.per_host_batch = per_device_batch_size * data_parallel_size // process_count
+            host_lo = process_index * self.per_host_batch
 
         self._handle = lib.sft_loader_create(
             _i32p(self._ids), _i32p(self._lm), _i32p(self._am),

@@ -100,9 +100,44 @@ class SFTTrainer:
             return {"system_prompt": self.config.system_prompt}
         return {}
 
+    def _process_batch_rows(self) -> tuple:
+        """(row_start, row_count): this process's contiguous row range of
+        each microbatch's global batch, derived from the mesh.
+
+        With the batch dim sharded over (data, fsdp) and the standard axis
+        order, a process's devices cover a contiguous block of rows. When a
+        seq (or tensor/pipe) axis spans processes, several processes map to
+        the SAME rows — each loads the full rows and its devices take their
+        sequence slices in _device_batch. This is what lets the seq axis
+        cross host boundaries (long-context ring attention over DCN)."""
+        B = self.config.per_device_batch_size * self.dp_size
+        if jax.process_count() == 1:
+            return 0, B
+        sharding = NamedSharding(self.mesh, P(("data", "fsdp")))
+        index_map = sharding.devices_indices_map((B,))
+        pid = jax.process_index()
+        blocks = sorted(
+            {
+                ((sl[0].start or 0), B if sl[0].stop is None else sl[0].stop)
+                for d, sl in index_map.items()
+                if d.process_index == pid
+            }
+        )
+        lo, hi = blocks[0][0], blocks[-1][1]
+        covered = 0
+        for s, e in blocks:
+            covered += e - s
+        if covered != hi - lo:
+            raise ValueError(
+                f"process {pid}'s batch rows are not contiguous ({blocks}); "
+                "reorder the mesh axes so data/fsdp are outermost"
+            )
+        return lo, hi - lo
+
     def _loader_kwargs(self) -> Dict[str, Any]:
         """Batch-loader kwargs (shared SFT/DPO so sharding semantics can't drift)."""
         cfg = self.config
+        self._row_start, self._row_count = self._process_batch_rows()
         return dict(
             per_device_batch_size=cfg.per_device_batch_size,
             grad_accum_steps=cfg.gradient_accumulation_steps,
@@ -111,6 +146,8 @@ class SFTTrainer:
             process_count=jax.process_count(),
             seed=cfg.seed,
             drop_last=cfg.drop_last,
+            row_start=self._row_start,
+            row_count=self._row_count,
         )
 
     def _prepare_data(self) -> None:
@@ -396,20 +433,10 @@ class SFTTrainer:
             self.config.attention_impl in ("ring", "ulysses")
             and self.mesh.shape["seq"] > 1
         )
-        if (
-            seq_sharded
-            and jax.process_count() > 1
-            and self.mesh.shape["seq"] * self.mesh.shape["tensor"]
-            > jax.local_device_count()
-        ):
-            # The loader hands each process host-complete sequences; a seq
-            # axis crossing process boundaries would need seq-sliced host
-            # data too. Keep the ring within a host (ICI) for now.
-            raise NotImplementedError(
-                "multi-host runs require the seq axis to fit within one "
-                f"host's devices (seq*tensor={self.mesh.shape['seq'] * self.mesh.shape['tensor']}"
-                f" > local devices {jax.local_device_count()}); reshape the mesh"
-            )
+        # The seq axis may span process boundaries: processes sharing batch
+        # rows each load the full rows (_process_batch_rows) and their
+        # devices take sequence slices in _device_batch — long-context ring
+        # attention across hosts rides DCN collectives.
         seq_ax = "seq" if seq_sharded else None
         act = NamedSharding(self.mesh, P(("data", "fsdp"), seq_ax, None))
         self._batch_sharding = NamedSharding(self.mesh, P(None, ("data", "fsdp"), seq_ax))
@@ -481,26 +508,36 @@ class SFTTrainer:
         # "lengths" never reaches here: the loader strips it before yielding.
         #
         # Two multi-process cases:
-        # - local_shards=True (training): each process holds only ITS column
-        #   of the global batch (data/loader.py shards by process_index), so
-        #   the global array is assembled from per-process pieces.
+        # - local_shards=True (training): each process holds the global batch
+        #   ROWS its devices need (data/loader.py row_start/row_count —
+        #   disjoint columns for plain dp meshes, shared rows when a seq axis
+        #   spans processes), host-complete along the sequence. Each device's
+        #   (row, seq) block is served from that local slab by callback.
         # - local_shards=False (eval): every process builds the identical full
         #   batch, and device_put's global semantics take each host's shard.
         if local_shards and jax.process_count() > 1:
-            # Global shape is the loader contract — batch dim (axis 1 of
-            # [accum, per_host_batch, seq]) is split contiguously by process
-            # index, everything else host-complete. Passing it explicitly
-            # (instead of letting inference guess from the sharding) keeps
-            # this correct for meshes whose batch axes do not span every
-            # process uniformly.
-            return {
-                k: jax.make_array_from_process_local_data(
-                    sharding,
-                    v,
-                    (v.shape[0], v.shape[1] * jax.process_count(), *v.shape[2:]),
-                )
-                for k, v in batch.items()
-            }
+            B = self.config.per_device_batch_size * self.dp_size
+            row_lo = getattr(self, "_row_start", 0)
+
+            def make(v):
+                gshape = (v.shape[0], B, *v.shape[2:])
+
+                def cb(index):
+                    row_sl = index[1]
+                    start = row_sl.start or 0
+                    stop = B if row_sl.stop is None else row_sl.stop
+                    if not (row_lo <= start and stop <= row_lo + v.shape[1]):
+                        raise ValueError(
+                            f"device requests batch rows [{start}, {stop}) but "
+                            f"this process loaded [{row_lo}, {row_lo + v.shape[1]})"
+                            " — mesh/loader row layout mismatch"
+                        )
+                    local = (index[0], slice(start - row_lo, stop - row_lo), *index[2:])
+                    return v[local]
+
+                return jax.make_array_from_callback(gshape, sharding, cb)
+
+            return {k: make(v) for k, v in batch.items()}
         return {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
     # ------------------------------------------------------------------ eval

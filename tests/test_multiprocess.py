@@ -121,3 +121,91 @@ def test_two_process_training(tmp_path):
     # the completion banner is host-0-gated (reference rank-0 prints)
     assert "completed successfully" in outputs[0]
     assert "completed successfully" not in outputs[1]
+
+
+@pytest.mark.slow
+def test_two_process_cross_host_sequence_parallel(tmp_path):
+    """The seq axis SPANS process boundaries (VERDICT r1 #4): 2 processes x 1
+    device, mesh seq=2, ring attention — each host loads the same batch rows
+    and its device holds a sequence slice; the ring's ppermute crosses the
+    process gap every step."""
+    from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
+
+    jsonl = tmp_path / "qa.jsonl"
+    with open(jsonl, "w") as f:
+        for i in range(32):
+            f.write(json.dumps({
+                "topic": "Knots",
+                "question": f"question {i}?",
+                "answer": f"answer {i}: " + "word " * (3 + i % 4),
+            }) + "\n")
+    convert_jsonl_to_parquet(str(jsonl), str(tmp_path / "qa_dataset.parquet"), verbose=False)
+
+    out = tmp_path / "outputs"
+    cfg = {
+        "model_name": "tiny-random",
+        "model_preset": "tiny",
+        "tokenizer_path": "byte-chatml",
+        "system_prompt": "You are an expert.",
+        "data_dir": str(tmp_path),
+        "dataset_file": "qa_dataset.parquet",
+        "output_dir": str(out),
+        "epochs": 1,
+        "per_device_batch_size": 2,
+        "gradient_accumulation_steps": 2,
+        "learning_rate": 2e-3,
+        "max_seq_length": 128,
+        "eval_steps": 4,
+        "logging_steps": 2,
+        "save_steps": 100,
+        "attention_impl": "ring",
+        "mesh": {"data": 1, "fsdp": 1, "tensor": 1, "seq": 2},
+        "use_native_loader": False,
+        "heartbeat": False,
+    }
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            WORLD_SIZE="2",
+            RANK=str(rank),
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT=str(port),
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            JAX_PLATFORMS="cpu",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "training.py"),
+                 "--config", str(cfg_path), "--platform", "cpu"],
+                env=env,
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+
+    outputs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("cross-host seq-parallel training timed out")
+        outputs.append(stdout)
+
+    for rank, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{text[-4000:]}"
+
+    assert (out / "best_model" / "model.safetensors").exists()
+    history = json.loads((out / "training_history.json").read_text())
+    losses = [h["loss"] for h in history if "loss" in h]
+    assert len(losses) >= 2 and all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
+    assert "completed successfully" in outputs[0]
