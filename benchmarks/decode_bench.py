@@ -7,7 +7,7 @@ every matmul weight once — so the NF4 path (4.5 bits/param at rest) trades a
 ~3.5x smaller HBM weight stream against dequantization cost. The NF4 matmuls
 run through the default XLA dequant path (``nf4_matmul(impl="auto")``
 resolves to ``"xla"`` — measured fastest on v5e; the fused Pallas VMEM-decode
-kernel of ops/nf4_pallas.py stays opt-in via ``impl="pallas"``). This harness
+Pallas kernel was retired after the v5e shootout — ops/nf4.py). This harness
 measures both variants on the same chip and prints one JSON line per variant.
 
 The reference has no decode benchmark (its inference is an interactive CLI);

@@ -230,7 +230,7 @@ class TrainConfig:
     # QLoRA quantization (freeze_strategy="qlora": NF4 frozen base)
     quant_block_size: int = 64        # NF4 scale block (QLoRA paper default)
     quant_double_quant: bool = True   # int8-compress the absmax scales
-    quant_matmul_impl: str = "auto"   # "auto" | "xla" | "pallas"
+    quant_matmul_impl: str = "auto"   # "auto" | "xla" (fused pallas retired: ops/nf4.py)
 
     # LoRA (external-doc config: r=16, alpha=8, dropout=0.05, 7 proj targets)
     lora_rank: int = 16

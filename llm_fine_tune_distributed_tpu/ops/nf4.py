@@ -200,50 +200,32 @@ def dequantize_nf4(q: Dict, dtype=jnp.bfloat16):
 def nf4_matmul(x, q: Dict, impl: str = "auto", compute_dtype=jnp.bfloat16):
     """``x [. , in] @ dequant(q) [in, out]``.
 
-    impl:
-      - "xla": dequantize then jnp.dot (XLA fuses decode into the operand
-        read where it can; correct everywhere).
-      - "pallas": fused Pallas kernel — decodes 4-bit tiles in VMEM so the
-        bf16 weight never round-trips HBM. Experimental: see measurements.
-      - "auto": currently always xla.
+    impl: "xla" (dequantize then jnp.dot; XLA fuses decode into the operand
+    read where it can) or "auto" (resolves to "xla").
 
-    Measured on a v5e chip: at training shapes (M=8192, K=N=2048) the fused
-    kernel re-decodes the weight tile once per M-tile and lands ~1.8x slower
-    than XLA dequant; at batch-1 3B decode (benchmarks/decode_bench.py) the
-    NF4 path reaches ~35 tokens/sec vs ~101 for plain bf16 (and ~154 for
-    int8 weight-only, ops/int8.py) — the shift/mask/select nibble decode,
-    not HBM bandwidth, is the bottleneck on this chip. NF4's value here is
-    MEMORY (4.5 bits/param at rest, one layer decoded at a time under
-    remat/liveness), not speed, so "auto" resolves to the XLA path
-    everywhere until a faster decode (e.g. MXU one-hot lookup) lands; for
-    decode SPEED use int8.
+    A fused Pallas decode kernel was built and RETIRED after head-to-head
+    measurement on a v5e chip (round-2 shootout; BASELINE.md "NF4 matmul
+    implementations"): at the 3B train-microbatch shape (M=2048, K=2048,
+    N=11008) fused-pallas ran 7.8ms vs 6.7ms XLA vs 5.6ms bf16, and at
+    batch-1 decode both NF4 paths sat ~6.5ms vs 20us bf16. The bottleneck
+    is not HBM (a fused kernel's win) but the exact nibble decode itself:
+    any exact NF4 expansion — select chain, binary select tree, one-hot
+    compare + MXU dot, Lagrange polynomial — costs ~16 VPU ops per weight,
+    and the VPU is ~100x slower than the MXU on this chip. NF4's value here
+    is MEMORY (4.5 bits/param at rest, one layer decoded at a time under
+    remat/liveness), not speed; for decode SPEED use int8 weight-only
+    (ops/int8.py: 1 multiply per weight, measured 1.5x bf16).
     """
     if impl == "auto":
         impl = "xla"
-    if impl == "pallas":
-        if not _pallas_supported(x, q):
-            raise ValueError(
-                "nf4 pallas kernel unsupported for this shape "
-                f"(out {q['nf4'].shape[1]} must tile by 128; in "
-                f"{q['nf4'].shape[0] * 8} by 512, covering whole scale "
-                "blocks); use impl='xla'"
-            )
-        from llm_fine_tune_distributed_tpu.ops.nf4_pallas import nf4_matmul_pallas
-
-        return nf4_matmul_pallas(x, q, compute_dtype=compute_dtype)
+    if impl != "xla":
+        raise ValueError(
+            f"unknown nf4 matmul impl {impl!r} (the fused Pallas kernel was "
+            "retired after losing to the XLA path on v5e — see nf4_matmul "
+            "docstring; use impl='xla' or int8 weight-only for speed)"
+        )
     w = dequantize_nf4(q, dtype=compute_dtype)
     return x.astype(compute_dtype) @ w
-
-
-def _pallas_supported(x, q) -> bool:
-    """Shape gate for explicit impl="pallas" calls (see nf4_matmul)."""
-    k8, n = q["nf4"].shape
-    k = k8 * 8
-    am = q["absmax"] if "absmax" in q else q["absmax_q"]
-    block = k // am.shape[0]
-    # kernel K-tile is fixed at 512 (see nf4_pallas._matmul_2d): the out dim
-    # must tile by 128 lanes, K by 512, and 512 must cover whole scale blocks
-    return n % 128 == 0 and k % 512 == 0 and 512 % block == 0
 
 
 # Canonical sibling-leaf naming scheme for a quantized ``kernel``. Every

@@ -448,11 +448,9 @@ class SFTTrainer:
         return self.config.max_seq_length
 
     def _resolved_quant_impl(self) -> str:
-        """The fused Pallas decode kernel is not SPMD-partitionable by the
-        sharding propagator; sharded runs take the XLA dequant path (still
-        4-bit at rest in HBM, one layer decoded at a time under remat)."""
-        if self.config.quant_matmul_impl == "auto" and self.mesh.size > 1:
-            return "xla"
+        """NF4 matmuls take the XLA dequant path on every mesh (the fused
+        Pallas kernel was retired after losing the v5e shootout —
+        ops/nf4.nf4_matmul docstring; 4-bit at rest in HBM either way)."""
         return self.config.quant_matmul_impl
 
     def _prepare_steps(self) -> None:
