@@ -477,6 +477,12 @@ def _lookup_table_constraint(table, mesh, vocab_dim: int = 0):
         if axes.get(ax, 1) > 1 and table.shape[vocab_dim] % axes[ax] == 0:
             vocab_ax = ax
             break
+    if vocab_ax is None:
+        # nothing to shard (single-chip mesh, or indivisible vocab): a
+        # no-op constraint would still be an HLO boundary that blocks XLA
+        # from fusing the weight cast into the matmul — measurably slower
+        # inside the remat'd chunked-CE loop
+        return table
     spec = [None, None]
     spec[vocab_dim] = vocab_ax
     return jax.lax.with_sharding_constraint(
