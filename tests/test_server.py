@@ -109,6 +109,7 @@ def test_unknown_path_404(server):
     assert e.value.code == 404
 
 
+@pytest.mark.slow
 def test_concurrent_generate_batched(server):
     """Several simultaneous identical-config requests all succeed and agree
     (greedy + shared seed -> the batcher groups them; batched greedy rows
@@ -151,6 +152,7 @@ def test_serve_int8(model_dir):
         assert isinstance(json.loads(r.read())["answer"], str)
 
 
+@pytest.mark.slow
 def test_speculative_request_field(server):
     """POST /v1/generate accepts "speculative": K for greedy AND sampled
     requests (sampled verification is rejection sampling, infer/generate.py)."""
