@@ -41,6 +41,11 @@ class _AimSink:
 
         self.run = Run(repo=repo, experiment=experiment)
 
+    def set_params(self, params: Dict) -> None:
+        # run-level hparams: what makes "Color by run.hparams.learning_rate"
+        # and AimQL filters (docs/aim-workflow.md "Comparing runs") work
+        self.run["hparams"] = params
+
     def log(self, step: int, epoch: float, logs: Dict[str, float]) -> None:
         for key, value in logs.items():
             if not isinstance(value, (int, float)):
@@ -90,6 +95,19 @@ class MetricLogger:
                     self.sinks.append(_AimSink(aim_repo, experiment))
                 except ImportError:
                     print("[metrics] aim not installed; falling back to JSONL sink only")
+
+    def set_params(self, params: Dict) -> None:
+        """Record run-level hyperparameters on every sink that supports them
+        (Aim run['hparams']; the JSONL sink writes one {'hparams': ...}
+        record). Call once at trainer construction."""
+        if not self.primary:
+            return
+        for sink in self.sinks:
+            if hasattr(sink, "set_params"):
+                sink.set_params(params)
+            elif isinstance(sink, _JsonlSink):
+                sink._f.write(json.dumps({"hparams": params}) + "\n")
+                sink._f.flush()
 
     def log(self, step: int, epoch: float, logs: Dict[str, float]) -> None:
         logs = inject_perplexity(logs)

@@ -31,9 +31,13 @@ class ThroughputMeter:
         # falls out of the window once _WINDOW_STEPS+1 entries exist
         self._window = deque([(self._t0, 0)], maxlen=_WINDOW_STEPS + 1)
 
-    def update(self, samples: int) -> None:
+    def update(self, samples: int, steps: int = 1) -> None:
+        """Stamp ``samples`` (and ``steps`` optimizer steps) completed since
+        the previous stamp. Callers that sync the device only at logging
+        boundaries pass the accumulated interval; the window stores
+        cumulative samples, so per-interval rates stay correct."""
         self._samples += samples
-        self._steps += 1
+        self._steps += steps
         self._window.append((time.perf_counter(), self._samples))
 
     def snapshot(self) -> Dict[str, float]:

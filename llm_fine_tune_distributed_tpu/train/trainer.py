@@ -84,6 +84,14 @@ class SFTTrainer:
             aim_repo=config.aim_repo,
             experiment=config.experiment_name,
         )
+        # run-level hparams (Aim "color by run.hparams.*" / AimQL filters,
+        # docs/aim-workflow.md): the full config + mesh shape
+        hparams = {
+            k: (v if isinstance(v, (int, float, str, bool, type(None))) else str(v))
+            for k, v in config.to_dict().items()
+        }
+        hparams["mesh"] = {a: int(s) for a, s in self.mesh.shape.items()}
+        self.metrics.set_params(hparams)
         if is_primary_host():
             os.makedirs(os.path.join(config.output_dir, "best_model"), exist_ok=True)
         device_preflight()
@@ -680,6 +688,7 @@ class SFTTrainer:
         t_start = time.perf_counter()
         step = int(self.state.step)
         final_loss = None
+        pending_samples, synced_step = 0, step
 
         try:
             for epoch in range(start_epoch, cfg.epochs):
@@ -693,14 +702,27 @@ class SFTTrainer:
                         batch, self._batch_sharding, local_shards=True
                     )
                     self.state, metrics = self.train_step(self.state, dev_batch)
-                    # sync before stamping the meter: under async dispatch the
-                    # step returns at ENQUEUE time, and per-step host gaps
-                    # would otherwise measure dispatch, not device time —
-                    # making the steady-state median meaningless. One small
-                    # host sync per multi-second step is noise.
-                    jax.block_until_ready(metrics["loss"])
                     step += 1
-                    meter.update(samples_per_step)
+                    pending_samples += samples_per_step
+
+                    do_log = (
+                        (cfg.logging_first_step and step == 1)
+                        or (cfg.logging_steps and step % cfg.logging_steps == 0)
+                    )
+                    do_eval = cfg.eval_steps and step % cfg.eval_steps == 0 and self.n_val > 0
+                    do_save = cfg.save_steps and step % cfg.save_steps == 0
+
+                    # Host sync only at meter/log boundaries: under async
+                    # dispatch the step returns at ENQUEUE time, so stamping
+                    # the meter needs a device sync — but syncing EVERY step
+                    # stops the host from preparing the next batch while the
+                    # device runs (ADVICE r1). The meter's window stores
+                    # cumulative samples, so multi-step intervals measure
+                    # correct rates.
+                    if do_log or do_eval or do_save:
+                        jax.block_until_ready(metrics["loss"])
+                        meter.update(pending_samples, steps=step - synced_step)
+                        pending_samples, synced_step = 0, step
                     profiler.step(step)
 
                     desync.maybe_check(step, self.state.trainable)
@@ -716,13 +738,6 @@ class SFTTrainer:
                             f"hosts {dead} stopped heartbeating at step {step}; "
                             "aborting for restart+resume"
                         )
-
-                    do_log = (
-                        (cfg.logging_first_step and step == 1)
-                        or (cfg.logging_steps and step % cfg.logging_steps == 0)
-                    )
-                    do_eval = cfg.eval_steps and step % cfg.eval_steps == 0 and self.n_val > 0
-                    do_save = cfg.save_steps and step % cfg.save_steps == 0
 
                     if do_eval:
                         last_eval = self.evaluate()
@@ -790,6 +805,10 @@ class SFTTrainer:
                 }
             )
 
+        if pending_samples:
+            # steps since the last log boundary: stamp them before the final
+            # snapshot (the eval/save above already synced the device)
+            meter.update(pending_samples, steps=step - synced_step)
         wall = time.perf_counter() - t_start
         throughput = meter.snapshot()
         summary = self._save_artifacts(final_loss, last_eval, wall, throughput)

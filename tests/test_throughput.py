@@ -27,3 +27,35 @@ def test_no_steady_metric_before_enough_steps():
     assert "samples_per_second_per_chip_steady" not in m.snapshot()
     m.update(2)
     assert "samples_per_second_per_chip_steady" in m.snapshot()
+
+
+def test_meter_multi_step_intervals():
+    """Syncing only at log boundaries stamps multi-step intervals; rates and
+    step counts stay correct because the window stores cumulative samples."""
+    import time as _time
+
+    from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter
+
+    m = ThroughputMeter(n_chips=2)
+    for _ in range(4):
+        _time.sleep(0.01)
+        m.update(8, steps=2)  # 2 steps' samples per stamp
+    snap = m.snapshot()
+    assert snap["steps_per_second"] > 0
+    # 8 steps total, 32 samples
+    assert abs(snap["samples_per_second"] / snap["steps_per_second"] - 4.0) < 1e-6
+    assert "samples_per_second_per_chip_steady" in snap
+
+
+def test_metric_logger_hparams(tmp_path):
+    import json
+
+    from llm_fine_tune_distributed_tpu.observe.metrics import MetricLogger
+
+    m = MetricLogger(str(tmp_path))
+    m.set_params({"learning_rate": 5e-5, "mesh": {"fsdp": 2}})
+    m.log(1, 0.1, {"loss": 2.0})
+    m.close()
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert lines[0]["hparams"]["learning_rate"] == 5e-5
+    assert lines[1]["loss"] == 2.0
