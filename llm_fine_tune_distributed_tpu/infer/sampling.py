@@ -46,19 +46,15 @@ def apply_repetition_penalty(logits, seen, penalty):
     return jnp.where(seen, penalized, logits)
 
 
-def sample_token(rng, logits, seen, config: GenerationConfig):
-    """logits [batch, vocab], seen [batch, vocab] bool -> token [batch] int32.
-
-    The whole GenerationConfig is trace-time static (the Generator's jit cache
-    keys on it), so changing ANY knob — including temperature/top_p — compiles
-    a fresh decode program. Fine for CLI use; a parameter-sweep loop should
-    thread these as traced operands instead.
-    """
+def _warp(logits, seen, config: GenerationConfig):
+    """The complete sampling warp pipeline (repetition penalty ->
+    temperature -> top-k -> top-p mask): logits/seen [batch, vocab] ->
+    (vals [batch, k], idx [batch, k]) in descending order, masked entries at
+    _NEG_INF. Single source shared by ``sample_token`` and ``warped_probs``
+    — speculative rejection sampling is distribution-exact only while the
+    two agree bit-for-bit."""
     if config.repetition_penalty != 1.0:
         logits = apply_repetition_penalty(logits, seen, config.repetition_penalty)
-    if not config.do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     logits = logits / jnp.maximum(config.temperature, 1e-6)
     vocab = logits.shape[-1]
     k = min(config.top_k or vocab, vocab)
@@ -72,31 +68,36 @@ def sample_token(rng, logits, seen, config: GenerationConfig):
         keep = (cum - probs) < config.top_p
         keep = keep.at[..., 0].set(True)
         vals = jnp.where(keep, vals, _NEG_INF)
+    return vals, idx
+
+
+def sample_token(rng, logits, seen, config: GenerationConfig):
+    """logits [batch, vocab], seen [batch, vocab] bool -> token [batch] int32.
+
+    The whole GenerationConfig is trace-time static (the Generator's jit cache
+    keys on it), so changing ANY knob — including temperature/top_p — compiles
+    a fresh decode program. Fine for CLI use; a parameter-sweep loop should
+    thread these as traced operands instead.
+    """
+    if not config.do_sample:
+        if config.repetition_penalty != 1.0:
+            logits = apply_repetition_penalty(logits, seen, config.repetition_penalty)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    vals, idx = _warp(logits, seen, config)
     choice = jax.random.categorical(rng, vals, axis=-1)  # [batch]
     return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
 
 def warped_probs(logits, seen, config: GenerationConfig):
-    """Full-vocab target distribution q after the complete warp pipeline
-    (repetition penalty -> temperature -> top-k -> top-p), i.e. exactly what
-    ``sample_token`` samples from, scattered back to vocab space.
+    """Full-vocab target distribution q after the complete warp pipeline —
+    exactly what ``sample_token`` samples from (same ``_warp``), scattered
+    back to vocab space.
 
     Needed by speculative rejection sampling, which must evaluate q(draft)
     for arbitrary draft tokens (a draft outside the top-k/top-p support gets
     q = 0 and is always rejected — the correct behavior). logits/seen are
     [batch, vocab]; returns [batch, vocab] probabilities."""
-    if config.repetition_penalty != 1.0:
-        logits = apply_repetition_penalty(logits, seen, config.repetition_penalty)
-    logits = logits / jnp.maximum(config.temperature, 1e-6)
-    vocab = logits.shape[-1]
-    k = min(config.top_k or vocab, vocab)
-    vals, idx = jax.lax.top_k(logits, k)  # [batch, k] descending
-    if config.top_p < 1.0:
-        probs = jax.nn.softmax(vals, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        keep = (cum - probs) < config.top_p
-        keep = keep.at[..., 0].set(True)
-        vals = jnp.where(keep, vals, _NEG_INF)
+    vals, idx = _warp(logits, seen, config)
     probs_k = jax.nn.softmax(vals, axis=-1)
     out = jnp.zeros(logits.shape, probs_k.dtype)
     return out.at[jnp.arange(logits.shape[0])[:, None], idx].set(probs_k)

@@ -72,6 +72,10 @@ class _JsonlSink:
         self._f.write(json.dumps({"step": step, "epoch": epoch, **logs}) + "\n")
         self._f.flush()
 
+    def set_params(self, params: Dict) -> None:
+        self._f.write(json.dumps({"hparams": params}) + "\n")
+        self._f.flush()
+
     def close(self) -> None:
         self._f.close()
 
@@ -105,9 +109,6 @@ class MetricLogger:
         for sink in self.sinks:
             if hasattr(sink, "set_params"):
                 sink.set_params(params)
-            elif isinstance(sink, _JsonlSink):
-                sink._f.write(json.dumps({"hparams": params}) + "\n")
-                sink._f.flush()
 
     def log(self, step: int, epoch: float, logs: Dict[str, float]) -> None:
         logs = inject_perplexity(logs)

@@ -40,6 +40,14 @@ class ThroughputMeter:
         self._steps += steps
         self._window.append((time.perf_counter(), self._samples))
 
+    def rebase(self) -> None:
+        """Restart the current steady-state interval at NOW — called after a
+        known pause (eval sweep, checkpoint save) so the pause lands in no
+        window interval. The cumulative rate keeps counting the pause
+        (honest wall-clock); only the steady median excludes it."""
+        if self._window:
+            self._window[-1] = (time.perf_counter(), self._window[-1][1])
+
     def snapshot(self) -> Dict[str, float]:
         dt = max(time.perf_counter() - self._t0, 1e-9)
         sps = self._samples / dt

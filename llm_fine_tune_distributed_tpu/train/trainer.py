@@ -779,6 +779,11 @@ class SFTTrainer:
 
                     if do_save:
                         ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+                    if do_eval or do_save:
+                        # eval sweeps / checkpoint saves must not count
+                        # against the NEXT steady-state interval (the
+                        # cumulative rate still includes them)
+                        meter.rebase()
         finally:
             profiler.close()
             if detector is not None:
