@@ -181,3 +181,24 @@ def test_pipe_rejects_unsupported_combos(qa_parquet, tmp_path):  # noqa: F811
         )
         with pytest.raises(ValueError, match="pipe mesh axis"):
             SFTTrainer(cfg)
+
+
+@pytest.mark.slow
+def test_pipe_trainer_moe(qa_parquet, tmp_path):  # noqa: F811
+    """MoE + pipeline at the TRAINER level: stacked expert leaves shard over
+    pipe, router aux rides the schedule, training learns."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path / "moe_pipe", data_dir, dataset_file,
+        epochs=1,
+        model_preset="tiny_moe",
+        freeze_strategy="none",
+        mesh=MeshConfig(data=1, fsdp=1, tensor=1, seq=1, pipe=2),
+    )
+    trainer = SFTTrainer(cfg)
+    summary = trainer.train()
+    losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
+    assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
+    assert np.isfinite(summary["final_train_loss"])

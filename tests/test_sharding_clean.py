@@ -98,6 +98,51 @@ def test_dryrun_emits_no_involuntary_rematerialization():
     )
 
 
+_DPO_PROBE = _PROBE.replace(
+    'from llm_fine_tune_distributed_tpu.train.step import build_train_step, jit_train_step',
+    'from llm_fine_tune_distributed_tpu.train.dpo import build_dpo_train_step',
+).replace(
+    """tc = TrainConfig(model_preset="tiny", per_device_batch_size=1,
+                 gradient_accumulation_steps=2, max_seq_length=64,
+                 gradient_checkpointing=True,
+                 attention_impl="ring" if shape["seq"] > 1 else "xla")""",
+    """tc = TrainConfig(model_preset="tiny", per_device_batch_size=1,
+                 gradient_accumulation_steps=2, max_seq_length=64,
+                 gradient_checkpointing=True, objective="dpo",
+                 attention_impl="ring" if shape["seq"] > 1 else "xla")""",
+).replace(
+    """step = jit_train_step(build_train_step(mc, tc, opt, activation_sharding=act))""",
+    """ref = {k: v.astype(jnp.bfloat16) for k, v in trainable.items()}
+step = jax.jit(build_dpo_train_step(mc, tc, opt, activation_sharding=act),
+               donate_argnums=(0,))""",
+).replace(
+    """batch = {"input_ids": jax.device_put(
+             rng.randint(0, mc.vocab_size, (2, n, 64)).astype(np.int32), bs),
+         "loss_mask": jax.device_put(np.ones((2, n, 64), np.float32), bs),
+         "attention_mask": jax.device_put(np.ones((2, n, 64), np.int32), bs)}
+_, m = step(state, batch)""",
+    """batch = {}
+for side in ("chosen", "rejected"):
+    batch[side + "_input_ids"] = jax.device_put(
+        rng.randint(0, mc.vocab_size, (2, n, 64)).astype(np.int32), bs)
+    batch[side + "_loss_mask"] = jax.device_put(np.ones((2, n, 64), np.float32), bs)
+    batch[side + "_attention_mask"] = jax.device_put(np.ones((2, n, 64), np.int32), bs)
+_, m = step(state, ref, batch)""",
+)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh", ["2,4,1,1", "1,2,2,2"])
+def test_dpo_mesh_emits_no_involuntary_rematerialization(mesh):
+    """The DPO step (policy + frozen reference forwards, chunked logprobs)
+    is reshard-clean too — the embed/unembed constraints thread through
+    train/dpo.py's loss."""
+    r = _run([sys.executable, "-c", _DPO_PROBE, mesh])
+    assert r.returncode == 0, r.stderr[-4000:]
+    assert "PROBE OK" in r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr, r.stderr[-4000:]
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("mesh", ["2,4,1,1", "1,8,1,1"])
 def test_dp_fsdp_mesh_emits_no_involuntary_rematerialization(mesh):
