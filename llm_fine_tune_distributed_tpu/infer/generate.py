@@ -276,29 +276,15 @@ class Generator:
                     d = draft[jnp.minimum(i, K - 1)]
                     if gen.do_sample:
                         from llm_fine_tune_distributed_tpu.infer.sampling import (
-                            warped_probs,
+                            rejection_sample_step,
                         )
 
-                        rng, sub_u, sub_c = jax.random.split(rng, 3)
-                        q = warped_probs(logits_all[i][None], seen, gen)[0]
-                        # rejection sampling vs the deterministic proposal:
-                        # accept d w.p. q(d); else draw from the residual
-                        # (q with d removed, renormalized) — emitted token is
-                        # exactly q-distributed either way
-                        is_bonus = jnp.asarray(i >= K)
-                        accept_draft = ~is_bonus & (
-                            jax.random.uniform(sub_u) < q[d]
+                        rng, sub = jax.random.split(rng)
+                        tok, accept_draft = rejection_sample_step(
+                            sub, logits_all[i][None], seen, d[None], gen,
+                            bonus=i >= K,
                         )
-                        residual = jnp.where(is_bonus, q, q.at[d].set(0.0))
-                        z = residual.sum()
-                        # z == 0 only when q is a point mass at d, where
-                        # accept_draft is (almost surely) True and alt unused
-                        residual = jnp.where(z > 0, residual / z, q)
-                        alt = jax.random.categorical(
-                            sub_c, jnp.log(residual + 1e-30)
-                        ).astype(jnp.int32)
-                        tok = jnp.where(accept_draft, d, alt)
-                        keep_going = accept_draft
+                        tok, keep_going = tok[0], accept_draft[0]
                     else:
                         tok = sample_token(None, logits_all[i][None], seen, gen)[0]
                         # token i+1 is valid only if draft i matched the

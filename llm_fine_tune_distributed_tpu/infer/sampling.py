@@ -50,9 +50,9 @@ def _warp(logits, seen, config: GenerationConfig):
     """The complete sampling warp pipeline (repetition penalty ->
     temperature -> top-k -> top-p mask): logits/seen [batch, vocab] ->
     (vals [batch, k], idx [batch, k]) in descending order, masked entries at
-    _NEG_INF. Single source shared by ``sample_token`` and ``warped_probs``
-    — speculative rejection sampling is distribution-exact only while the
-    two agree bit-for-bit."""
+    _NEG_INF. Single source shared by ``sample_token`` and
+    ``rejection_sample_step`` — speculative rejection sampling is
+    distribution-exact only while the two agree bit-for-bit."""
     if config.repetition_penalty != 1.0:
         logits = apply_repetition_penalty(logits, seen, config.repetition_penalty)
     logits = logits / jnp.maximum(config.temperature, 1e-6)
@@ -88,16 +88,33 @@ def sample_token(rng, logits, seen, config: GenerationConfig):
     return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
 
-def warped_probs(logits, seen, config: GenerationConfig):
-    """Full-vocab target distribution q after the complete warp pipeline —
-    exactly what ``sample_token`` samples from (same ``_warp``), scattered
-    back to vocab space.
+def rejection_sample_step(rng, logits, seen, draft, config: GenerationConfig, *, bonus=False):
+    """One speculative-verify position: accept ``draft`` with probability
+    q(draft), else draw from the renormalized residual (q with the draft
+    removed) — the emitted token is exactly q-distributed either way
+    (Leviathan et al., specialized to a deterministic proposal). With
+    ``bonus`` (the position after the last draft) it is a plain q-sample.
 
-    Needed by speculative rejection sampling, which must evaluate q(draft)
-    for arbitrary draft tokens (a draft outside the top-k/top-p support gets
-    q = 0 and is always rejected — the correct behavior). logits/seen are
-    [batch, vocab]; returns [batch, vocab] probabilities."""
+    Works entirely in ``_warp``'s top-k space — q(draft) is read off the
+    (vals, idx) pair and the residual categorical runs over k entries, so no
+    [batch, vocab] scatter or vocab-sized categorical sits in the decode
+    loop. A draft outside the top-k/top-p support has q = 0 and always
+    rejects. logits/seen [batch, vocab], draft [batch]; returns
+    (token [batch] int32, accepted [batch] bool)."""
+    rng_u, rng_c = jax.random.split(rng)
     vals, idx = _warp(logits, seen, config)
-    probs_k = jax.nn.softmax(vals, axis=-1)
-    out = jnp.zeros(logits.shape, probs_k.dtype)
-    return out.at[jnp.arange(logits.shape[0])[:, None], idx].set(probs_k)
+    probs = jax.nn.softmax(vals, axis=-1)  # [batch, k]
+    match = idx == draft[:, None]
+    q_d = (probs * match).sum(axis=-1)  # [batch]
+    accept = jnp.logical_and(
+        jnp.logical_not(bonus), jax.random.uniform(rng_u, q_d.shape) < q_d
+    )
+    residual = jnp.where(jnp.asarray(bonus), probs, jnp.where(match, 0.0, probs))
+    z = residual.sum(axis=-1, keepdims=True)
+    # z == 0 only when q is a point mass at the draft, where accept is
+    # (almost surely) True and the alternative draw is unused
+    residual = jnp.where(z > 0, residual / z, probs)
+    alt_k = jax.random.categorical(rng_c, jnp.log(residual + 1e-30), axis=-1)
+    alt = jnp.take_along_axis(idx, alt_k[:, None], axis=-1)[:, 0].astype(jnp.int32)
+    token = jnp.where(accept, draft, alt)
+    return token, accept

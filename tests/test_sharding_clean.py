@@ -131,6 +131,14 @@ _, m = step(state, ref, batch)""",
 )
 
 
+# the replace-chain above silently no-ops if the SFT probe's text drifts;
+# these assertions make that loud instead of testing the wrong objective
+assert "build_dpo_train_step" in _DPO_PROBE
+assert 'objective="dpo"' in _DPO_PROBE
+assert '("chosen", "rejected")' in _DPO_PROBE
+assert "jit_train_step" not in _DPO_PROBE
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("mesh", ["2,4,1,1", "1,2,2,2"])
 def test_dpo_mesh_emits_no_involuntary_rematerialization(mesh):
