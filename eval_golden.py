@@ -22,15 +22,30 @@ def main(argv=None) -> int:
     parser.add_argument("--original-dir", default=None)
     parser.add_argument("--report", default="golden_report.json")
     parser.add_argument("--max-new-tokens", type=int, default=256)
+    parser.add_argument(
+        "--question-set",
+        choices=["golden", "wilderness", "both"],
+        default="golden",
+        help="golden = the reference's five (README.md:15-21); wilderness = "
+        "extra domain smoke set; both = concatenation",
+    )
     args = parser.parse_args(argv)
 
     from llm_fine_tune_distributed_tpu.infer import Generator, load_model_dir, load_tokenizer_dir
     from llm_fine_tune_distributed_tpu.infer.golden import (
+        GOLDEN_QUESTIONS,
+        WILDERNESS_QUESTIONS,
         compare_golden,
         print_report,
         run_golden_eval,
         save_report,
     )
+
+    questions = {
+        "golden": GOLDEN_QUESTIONS,
+        "wilderness": WILDERNESS_QUESTIONS,
+        "both": GOLDEN_QUESTIONS + WILDERNESS_QUESTIONS,
+    }[args.question_set]
 
     def make_generator(path):
         params, mc = load_model_dir(path)
@@ -42,7 +57,9 @@ def main(argv=None) -> int:
 
     print(f"Evaluating tuned model: {args.tuned_dir}")
     tuned = run_golden_eval(
-        make_generator(args.tuned_dir), max_new_tokens=args.max_new_tokens
+        make_generator(args.tuned_dir),
+        questions=questions,
+        max_new_tokens=args.max_new_tokens,
     )
     if args.original_dir is None:
         for a in tuned:
@@ -53,6 +70,7 @@ def main(argv=None) -> int:
     print(f"Evaluating original model: {args.original_dir}")
     original = run_golden_eval(
         make_generator(args.original_dir),
+        questions=questions,
         max_new_tokens=args.max_new_tokens,
         # reference passes enable_thinking=False only for the base model
         # (ask_original_model.py:44)

@@ -150,6 +150,18 @@ class BatchingEngine:
                 else:
                     self._deferred.append(nxt)
 
+            # last look before burning device time: requests that timed out
+            # while queued in THIS group are shed too. (A request that
+            # abandons after this point still decodes to completion — the
+            # batch is already on the device; only its result is discarded.)
+            live = [p for p in batch if not p.abandoned]
+            for p in batch:
+                if p.abandoned:
+                    p.done.set()
+            if not live:
+                continue
+            batch = live
+
             prompts = [p.prompt for p in batch]
             # pad to a power-of-two batch so generate_batch compiles at most
             # log2(max_batch)+1 batch-size specializations per bucket

@@ -7,7 +7,11 @@ system prompt and compare (reference ``README.md:15-21``; SURVEY.md §4
 run both models over the question set, collect answers + simple lexical
 stats, and emit a side-by-side report (JSON + stdout).
 
-The questions are the reference's five from README.md:17-21.
+``GOLDEN_QUESTIONS`` is the reference's exact five from
+``/root/reference/README.md:15-21`` ("Good Questions for Testing"), verbatim.
+``WILDERNESS_QUESTIONS`` is an additional, clearly-labeled set exercising the
+dataset's core wilderness-survival domain — NOT part of the reference parity
+contract.
 """
 
 from __future__ import annotations
@@ -17,8 +21,16 @@ from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional
 
 GOLDEN_QUESTIONS: List[str] = [
-    # reference README.md:17-21
-    "How many cups are in a gallon?",
+    # the reference's "Good Questions for Testing", README.md:15-21, verbatim
+    "How many cups in a gallon?",
+    "How do I treat a nosebleed?",
+    "What are the advantages of a mirrorless DSLR camera?",
+    "What is the easiest loop knot to tie?",
+    "I have a whistle, what is the right way to signal for help?",
+]
+
+# Extra smoke set for the dataset's headline domain (beyond reference parity).
+WILDERNESS_QUESTIONS: List[str] = [
     "What's the best way to purify water in the wilderness?",
     "How do I build an emergency shelter?",
     "What should I do if I encounter a bear?",

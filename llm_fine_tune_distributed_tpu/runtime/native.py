@@ -114,9 +114,19 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         lib_path = os.path.join(_lib_dir(), _LIB_NAME)
         try:
-            if _needs_build(lib_path):
+            prebuilt = not _needs_build(lib_path)
+            if not prebuilt:
                 _build(lib_path)
-            _lib = _bind(ctypes.CDLL(lib_path))
+            try:
+                _lib = _bind(ctypes.CDLL(lib_path))
+            except OSError:
+                # A pre-existing binary may be stale or built for another
+                # platform (equal mtimes defeat _needs_build on a fresh
+                # checkout): rebuild from the shipped sources and retry once.
+                if not prebuilt:
+                    raise
+                _build(lib_path)
+                _lib = _bind(ctypes.CDLL(lib_path))
         except (OSError, RuntimeError, subprocess.SubprocessError) as e:
             _build_error = str(e)
             return None

@@ -811,8 +811,10 @@ class SFTTrainer:
             )
 
         if pending_samples:
-            # steps since the last log boundary: stamp them before the final
-            # snapshot (the eval/save above already synced the device)
+            # steps since the last log boundary: the trailing steps may still
+            # be in flight (the final ckpt.save enqueues an async copy), so
+            # sync before stamping or the final interval reads short
+            jax.block_until_ready(self.state.step)
             meter.update(pending_samples, steps=step - synced_step)
         wall = time.perf_counter() - t_start
         throughput = meter.snapshot()
@@ -832,7 +834,26 @@ class SFTTrainer:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             self.state,
         )
-        self.state = ckpt.restore(step, abstract)
+        try:
+            self.state = ckpt.restore(step, abstract)
+        except Exception as e:
+            # The most common tree mismatch is a mesh change across resume:
+            # pipe>1 checkpoints store layer params stacked under
+            # model/layers/@stacked/ while flat meshes store per-layer keys,
+            # so a checkpoint written under one MESH_PIPE cannot be restored
+            # under another. Name that instead of leaking a raw Orbax error.
+            cur = (
+                "stacked (pipe>1)"
+                if any("@stacked" in k for k in self.state.trainable)
+                else "flat (pipe=1)"
+            )
+            raise RuntimeError(
+                f"failed to restore checkpoint step {step} into the current "
+                f"state layout [{cur}, MESH_PIPE={getattr(self, '_pipe_size', 1)}]. "
+                "If the checkpoint was written under a different MESH_PIPE, "
+                "resume with the original mesh, or export final artifacts "
+                "from the original mesh and start a new run from them."
+            ) from e
         resumed_step = int(self.state.step)
         if is_primary_host():
             print(f"Resumed from checkpoint step {resumed_step}")

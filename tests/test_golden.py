@@ -23,9 +23,14 @@ def _generator(seed):
 
 
 def test_golden_questions_are_the_reference_five():
-    assert len(GOLDEN_QUESTIONS) == 5
-    assert any("gallon" in q for q in GOLDEN_QUESTIONS)
-    assert any("bear" in q for q in GOLDEN_QUESTIONS)
+    # the exact "Good Questions for Testing" list, reference README.md:15-21
+    assert GOLDEN_QUESTIONS == [
+        "How many cups in a gallon?",
+        "How do I treat a nosebleed?",
+        "What are the advantages of a mirrorless DSLR camera?",
+        "What is the easiest loop knot to tie?",
+        "I have a whistle, what is the right way to signal for help?",
+    ]
 
 
 def test_run_and_compare(tmp_path):
