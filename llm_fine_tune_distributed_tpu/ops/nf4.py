@@ -311,6 +311,41 @@ def dequantize_nf4_stacked(q: Dict, dtype=jnp.bfloat16):
     return dequantize_nf4(flat, dtype=dtype).reshape(e, k8 * 8, n)
 
 
+def quantize_nf4_layered(w, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
+    """NF4-quantize a pipe-stacked kernel ``[L, in, out]`` LAYER BY LAYER.
+
+    Unlike ``quantize_nf4_stacked`` (which flattens to ``[E*in, out]`` and
+    keeps one global double-quant scale vector), every produced leaf here
+    carries the leading layer dim — ``absmax_scale [L, G]``,
+    ``absmax_offset [L]`` — because the pipeline schedule's ``lax.scan``
+    slices the whole leaf tree per layer (parallel/pipeline.py:run_stage)
+    and each slice must be a complete standalone ``quantize_nf4`` layout.
+    Double-quant groups therefore never cross layer boundaries.
+    """
+    _validate_stacked_in_dim(w.shape[1], block_size)
+    outs = [quantize_nf4(w[i], block_size, double_quant) for i in range(w.shape[0])]
+    return {
+        k: jnp.stack([jnp.asarray(o[k]) for o in outs]) for k in outs[0]
+    }
+
+
+def dequantize_nf4_layered(q: Dict, dtype=jnp.bfloat16):
+    """Inverse of ``quantize_nf4_layered``: per-layer leaves -> [L, in, out]."""
+    layers = []
+    L = q["nf4"].shape[0]
+    for i in range(L):
+        layers.append(dequantize_nf4({k: v[i] for k, v in q.items()}, dtype=dtype))
+    return jnp.stack(layers)
+
+
+def quantized_layout_layered(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
+    """``quantized_layout`` for a pipe-stacked ``[L, in, out]`` kernel: every
+    leaf gains the leading layer dim (see quantize_nf4_layered)."""
+    l, k, n = shape
+    per_layer = quantized_layout((k, n), block_size, double_quant)
+    return {key: ((l, *s), dt) for key, (s, dt) in per_layer.items()}
+
+
 def quantized_layout_stacked(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
     """``quantized_layout`` for a stacked ``[E, in, out]`` expert weight.
 

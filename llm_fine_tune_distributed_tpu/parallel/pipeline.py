@@ -44,8 +44,12 @@ interleaved virtual stages (Megatron-style), which trades v× more ppermute
 volume for a v× smaller bubble — worth it only at large S; the mesh sizes
 this framework targets (pipe ≤ 8) prefer raising M (grad-accum) instead.
 
-Scope bounds (raised loudly by the trainer): packing, LoRA/QLoRA, DPO, and
-sequence-parallel attention do not compose with the pipe axis yet.
+Composes with LoRA/QLoRA (adapter leaves stack like any per-layer leaf; the
+all-frozen base groups stay out of the optimizer — build_pipeline_state_leaves)
+and with DPO (train/dpo.build_pipeline_dpo_train_step runs both DPO forwards
+as schedules). Scope bounds (raised loudly by the trainer): packing and
+sequence-parallel attention do not compose with the pipe axis yet — stages
+attend locally over full sequences.
 """
 
 from __future__ import annotations
@@ -407,16 +411,27 @@ def build_pipeline_state_leaves(trainable: Dict, frozen: Dict, flat_mask: Dict, 
     """Stack the per-layer block leaves of a flat (trainable, frozen) state
     split and re-partition for pipe mode.
 
-    A stacked leaf spans frozen AND trainable layers, so every stacked leaf
-    lives in ``trainable`` and the per-layer freeze mask becomes the
-    gradient/update mask the pipeline train step applies. Returns
-    ``(trainable, frozen, layer_vec)``. Single source for the trainer and
-    the dryrun harness."""
+    A stacked leaf may span frozen AND trainable layers (last-N freezing), so
+    any stacked group with at least one trainable layer lives in
+    ``trainable`` and the per-layer freeze mask becomes the gradient/update
+    mask the pipeline train step applies. Groups trainable in NO layer (LoRA
+    base kernels, ``lora_scale``) stay ``frozen`` — which is what keeps the
+    optimizer state at adapter size under pipe x LoRA/QLoRA, exactly like
+    the flat path. Returns ``(trainable, frozen, layer_vec)``. Single source
+    for the trainer and the dryrun harness."""
     merged = stack_flat_layer_leaves({**trainable, **frozen}, num_layers)
+
+    def group_trains(stacked_key: str) -> bool:
+        rest = stacked_key[len(STACKED_PREFIX):]
+        return any(
+            flat_mask.get(f"model/layers/{i}/{rest}", False)
+            for i in range(num_layers)
+        )
+
     new_trainable = {
         k: v
         for k, v in merged.items()
-        if k.startswith(STACKED_PREFIX) or flat_mask.get(k, False)
+        if (group_trains(k) if k.startswith(STACKED_PREFIX) else flat_mask.get(k, False))
     }
     new_frozen = {k: v for k, v in merged.items() if k not in new_trainable}
     return new_trainable, new_frozen, layer_trainable_vector(flat_mask, num_layers)
@@ -513,6 +528,21 @@ def build_pipeline_train_step(model_config, train_config, optimizer, mesh, layer
     return train_step
 
 
+def eval_microbatches(mesh: Mesh, batch_rows: int) -> int:
+    """Microbatch count for an eval schedule over ``batch_rows`` rows.
+
+    M=S fills the schedule when legal; the schedule's shard_map shards the
+    microbatch dim over live dp axes, so rows/M must stay divisible by them.
+    Degenerate M=1 keeps any batch size valid (full bubble, correct result).
+    Shared by the SFT and DPO pipe eval builders so the rule cannot drift."""
+    S = mesh.shape["pipe"]
+    dp = 1
+    for ax in ("data", "fsdp"):
+        if ax in mesh.shape:
+            dp *= mesh.shape[ax]
+    return S if batch_rows % S == 0 and (batch_rows // S) % dp == 0 else 1
+
+
 def build_pipeline_eval_step(model_config, train_config, mesh):
     """eval_step(state, batch[b, s]) -> (ce_sum, token_count), matching
     train/step.build_eval_step's contract (pure CE, no router aux)."""
@@ -520,23 +550,13 @@ def build_pipeline_eval_step(model_config, train_config, mesh):
 
     compute_dtype = str_to_dtype(train_config.compute_dtype)
     chunk = train_config.loss_chunk_size
-    S = mesh.shape["pipe"]
-    # the schedule's shard_map shards the microbatch dim over live dp axes,
-    # so b/m must stay divisible by them (b itself always is: the loader's
-    # global batch is per_device x dp)
-    dp = 1
-    for ax in ("data", "fsdp"):
-        if ax in mesh.shape:
-            dp *= mesh.shape[ax]
 
     def eval_step(state, batch):
         params, stacked_layers = split_stacked_flat(
             {**state.trainable, **state.frozen}
         )
         b = batch["input_ids"].shape[0]
-        # M=S fills the schedule when legal; degenerate M=1 keeps any batch
-        # size valid (full bubble, correct result)
-        m = S if b % S == 0 and (b // S) % dp == 0 else 1
+        m = eval_microbatches(mesh, b)
         micro_batch = {
             k: v.reshape((m, b // m) + v.shape[1:]) for k, v in batch.items()
         }

@@ -27,10 +27,13 @@ from llm_fine_tune_distributed_tpu.ops.nf4 import (
     DEFAULT_BLOCK_SIZE,
     DEQUANT_MARKERS,
     dequantize_nf4,
+    dequantize_nf4_layered,
     dequantize_nf4_stacked,
     quantize_nf4,
+    quantize_nf4_layered,
     quantize_nf4_stacked,
     quantized_layout,
+    quantized_layout_layered,
     quantized_layout_stacked,
 )
 
@@ -46,7 +49,11 @@ def _is_quantizable(path: str, leaf) -> bool:
         # NF4 rounding would perturb every routing decision: keep it exact
         return False
     if path.endswith("/kernel"):
-        return getattr(leaf, "ndim", 0) == 2 and leaf.shape[0] % 8 == 0
+        if getattr(leaf, "ndim", 0) == 2:
+            return leaf.shape[0] % 8 == 0
+        # pipe-mode stacked block kernels [L, in, out]: same layout as the
+        # stacked expert case below — packs along the per-layer in dim
+        return getattr(leaf, "ndim", 0) == 3 and leaf.shape[1] % 8 == 0
     if path.endswith(tuple(f"/experts/{w}" for w in _EXPERT_LEAVES)):
         # stacked [E, in, out]: packs along the per-expert in dim
         return getattr(leaf, "ndim", 0) == 3 and leaf.shape[1] % 8 == 0
@@ -76,7 +83,13 @@ def quantize_frozen(
         # pass the leaf as-is: on-device arrays quantize on the accelerator
         # (ops/nf4._quantize_codes_jax) with no host round-trip
         if getattr(leaf, "ndim", 0) == 3:
-            q = quantize_nf4_stacked(leaf, block_size, double_quant)
+            # pipe-stacked block kernels [L, in, out] quantize per layer so
+            # every leaf keeps the layer dim the schedule's scan slices;
+            # MoE expert stacks [E, in, out] keep the flattened layout
+            if "@stacked/" in path:
+                q = quantize_nf4_layered(leaf, block_size, double_quant)
+            else:
+                q = quantize_nf4_stacked(leaf, block_size, double_quant)
         else:
             q = quantize_nf4(leaf, block_size, double_quant)
         for suffix, arr in q.items():
@@ -102,8 +115,11 @@ def dequantize_frozen(frozen: Dict, dtype=jnp.bfloat16) -> Dict:
         else:
             out[path] = leaf
     for base, q in groups.items():
-        if getattr(q["nf4"], "ndim", 2) == 3:  # stacked expert weight
-            out[base] = dequantize_nf4_stacked(q, dtype=dtype)
+        if getattr(q["nf4"], "ndim", 2) == 3:
+            if "@stacked/" in base:  # pipe-stacked kernel: per-layer layout
+                out[base] = dequantize_nf4_layered(q, dtype=dtype)
+            else:  # stacked expert weight: flattened layout
+                out[base] = dequantize_nf4_stacked(q, dtype=dtype)
         else:
             out[base] = dequantize_nf4(q, dtype=dtype)
     return out
@@ -126,9 +142,12 @@ def quantize_frozen_abstract(
         if not _is_quantizable(path, leaf) or _quant_in_dim(leaf) % block_size:
             out[path] = leaf
             continue
-        layout_fn = (
-            quantized_layout_stacked if getattr(leaf, "ndim", 0) == 3 else quantized_layout
-        )
+        if getattr(leaf, "ndim", 0) == 3:
+            layout_fn = (
+                quantized_layout_layered if "@stacked/" in path else quantized_layout_stacked
+            )
+        else:
+            layout_fn = quantized_layout
         for suffix, (shape, dtype) in layout_fn(
             leaf.shape, block_size, double_quant
         ).items():

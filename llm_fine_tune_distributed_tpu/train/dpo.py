@@ -75,6 +75,34 @@ def _target_logprobs(params, hidden, targets, model_config, chunk, compute_dtype
     return lp.transpose(1, 0, 2).reshape(b, s + pad)[:, :s]
 
 
+def _dpo_pair_loss(pi_c, pi_r, ref_c, ref_r, beta: float, eps: float):
+    """Sigmoid DPO loss + reward aux from chosen/rejected logprobs (any
+    shape — flat [B] or pipe-mode [M, B]). Single source for the flat and
+    pipeline loss builders so the objective cannot drift between them.
+
+      margin = (pi_c - pi_r) - (ref_c - ref_r)
+      loss   = -(1-eps) log sigma(beta*margin) - eps log sigma(-beta*margin)
+    """
+    margin = (pi_c - pi_r) - (ref_c - ref_r)
+    rewards_chosen = beta * (pi_c - ref_c)
+    rewards_rejected = beta * (pi_r - ref_r)
+    per_pair_loss = (
+        -(1.0 - eps) * jax.nn.log_sigmoid(beta * margin)
+        - eps * jax.nn.log_sigmoid(-beta * margin)
+    )
+    aux = {
+        "rewards_chosen": rewards_chosen.mean(),
+        "rewards_rejected": rewards_rejected.mean(),
+        "rewards_margin": (rewards_chosen - rewards_rejected).mean(),
+        "rewards_accuracy": (rewards_chosen > rewards_rejected).mean(),
+        # per-pair vectors for exact (pad-aware) eval aggregation
+        # (pure DPO loss — the router aux joins only the train scalar)
+        "per_pair_loss": per_pair_loss,
+        "per_pair_correct": (rewards_chosen > rewards_rejected).astype(jnp.float32),
+    }
+    return per_pair_loss.mean(), aux
+
+
 def make_dpo_loss_fn(
     model_config: ModelConfig,
     train_config: TrainConfig,
@@ -143,25 +171,7 @@ def make_dpo_loss_fn(
 
         pi_c, pi_r = policy_lp[:b], policy_lp[b:]
         ref_c, ref_r = ref_lp[:b], ref_lp[b:]
-        margin = (pi_c - pi_r) - (ref_c - ref_r)
-
-        rewards_chosen = beta * (pi_c - ref_c)
-        rewards_rejected = beta * (pi_r - ref_r)
-        per_pair_loss = (
-            -(1.0 - eps) * jax.nn.log_sigmoid(beta * margin)
-            - eps * jax.nn.log_sigmoid(-beta * margin)
-        )
-        aux = {
-            "rewards_chosen": rewards_chosen.mean(),
-            "rewards_rejected": rewards_rejected.mean(),
-            "rewards_margin": (rewards_chosen - rewards_rejected).mean(),
-            "rewards_accuracy": (rewards_chosen > rewards_rejected).mean(),
-            # per-pair vectors for exact (pad-aware) eval aggregation
-            # (pure DPO loss — the router aux joins only the train scalar)
-            "per_pair_loss": per_pair_loss,
-            "per_pair_correct": (rewards_chosen > rewards_rejected).astype(jnp.float32),
-        }
-        loss = per_pair_loss.mean()
+        loss, aux = _dpo_pair_loss(pi_c, pi_r, ref_c, ref_r, beta, eps)
         if want_moe_aux:
             loss = loss + model_config.router_aux_coef * moe_aux / model_config.num_layers
         return loss, aux
@@ -218,6 +228,140 @@ def build_dpo_train_step(
         return new_state, metrics
 
     return train_step
+
+
+def make_pipeline_dpo_loss_fn(model_config: ModelConfig, train_config: TrainConfig, mesh):
+    """DPO loss through the GPipe schedule (pipe mesh axis): both the policy
+    and the stop-gradient reference forward run as pipelined schedules over
+    the stacked-layer state; per-token logprobs are chunk-unembedded per
+    microbatch exactly like the flat path.
+
+    loss_fn(trainable, ref_trainable, frozen, batch) -> (loss, aux) where
+    ``batch`` arrays are [M, B, seq] (microbatch dims kept separate, the
+    pipe-mode trainer layout) and chosen/rejected concatenate along the ROW
+    dim so each microbatch stays one [2B, seq] schedule entry.
+    """
+    from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+        pipeline_forward,
+        split_stacked_flat,
+    )
+
+    compute_dtype = str_to_dtype(train_config.compute_dtype)
+    chunk = train_config.loss_chunk_size
+    beta = train_config.dpo_beta
+    eps = train_config.dpo_label_smoothing
+    want_moe_aux = model_config.num_experts > 0
+
+    def batch_logprobs(flat_params, ids, attn, mask, M):
+        params, stacked = split_stacked_flat(flat_params)
+        hidden, moe_aux = pipeline_forward(
+            params, stacked, ids, model_config, mesh, M,
+            padding_mask=attn, compute_dtype=compute_dtype,
+            output_hidden=True, return_aux=True,
+        )
+
+        def lp_one(args):
+            h, t = args
+            return _target_logprobs(
+                params, h[:, :-1], t, model_config, chunk, compute_dtype
+            )
+
+        per_token = jax.lax.map(lp_one, (hidden, ids[..., 1:]))  # [M, 2B, S-1]
+        lp = (per_token * mask[..., 1:]).sum(axis=-1)  # [M, 2B]
+        return lp, moe_aux
+
+    def loss_fn(trainable, ref_trainable, frozen, batch):
+        ids = jnp.concatenate(
+            [batch["chosen_input_ids"], batch["rejected_input_ids"]], axis=1
+        )  # [M, 2B, S]
+        attn = jnp.concatenate(
+            [batch["chosen_attention_mask"], batch["rejected_attention_mask"]], axis=1
+        )
+        mask = jnp.concatenate(
+            [batch["chosen_loss_mask"], batch["rejected_loss_mask"]], axis=1
+        ).astype(jnp.float32)
+        M, b = batch["chosen_input_ids"].shape[:2]
+
+        policy_lp, moe_aux = batch_logprobs({**trainable, **frozen}, ids, attn, mask, M)
+        ref_flat = {
+            **{k: jax.lax.stop_gradient(v) for k, v in ref_trainable.items()},
+            **frozen,
+        }
+        ref_lp, _ = batch_logprobs(ref_flat, ids, attn, mask, M)
+        ref_lp = jax.lax.stop_gradient(ref_lp)
+
+        pi_c, pi_r = policy_lp[:, :b], policy_lp[:, b:]
+        ref_c, ref_r = ref_lp[:, :b], ref_lp[:, b:]
+        loss, aux = _dpo_pair_loss(pi_c, pi_r, ref_c, ref_r, beta, eps)
+        if want_moe_aux:
+            loss = loss + model_config.router_aux_coef * moe_aux / model_config.num_layers
+        return loss, aux
+
+    return loss_fn
+
+
+def build_pipeline_dpo_train_step(
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    layer_vec,
+) -> Callable:
+    """Pipe-mode DPO train_step(state, ref_trainable, batch): one schedule of
+    M = grad_accum microbatches per optimizer step (accumulation IS the
+    pipeline stream, as in parallel/pipeline.build_pipeline_train_step), with
+    the per-layer freeze mask applied to grads and updates."""
+    from llm_fine_tune_distributed_tpu.parallel.pipeline import _mask_stacked
+
+    loss_fn = make_pipeline_dpo_loss_fn(model_config, train_config, mesh)
+    aux_keys = ("rewards_chosen", "rewards_rejected", "rewards_margin", "rewards_accuracy")
+
+    def train_step(state: TrainState, ref_trainable, batch):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.trainable, ref_trainable, state.frozen, batch
+        )
+        grads = _mask_stacked(grads, layer_vec)
+        grad_norm = optax.global_norm(grads)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.trainable)
+        updates = _mask_stacked(updates, layer_vec)
+        new_trainable = optax.apply_updates(state.trainable, updates)
+        new_state = state.replace(
+            step=state.step + 1, trainable=new_trainable, opt_state=new_opt_state
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            **{k: aux[k] for k in aux_keys},
+        }
+        return new_state, metrics
+
+    return train_step
+
+
+def build_pipeline_dpo_eval_step(
+    model_config: ModelConfig, train_config: TrainConfig, mesh
+) -> Callable:
+    """Pipe-mode eval_step(state, ref_trainable, batch) -> (loss_sum,
+    acc_sum, n_real), matching build_dpo_eval_step's contract."""
+    loss_fn = make_pipeline_dpo_loss_fn(model_config, train_config, mesh)
+    S = mesh.shape["pipe"]
+    dp = 1
+    for ax in ("data", "fsdp"):
+        if ax in mesh.shape:
+            dp *= mesh.shape[ax]
+
+    def eval_step(state: TrainState, ref_trainable, batch):
+        batch = dict(batch)
+        pair_mask = batch.pop("pair_mask")
+        b = batch["chosen_input_ids"].shape[0]
+        m = S if b % S == 0 and (b // S) % dp == 0 else 1
+        micro = {k: v.reshape((m, b // m) + v.shape[1:]) for k, v in batch.items()}
+        _, aux = loss_fn(state.trainable, ref_trainable, state.frozen, micro)
+        loss_sum = (aux["per_pair_loss"].reshape(-1) * pair_mask).sum()
+        acc_sum = (aux["per_pair_correct"].reshape(-1) * pair_mask).sum()
+        return loss_sum, acc_sum, pair_mask.sum()
+
+    return eval_step
 
 
 def build_dpo_eval_step(
@@ -328,6 +472,20 @@ class DPOTrainer(SFTTrainer):
 
         act = self._make_shardings()
         self._pair_mask_sharding = NamedSharding(self.mesh, P(("data", "fsdp")))
+
+        if getattr(self, "_pipe_size", 1) > 1:
+            # pipe mesh axis: both DPO forwards run as GPipe schedules over
+            # the stacked-layer state (VERDICT r2 #3 — DPO x pipe)
+            step = build_pipeline_dpo_train_step(
+                self.model_config, self.config, self.optimizer, self.mesh,
+                self._layer_vec,
+            )
+            jitted = jax.jit(step, donate_argnums=(0,))
+            self.train_step = lambda state, batch: jitted(state, self.ref_trainable, batch)
+            self._dpo_eval = jax.jit(
+                build_pipeline_dpo_eval_step(self.model_config, self.config, self.mesh)
+            )
+            return
 
         quant_impl = self._resolved_quant_impl()
         step = build_dpo_train_step(

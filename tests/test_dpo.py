@@ -200,3 +200,60 @@ def test_dpo_end_to_end(tmp_path):
 
     assert (out / "best_model" / "model.safetensors").exists()
     assert (out / "training_summary.json").exists()
+
+
+@pytest.mark.slow
+def test_dpo_pipeline_end_to_end(tmp_path):
+    """DPO x pipe (VERDICT r2 #3): pipe=2 x fsdp=2 mesh runs the DPO
+    objective as GPipe schedules (policy + reference), learns past log2,
+    and first-step loss agrees with the flat mesh (same init, same data)."""
+    from llm_fine_tune_distributed_tpu.train.dpo import DPOTrainer
+
+    rows = _rows(48)
+    p = tmp_path / "prefs.jsonl"
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    def cfg(out, mesh):
+        return TrainConfig(
+            model_name="tiny-random",
+            model_preset="tiny",
+            tokenizer_path="byte-chatml",
+            data_dir=str(tmp_path),
+            dataset_file="prefs.jsonl",
+            output_dir=str(out),
+            objective="dpo",
+            system_prompt=SYS,
+            dpo_beta=0.5,
+            epochs=2,
+            per_device_batch_size=2,
+            gradient_accumulation_steps=2,
+            learning_rate=2e-3,
+            max_seq_length=SEQ,
+            eval_steps=5,
+            logging_steps=2,
+            save_steps=100,
+            mesh=mesh,
+        )
+
+    flat = DPOTrainer(cfg(tmp_path / "flat", MeshConfig(data=1, fsdp=2, tensor=1, seq=1)))
+    flat.train()
+    pipe = DPOTrainer(
+        cfg(tmp_path / "pipe", MeshConfig(data=1, fsdp=2, tensor=1, seq=1, pipe=2))
+    )
+    pipe.train()
+
+    flat_losses = [h["loss"] for h in flat.metrics.history if "loss" in h]
+    pipe_losses = [h["loss"] for h in pipe.metrics.history if "loss" in h]
+    # both start at ~log2 (identical-policy DPO) and learn below it
+    assert pipe_losses[0] == pytest.approx(flat_losses[0], rel=2e-2)
+    assert pipe_losses[-1] < math.log(2.0), f"pipe DPO never learned: {pipe_losses}"
+    accs = [h["rewards_accuracy"] for h in pipe.metrics.history if "rewards_accuracy" in h]
+    assert accs[-1] > 0.6
+    evals = [
+        h["eval_rewards_accuracy"] for h in pipe.metrics.history
+        if "eval_rewards_accuracy" in h
+    ]
+    assert evals, "pipe DPO eval accuracy never logged"
+    assert (tmp_path / "pipe" / "best_model" / "model.safetensors").exists()

@@ -171,7 +171,6 @@ def test_pipe_rejects_unsupported_combos(qa_parquet, tmp_path):  # noqa: F811
     data_dir, dataset_file = qa_parquet
     for bad in (
         {"packing": True},
-        {"freeze_strategy": "lora"},
         {"attention_impl": "ring"},
     ):
         cfg = make_config(
@@ -181,6 +180,108 @@ def test_pipe_rejects_unsupported_combos(qa_parquet, tmp_path):  # noqa: F811
         )
         with pytest.raises(ValueError, match="pipe mesh axis"):
             SFTTrainer(cfg)
+
+
+def test_pipeline_state_split_lora():
+    """Under LoRA, only adapters are trainable in pipe mode: stacked base
+    kernels land in `frozen` (no optimizer state, like the flat path) and the
+    per-layer mask is all-ones (every layer has trainable adapters)."""
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.parallel.freeze import trainable_mask
+    from llm_fine_tune_distributed_tpu.parallel.lora import add_lora_params
+    from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+        build_pipeline_state_leaves,
+    )
+    from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict, split_by_mask
+
+    mc = get_preset("tiny")
+    cfg = TrainConfig(model_preset="tiny", freeze_strategy="lora")
+    params = add_lora_params(params=init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32), rng=jax.random.PRNGKey(1))
+    mask = trainable_mask(params, mc, cfg)
+    trainable, frozen = split_by_mask(params, mask)
+    t, f, vec = build_pipeline_state_leaves(
+        trainable, frozen, flatten_dict(mask), mc.num_layers
+    )
+    stacked_t = [k for k in t if k.startswith(STACKED_PREFIX)]
+    assert stacked_t and all(k.endswith(("lora_a", "lora_b")) for k in stacked_t)
+    assert any(k.endswith("/kernel") for k in f if k.startswith(STACKED_PREFIX))
+    assert any(k.endswith("lora_scale") for k in f if k.startswith(STACKED_PREFIX))
+    np.testing.assert_array_equal(np.asarray(vec), np.ones(mc.num_layers))
+
+
+@pytest.mark.slow
+def test_pipe_lora_loss_parity(qa_parquet, tmp_path):  # noqa: F811
+    """pipe=2 x LoRA trains with loss parity vs the flat LoRA run, keeps the
+    optimizer state at adapter size, and exports the PEFT adapter +
+    merged model exactly like the flat path (VERDICT r2 #3)."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    flat_cfg = make_config(
+        tmp_path / "flat", data_dir, dataset_file,
+        epochs=1, freeze_strategy="lora",
+        mesh=MeshConfig(data=1, fsdp=1, tensor=1, seq=1),
+    )
+    pipe_cfg = make_config(
+        tmp_path / "pipe", data_dir, dataset_file,
+        epochs=1, freeze_strategy="lora",
+        mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=1, pipe=2),
+    )
+    flat = SFTTrainer(flat_cfg)
+    flat_summary = flat.train()
+    pipe = SFTTrainer(pipe_cfg)
+    pipe_summary = pipe.train()
+
+    flat_losses = [h["loss"] for h in flat.metrics.history if "loss" in h]
+    pipe_losses = [h["loss"] for h in pipe.metrics.history if "loss" in h]
+    assert pipe_losses[0] == pytest.approx(flat_losses[0], rel=2e-2)
+    assert pipe_losses[-1] < pipe_losses[0], "pipe x lora did not learn"
+    assert pipe_summary["trainable_params"] == flat_summary["trainable_params"]
+
+    # optimizer state covers ONLY adapter leaves (the LoRA memory win)
+    assert all(
+        k.endswith(("lora_a", "lora_b")) for k in pipe.state.trainable
+    ), sorted(pipe.state.trainable)[:5]
+    # adapter + merged exports both present, no stacked leak
+    assert (tmp_path / "pipe" / "adapter" / "adapter_model.safetensors").exists()
+    from safetensors import safe_open
+
+    with safe_open(
+        os.path.join(tmp_path / "pipe", "best_model", "model.safetensors"), "np"
+    ) as f:
+        keys = set(f.keys())
+    assert not any("@stacked" in k or "lora" in k for k in keys)
+
+
+@pytest.mark.slow
+def test_pipe_qlora_trains(qa_parquet, tmp_path):  # noqa: F811
+    """pipe=2 x QLoRA: stacked [L, in, out] base kernels quantize to NF4
+    (packed along the per-layer in dim), training learns, and the export
+    decodes back to plain per-layer bf16 safetensors."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path / "qlora_pipe", data_dir, dataset_file,
+        epochs=1, freeze_strategy="qlora",
+        mesh=MeshConfig(data=1, fsdp=1, tensor=1, seq=1, pipe=2),
+    )
+    trainer = SFTTrainer(cfg)
+    summary = trainer.train()
+    # the stacked frozen base really is NF4 at rest
+    assert any(k.endswith("kernel_nf4") for k in trainer.state.frozen)
+    losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
+    assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
+    assert np.isfinite(summary["final_train_loss"])
+    from safetensors import safe_open
+
+    with safe_open(
+        os.path.join(tmp_path / "qlora_pipe", "best_model", "model.safetensors"),
+        "np",
+    ) as f:
+        keys = set(f.keys())
+    assert not any("@stacked" in k or "nf4" in k or "lora" in k for k in keys)
 
 
 @pytest.mark.slow
