@@ -118,8 +118,10 @@ def pipeline_forward(
     pipe axis yields the total. With ``return_aux=True`` the result is
     ``(out, aux)`` where aux is the layer-SUM averaged over microbatches —
     the same scale ``models/transformer.forward`` returns per microbatch.
-    (Experts are replicated within a stage — the pipe axis does not compose
-    with expert parallelism.)
+    Expert parallelism composes: the schedule's shard_map is manual only
+    over pipe + dp axes, so expert-sharded stacked leaves
+    ([L, E, in, out] -> P("pipe", "expert", ...)) keep EP inside each stage
+    (GSPMD partitions the dispatch/combine einsums over ``expert``).
     """
     S = mesh.shape["pipe"]
     M = num_microbatches
@@ -254,11 +256,18 @@ def pipeline_forward(
     )
     mb_spec = dp_axes if dp_axes else None
     out_spec = P("pipe", mb_spec) if M % S == 0 else P(None, mb_spec)
+    # Manual only over the axes the schedule itself communicates on (pipe
+    # ppermute/psum + the dp pmean); every other axis — EXPERT above all —
+    # stays automatic, so stacked MoE leaves sharded [L->pipe, E->expert,...]
+    # keep their expert-dim sharding inside the stage compute and GSPMD
+    # partitions the dispatch/combine einsums over the expert axis exactly as
+    # on a flat mesh (pipe x EP composition).
     outs, aux = shard_map(
         spmd,
         mesh=mesh,
         in_specs=(P("pipe"), P(), P(None, mb_spec), P(None, mb_spec), P("pipe")),
         out_specs=(out_spec, P()),
+        axis_names={"pipe", *dp_axes},
         check_vma=False,
     )(stacked_layers, embed, ids, pm, rope_flags)
 
@@ -437,13 +446,31 @@ def build_pipeline_state_leaves(trainable: Dict, frozen: Dict, flat_mask: Dict, 
     return new_trainable, new_frozen, layer_trainable_vector(flat_mask, num_layers)
 
 
+_STACKED_EXPERT = re.compile(r"block_sparse_moe/experts/(w1|w3|w2)$")
+
+
 def pipeline_param_spec(path: str, leaf, mesh: Mesh) -> P:
     """Sharding for the pipe-mode state: stacked block leaves shard their
-    leading (layer) dim over ``pipe``; everything else (embedding, norms,
-    lm_head) is replicated — those leaves enter the schedule's shard_map
-    with replicated in_specs. (FSDP-within-stage is a possible refinement;
-    the at-rest cost of replicating non-block leaves is the embedding only.)"""
+    leading (layer) dim over ``pipe``; stacked MoE expert weights
+    ([L, E, in, out]) additionally shard the expert dim over ``expert`` and
+    their in/out dims like the flat rules (pipe x EP — the memory win both
+    axes exist for on mixtral-class models). Everything else (embedding,
+    norms, lm_head) is replicated — those leaves enter the schedule's
+    shard_map with replicated in_specs. (FSDP-within-stage is a possible
+    refinement; the at-rest cost of replicating non-block leaves is the
+    embedding only.)"""
     if path.startswith(STACKED_PREFIX):
+        m = _STACKED_EXPERT.search(path)
+        if m is not None and "expert" in mesh.shape:
+            # same orientation as parallel/sharding._MATRIX_RULES, shifted
+            # one dim right for the leading layer axis — but only AUTO axes
+            # (expert, tensor) may shard here: fsdp/data are MANUAL inside
+            # the schedule's shard_map, and a manual-axis sharding not
+            # described by the P("pipe") in_spec would just be gathered away
+            # at shard_map entry
+            if m.group(1) == "w2":
+                return P("pipe", "expert", "tensor", None)
+            return P("pipe", "expert", None, "tensor")
         return P("pipe")
     return P()
 

@@ -8,7 +8,14 @@ os.environ["HF_HUB_OFFLINE"] = "1"
 os.environ["TRANSFORMERS_OFFLINE"] = "1"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# XLA CPU bug: the AllReducePromotion pass check-fails ("Invalid binary
+# instruction opcode copy") cloning the bf16 expert-axis all-reduces the
+# pipe x EP backward emits. CPU-only pass, CPU-only workaround — the TPU
+# pipeline never runs it.
+if "xla_disable_hlo_passes" not in flags:
+    flags = (flags + " --xla_disable_hlo_passes=all-reduce-promotion").strip()
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 

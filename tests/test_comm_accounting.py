@@ -259,6 +259,35 @@ def test_ep_mesh_volume(eight_devices):
     assert rep.filter(kind="all-reduce", axes=("data",)).total_wire_bytes() > 0
 
 
+def test_pipe_ep_mesh_has_both_axes(eight_devices):
+    """pipe x EP: the compiled schedule keeps expert parallelism ACTIVE
+    inside stages — expert-axis psums appear alongside the pipe ppermutes
+    (were experts gathered/replicated at shard_map entry, the expert axis
+    would carry only the trivial top-k gathers)."""
+    s = abstract_train_setup(
+        {"pipe": 2, "expert": 2, "fsdp": 2},
+        preset="tiny_moe",
+        accum=4,
+        train_kwargs={"freeze_strategy": "none"},
+    )
+    rep = s.comm_report()
+    assert ("?",) not in {c.axes for c in rep.collectives}
+    ep_ar = rep.filter(kind="all-reduce", axes=("expert",))
+    assert sum(c.count for c in ep_ar.collectives) >= 2 * 4  # dispatch+combine per tick
+    perm = rep.filter(kind="collective-permute", axes=("pipe",))
+    assert sum(c.count for c in perm.collectives) == 2 * (4 + 2 - 1)
+    # the expert weights are never all-gathered whole (EP's memory win): any
+    # expert-axis gather traffic stays far below one full gather of the
+    # stacked expert bytes
+    expert_bytes = sum(
+        int(np.prod(v.shape)) * v.dtype.itemsize
+        for k, v in s.state.trainable.items()
+        if "/experts/" in k and k.endswith(("w1", "w2", "w3"))
+    )
+    ag = rep.filter(kind="all-gather", axes=("expert",)).total_wire_bytes()
+    assert ag < expert_bytes / 4, (ag, expert_bytes)
+
+
 # ------------------------------------------------------------- 16-device probe
 
 _PROBE_16 = r"""

@@ -303,3 +303,41 @@ def test_pipe_trainer_moe(qa_parquet, tmp_path):  # noqa: F811
     losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
     assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
     assert np.isfinite(summary["final_train_loss"])
+
+
+@pytest.mark.slow
+def test_pipe_trainer_moe_expert_parallel(qa_parquet, tmp_path):  # noqa: F811
+    """pipe x EP (VERDICT r2 #4): on a pipe=2 x expert=2 x fsdp=2 mesh the
+    stacked expert weights shard over pipe AND expert (the memory win both
+    axes exist for), the schedule keeps EP inside each stage, and training
+    learns."""
+    from jax.sharding import PartitionSpec as P
+
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path / "moe_ep_pipe", data_dir, dataset_file,
+        epochs=1,
+        model_preset="tiny_moe",
+        freeze_strategy="none",
+        mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=1, expert=2, pipe=2),
+    )
+    trainer = SFTTrainer(cfg)
+
+    # the stacked expert leaves really are expert-sharded at rest
+    expert_keys = [
+        k for k in trainer.state.trainable
+        if STACKED_PREFIX in k and "/experts/" in k and k.endswith(("w1", "w2", "w3"))
+    ]
+    assert expert_keys, "no stacked expert leaves in pipe-mode state"
+    for k in expert_keys:
+        spec = trainer.state.trainable[k].sharding.spec
+        assert len(spec) >= 2 and spec[0] == "pipe" and spec[1] == "expert", (
+            f"{k} not pipe+expert sharded: {spec}"
+        )
+
+    summary = trainer.train()
+    losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
+    assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
+    assert np.isfinite(summary["final_train_loss"])
