@@ -50,6 +50,12 @@ class _Pending:
     # request instead of decoding for nobody (a recovered device would
     # otherwise burn minutes on dead work before serving live traffic)
     abandoned: bool = False
+    # speculative-decoding telemetry of the batch this request rode in
+    # (None unless the request asked for speculation): read off the
+    # Generator right after ITS generate_batch call on the worker thread,
+    # so a later batch cannot overwrite it
+    spec_acceptance: Optional[float] = None
+    spec_steps: Optional[int] = None
 
 
 def _pad_batch_size(n: int, max_batch: int) -> int:
@@ -87,6 +93,17 @@ class BatchingEngine:
         ``timeout`` (seconds) bounds the wait: if the device wedges
         mid-generate, handler threads shed load with a TimeoutError (the
         server maps it to 503) instead of accumulating forever."""
+        return self.submit_full(prompt_ids, gen, seed, timeout).result
+
+    def submit_full(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ) -> _Pending:
+        """``submit`` returning the whole request record (result + the
+        speculative-decoding telemetry the server reports)."""
         p = _Pending(list(prompt_ids), gen, seed)
         self._q.put(p)
         if not p.done.wait(timeout):
@@ -97,7 +114,7 @@ class BatchingEngine:
             )
         if p.error is not None:
             raise p.error
-        return p.result
+        return p
 
     # ---------------------------------------------------------------- worker
 
@@ -171,8 +188,12 @@ class BatchingEngine:
                 results = self._generator.generate_batch(
                     prompts, first.gen, seed=first.seed
                 )
+                rate = getattr(self._generator, "last_acceptance_rate", None)
+                steps = getattr(self._generator, "last_spec_steps", None)
                 for p, r in zip(batch, results):
                     p.result = r
+                    p.spec_acceptance = rate
+                    p.spec_steps = steps
             except BaseException as e:  # resolve waiters even on failure
                 for p in batch:
                     p.error = e

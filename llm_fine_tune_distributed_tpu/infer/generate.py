@@ -169,12 +169,15 @@ class Generator:
 
         return run
 
-    def _build_spec(self, prompt_bucket: int, gen: GenerationConfig):
-        """Compile the prompt-lookup speculative decoder (batch 1).
+    def _build_spec(self, batch: int, prompt_bucket: int, gen: GenerationConfig):
+        """Compile the prompt-lookup speculative decoder (any batch size).
 
-        Each step feeds ``[cur, d_1..d_K]`` (K = ``gen.speculative_lookup``
-        drafts found by matching the newest bigram earlier in the context)
-        through ONE forward at cache slots ``pos-1 .. pos+K-1``.
+        Each step feeds every row's ``[cur, d_1..d_K]`` (K =
+        ``gen.speculative_lookup`` drafts found by matching that row's newest
+        bigram earlier in its own context) through ONE forward — rows carry
+        independent positions (vector ``cache_pos``), so they desynchronize
+        freely as their acceptance counts diverge; the loop runs until every
+        row is done.
 
         GREEDY verify accepts the longest prefix of drafts that match the
         model's own greedy choices — algorithmically plain greedy decode
@@ -207,10 +210,13 @@ class Generator:
         buf_len = prompt_bucket + max_new + K + 1
         eos = jnp.asarray(self.eos_token_ids, jnp.int32) if self.eos_token_ids else None
 
+        def is_eos(tok):
+            return jnp.isin(tok, eos) if eos is not None else jnp.zeros_like(tok, bool)
+
         @jax.jit
         def run(params, prompt_ids, prompt_lens, rng):
-            prompt_len = prompt_lens[0]
-            b, pb = prompt_ids.shape  # b == 1
+            b, pb = prompt_ids.shape
+            rows = jnp.arange(b)
             cache = init_cache(mc, b, buf_len, dtype=dtype)
 
             hidden, cache = forward(
@@ -218,106 +224,118 @@ class Generator:
                 compute_dtype=dtype, output_hidden=True, activation_sharding=act,
             )
             last_h = jnp.take_along_axis(
-                hidden, (prompt_len - 1)[None, None, None], axis=1
+                hidden, (prompt_lens - 1)[:, None, None], axis=1
             )[:, 0]
             logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
 
-            valid = jnp.arange(pb)[None, :] < prompt_len
+            valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
             safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
             seen = jnp.zeros((b, mc.vocab_size), bool).at[
-                jnp.arange(b)[:, None], safe_ids
+                rows[:, None], safe_ids
             ].set(True)
 
-            # token history: prompt + generated, in logical positions
-            ids_buf = jnp.zeros((buf_len,), jnp.int32)
-            ids_buf = jax.lax.dynamic_update_slice(
-                ids_buf, jnp.where(valid, prompt_ids, 0)[0], (0,)
-            )
+            # per-row token history: prompt + generated, in logical positions
+            ids_buf = jnp.zeros((b, buf_len), jnp.int32)
+            ids_buf = ids_buf.at[:, :pb].set(jnp.where(valid, prompt_ids, 0))
 
             rng, sub = jax.random.split(rng)
-            first = sample_token(sub if gen.do_sample else None, logits0, seen, gen)[0]
-            ids_buf = ids_buf.at[prompt_len].set(first)
-            seen = seen.at[0, first].set(True)
-            done = jnp.isin(first, eos) if eos is not None else jnp.bool_(False)
-            n_gen = jnp.int32(1)
+            first = sample_token(sub if gen.do_sample else None, logits0, seen, gen)
+            ids_buf = ids_buf.at[rows, prompt_lens].set(first)
+            seen = seen.at[rows, first].set(True)
+            done = is_eos(first)
+            n_gen = jnp.ones((b,), jnp.int32)
 
             def body(c):
-                n_gen, cache, ids_buf, seen, done, n_steps, rng = c
-                pos = prompt_len + n_gen  # position of the next token
+                n_gen, cache, ids_buf, seen, done, n_steps, row_steps, rng = c
+                pos = prompt_lens + n_gen  # [b] position of each next token
+                alive = (n_gen < max_new) & ~done
 
-                # --- draft: most recent earlier occurrence of the newest bigram
-                last2 = jax.lax.dynamic_slice(ids_buf, (pos - 2,), (2,))
+                # --- draft per row: most recent earlier occurrence of that
+                # row's newest bigram in its own context
+                l0 = ids_buf[rows, pos - 2]
+                l1 = ids_buf[rows, pos - 1]
                 j = jnp.arange(buf_len - 1)
                 match = (
-                    (ids_buf[:-1] == last2[0])
-                    & (ids_buf[1:] == last2[1])
-                    & (j < pos - 2)
+                    (ids_buf[:, :-1] == l0[:, None])
+                    & (ids_buf[:, 1:] == l1[:, None])
+                    & (j[None, :] < (pos - 2)[:, None])
                 )
-                j_star = jnp.max(jnp.where(match, j, -1))
+                j_star = jnp.max(jnp.where(match, j[None, :], -1), axis=1)
                 # garbage drafts are harmless: acceptance re-derives every
-                # token from the model's own greedy choice
+                # token from the model's own choice
                 start = jnp.clip(j_star + 2, 0, buf_len - K)
-                draft = jax.lax.dynamic_slice(ids_buf, (start,), (K,))
+                draft = jax.vmap(
+                    lambda buf, s: jax.lax.dynamic_slice(buf, (s,), (K,))
+                )(ids_buf, start)  # [b, K]
 
-                cur = ids_buf[pos - 1]
-                inputs = jnp.concatenate([cur[None], draft])[None, :]  # [1, K+1]
+                cur = ids_buf[rows, pos - 1]
+                inputs = jnp.concatenate([cur[:, None], draft], axis=1)  # [b, K+1]
                 hidden, new_cache = forward(
                     params, inputs, mc, cache=cache, cache_pos=pos - 1,
                     compute_dtype=dtype, output_hidden=True, activation_sharding=act,
                 )
-                logits_all = unembed(params, hidden[0][None], mc, compute_dtype=dtype, mesh=mesh)[0]
+                logits_all = unembed(params, hidden, mc, compute_dtype=dtype, mesh=mesh)
 
                 # --- sequential verify (evolving repetition-penalty set).
                 # Position i's token is ALWAYS valid when emitted (its logits
                 # condition only on accepted tokens); `active` gates whether
-                # position i+1 may still consume the next draft.
+                # position i+1 may still consume the next draft. All per-row.
                 def verify(i, v):
                     seen, ids_buf, n_acc, active, done, rng = v
-                    d = draft[jnp.minimum(i, K - 1)]
+                    d = draft[:, jnp.minimum(i, K - 1)]
                     if gen.do_sample:
                         from llm_fine_tune_distributed_tpu.infer.sampling import (
                             rejection_sample_step,
                         )
 
                         rng, sub = jax.random.split(rng)
-                        tok, accept_draft = rejection_sample_step(
-                            sub, logits_all[i][None], seen, d[None], gen,
-                            bonus=i >= K,
+                        tok, keep_going = rejection_sample_step(
+                            sub, logits_all[:, i], seen, d, gen, bonus=i >= K,
                         )
-                        tok, keep_going = tok[0], accept_draft[0]
                     else:
-                        tok = sample_token(None, logits_all[i][None], seen, gen)[0]
+                        tok = sample_token(None, logits_all[:, i], seen, gen)
                         # token i+1 is valid only if draft i matched the
                         # greedy choice (slot K has no draft to validate)
                         keep_going = (i >= K) | (d == tok)
                     take = active & ~done & (n_gen + i < max_new)
-                    seen = jnp.where(take, seen.at[0, tok].set(True), seen)
-                    ids_buf = jnp.where(
-                        take, ids_buf.at[pos + i].set(tok), ids_buf
+                    seen = jnp.where(
+                        take[:, None], seen.at[rows, tok].set(True), seen
                     )
-                    n_acc = n_acc + jnp.where(take, 1, 0)
-                    hit = jnp.isin(tok, eos) if eos is not None else jnp.bool_(False)
-                    done = done | (take & hit)
+                    ids_buf = jnp.where(
+                        take[:, None], ids_buf.at[rows, pos + i].set(tok), ids_buf
+                    )
+                    n_acc = n_acc + take.astype(jnp.int32)
+                    done = done | (take & is_eos(tok))
                     active = active & keep_going
                     return (seen, ids_buf, n_acc, active, done, rng)
 
                 seen, ids_buf, n_acc, _, done, rng = jax.lax.fori_loop(
-                    0, K + 1, lambda i, v: verify(i, v),
-                    (seen, ids_buf, jnp.int32(0), jnp.bool_(True), done, rng),
+                    0, K + 1, verify,
+                    (seen, ids_buf, jnp.zeros((b,), jnp.int32), alive, done, rng),
                 )
-                return (n_gen + n_acc, new_cache, ids_buf, seen, done, n_steps + 1, rng)
+                return (
+                    n_gen + n_acc, new_cache, ids_buf, seen, done,
+                    n_steps + 1, row_steps + alive.astype(jnp.int32), rng,
+                )
 
             def cond(c):
-                n_gen, _, _, _, done, _, _ = c
-                return (n_gen < max_new) & ~done
+                n_gen, _, _, _, done, _, _, _ = c
+                return jnp.any((n_gen < max_new) & ~done)
 
-            n_gen, cache, ids_buf, seen, done, n_steps, rng = jax.lax.while_loop(
-                cond, body, (n_gen, cache, ids_buf, seen, done, jnp.int32(1), rng)
+            n_gen, cache, ids_buf, seen, done, n_steps, row_steps, rng = (
+                jax.lax.while_loop(
+                    cond, body,
+                    (n_gen, cache, ids_buf, seen, done, jnp.int32(1),
+                     jnp.zeros((b,), jnp.int32), rng),
+                )
             )
-            out = jax.lax.dynamic_slice(ids_buf, (prompt_len,), (max_new,))
+            out = jax.vmap(
+                lambda buf, s: jax.lax.dynamic_slice(buf, (s,), (max_new,))
+            )(ids_buf, prompt_lens)
             # n_steps counts sequential forwards (prefill + spec steps);
-            # n_steps < n_gen proves multi-token acceptance
-            return out[None, :], n_gen, n_steps
+            # row_steps counts the steps each row was still generating — a
+            # row's accepted drafts total n_gen - 1 - row_steps
+            return out, n_gen, n_steps, row_steps
 
         return run
 
@@ -336,20 +354,15 @@ class Generator:
             raise ValueError("generate_batch needs >= 1 non-empty prompt")
         longest = max(len(p) for p in prompts)
         bucket = -(-longest // _PROMPT_BUCKET) * _PROMPT_BUCKET
-        # prompt-lookup speculation: batch-1 (the latency case); greedy
-        # verifies by exact match, sampled by rejection sampling
-        speculate = gen.speculative_lookup > 0 and len(prompts) == 1
+        # prompt-lookup speculation, any batch size: rows draft from their
+        # own contexts and desynchronize freely; greedy verifies by exact
+        # match, sampled by rejection sampling
+        speculate = gen.speculative_lookup > 0
         if speculate:
-            key = ("spec", bucket, gen)
+            key = ("spec", len(prompts), bucket, gen)
             if key not in self._jit_cache:
-                self._jit_cache[key] = self._build_spec(bucket, gen)
+                self._jit_cache[key] = self._build_spec(len(prompts), bucket, gen)
         else:
-            # normalize the unused speculation knob out of the cache key so a
-            # sampled/multi-prompt fallback reuses the plain batch program
-            # instead of compiling a behaviorally identical copy
-            import dataclasses
-
-            gen = dataclasses.replace(gen, speculative_lookup=0)
             key = ("batch", len(prompts), bucket, gen)
             if key not in self._jit_cache:
                 self._jit_cache[key] = self._build_batch(len(prompts), bucket, gen)
@@ -364,25 +377,26 @@ class Generator:
             self.params, jnp.asarray(padded), jnp.asarray(lens),
             jax.random.PRNGKey(seed),
         )
-        out, n = res[0], res[1]  # spec path also returns n_steps at res[2]
-        self.last_spec_steps = int(res[2]) if len(res) > 2 else None
-        if len(res) > 2:
-            # acceptance telemetry: each of the (n_steps - 1) spec steps
-            # drafted K tokens and emitted 1 + its accepted drafts, and the
-            # prefill emitted 1 — so accepted drafts total n_gen - n_steps
-            spec_steps = max(int(res[2]) - 1, 1)
-            drafted = spec_steps * gen.speculative_lookup
-            accepted = max(int(n) - int(res[2]), 0)
-            self.last_acceptance_rate = accepted / max(drafted, 1)
+        out, n = res[0], res[1]
+        if speculate:
+            # acceptance telemetry: prefill emitted 1 per row and each of a
+            # row's row_steps spec steps drafted K and emitted 1 + accepted
+            n_vec = np.asarray(n)
+            row_steps = np.asarray(res[3])
+            self.last_spec_steps = int(res[2])
+            drafted = int(row_steps.sum()) * gen.speculative_lookup
+            accepted = int((n_vec - 1 - row_steps).sum())
+            self.last_acceptance_rate = max(accepted, 0) / max(drafted, 1)
         else:
+            self.last_spec_steps = None
             self.last_acceptance_rate = None
         out = np.asarray(out)
         results: List[List[int]] = []
-        for row in out:
+        for r, row in enumerate(out):
             toks = row.tolist()
             if speculate:
                 # slots past the accepted count hold rejected-draft leftovers
-                toks = toks[: int(n)]
+                toks = toks[: int(np.asarray(n)[r])]
             for i, tok in enumerate(toks):
                 if tok in self.eos_token_ids:
                     toks = toks[:i]

@@ -135,17 +135,25 @@ def serve(
                 # chat helpers, so CLI and server cannot diverge); only the
                 # device work goes through the batching engine's worker
                 prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
-                ids = engine.submit(
+                pending = engine.submit_full(
                     prompt_ids, gen, seed=seed, timeout=request_timeout_s
                 )
-                answer = generator.decode_reply(ids)
+                answer = generator.decode_reply(pending.result)
             except TimeoutError as e:  # wedged device: shed load, don't pile up
                 self._send(503, {"error": str(e)})
                 return
             except Exception as e:  # surface generation errors as 500s
                 self._send(500, {"error": str(e)})
                 return
-            self._send(200, {"answer": answer})
+            resp = {"answer": answer}
+            if gen.speculative_lookup > 0 and pending.spec_acceptance is not None:
+                # draft-acceptance telemetry so clients can see whether the
+                # speculation they asked for is actually paying off
+                resp["speculative"] = {
+                    "acceptance_rate": round(pending.spec_acceptance, 3),
+                    "sequential_forwards": pending.spec_steps,
+                }
+            self._send(200, resp)
 
         def log_message(self, fmt, *args):
             print(f"[serve] {self.address_string()} {fmt % args}", flush=True)

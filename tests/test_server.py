@@ -166,6 +166,16 @@ def test_speculative_request_field(server):
     with post(
         {"question": "water?", "max_new_tokens": 4, "greedy": True, "speculative": 4}
     ) as r:
-        assert isinstance(json.loads(r.read())["answer"], str)
+        body = json.loads(r.read())
+        assert isinstance(body["answer"], str)
+        # acceptance-rate telemetry rides the response so clients can see
+        # whether the speculation they asked for pays off
+        assert 0.0 <= body["speculative"]["acceptance_rate"] <= 1.0
+        assert body["speculative"]["sequential_forwards"] >= 1
     with post({"question": "water?", "max_new_tokens": 4, "speculative": 4}) as r:
-        assert isinstance(json.loads(r.read())["answer"], str)
+        body = json.loads(r.read())
+        assert isinstance(body["answer"], str)
+        assert "speculative" in body
+    # non-speculative requests carry no speculative block
+    with post({"question": "water?", "max_new_tokens": 4, "greedy": True}) as r:
+        assert "speculative" not in json.loads(r.read())
