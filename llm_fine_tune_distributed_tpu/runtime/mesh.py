@@ -9,7 +9,12 @@ there is no NCCL env-var zoo (reference ``deploy/pytorchjob.yaml:51-64``).
 Axis order puts ``data`` outermost so that, on multi-slice systems, the pure
 data-parallel axis (which only communicates once per step for the gradient
 reduction) maps onto DCN while fsdp/tensor/seq traffic stays on ICI —
-the standard scaling-book layout.
+the standard scaling-book layout. ``make_mesh`` enforces this for real: when
+the device pool spans multiple slices (``device.slice_index`` differs) it
+builds the mesh with ``mesh_utils.create_hybrid_device_mesh``, spreading
+ONLY the data axis across slices and refusing shapes that would put any
+other axis on DCN (~6 GB/s/chip vs ~90 GB/s ICI — see
+observe/scaling.py:V5E).
 """
 
 from __future__ import annotations
@@ -61,6 +66,9 @@ def make_mesh(
     # (jax.make_mesh defaults to Explicit axis types as of jax 0.9, which
     # instead type-checks every intermediate — not what we want here.)
     auto = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+    n_slices = len({getattr(d, "slice_index", 0) or 0 for d in devices})
+    if n_slices > 1:
+        return _make_hybrid_mesh(sizes, devices, n_slices, auto)
     if devices is jax.devices() or list(devices) == list(jax.devices()):
         try:
             return jax.make_mesh(shape, MESH_AXES, axis_types=auto)
@@ -68,6 +76,42 @@ def make_mesh(
             pass
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, MESH_AXES, axis_types=auto)
+
+
+def _make_hybrid_mesh(sizes: dict, devices, n_slices: int, axis_types) -> Mesh:
+    """Multi-slice mesh: the data axis (and only it) spreads across slices.
+
+    Per-slice traffic (fsdp all-gathers, tensor psums, seq permutes, pipe
+    boundaries, expert dispatch) must ride ICI; the pure data axis carries
+    one gradient reduction per accumulation step — the only volume DCN can
+    afford (BASELINE.md "Multi-slice note"). Shapes that cannot place every
+    non-data axis within a slice are rejected rather than silently built
+    slow."""
+    from jax.experimental import mesh_utils
+
+    if sizes["data"] % n_slices:
+        raise ValueError(
+            f"multi-slice mesh: data={sizes['data']} must be divisible by "
+            f"the slice count ({n_slices}) — only the pure data axis may "
+            "span slices (DCN); fsdp/tensor/seq/pipe/expert traffic needs ICI"
+        )
+    per_slice = len(devices) // n_slices
+    ici = dict(sizes, data=sizes["data"] // n_slices)
+    ici_product = 1
+    for a in MESH_AXES:
+        ici_product *= ici[a]
+    if ici_product != per_slice:
+        raise ValueError(
+            f"multi-slice mesh: non-data axes need {ici_product} devices per "
+            f"slice but each slice has {per_slice}"
+        )
+    dcn = {a: (n_slices if a == "data" else 1) for a in MESH_AXES}
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici[a] for a in MESH_AXES),
+        tuple(dcn[a] for a in MESH_AXES),
+        devices=list(devices),
+    )
+    return Mesh(dev_array, MESH_AXES, axis_types=axis_types)
 
 
 def data_parallel_size(mesh: Mesh) -> int:
