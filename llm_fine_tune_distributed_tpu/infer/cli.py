@@ -53,8 +53,10 @@ def run_ask_cli(
     )
     parser.add_argument(
         "--tp", type=int, default=1, metavar="N",
-        help="tensor-parallel inference over N local devices (shards weights "
-        "and KV cache so models beyond one chip's HBM are servable)",
+        help="tensor-parallel inference over N devices of the global pool "
+        "(shards weights and KV cache so models beyond one chip's HBM are "
+        "servable; under jax.distributed N may exceed the local device "
+        "count — the mesh then spans hosts and --serve coordinates them)",
     )
     parser.add_argument(
         "--serve", action="store_true",

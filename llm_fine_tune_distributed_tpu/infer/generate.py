@@ -30,11 +30,24 @@ _PROMPT_BUCKET = 256
 
 
 def make_tp_mesh(tp: int):
-    """Tensor-parallel inference mesh over the first ``tp`` local devices
-    (the `--tp` flag of ask_tuned_model.py / smollm3-serve)."""
+    """Tensor-parallel inference mesh over the first ``tp`` devices of the
+    GLOBAL pool (the `--tp` flag of ask_tuned_model.py / smollm3-serve).
+
+    Under ``jax.distributed`` the pool spans processes, so ``tp`` may exceed
+    the local device count — a llama3_70b int8 (~70 GB) becomes servable on
+    a 2-host v5e-8 with ``--tp 8``. The Generator detects the
+    process-spanning mesh and switches to global-array placement/inputs."""
+    import jax as _jax
+
     from llm_fine_tune_distributed_tpu.config import MeshConfig
     from llm_fine_tune_distributed_tpu.runtime.mesh import make_mesh
 
+    if tp > len(_jax.devices()):
+        raise ValueError(
+            f"--tp {tp} exceeds the {len(_jax.devices())} visible devices "
+            f"across {_jax.process_count()} process(es); start more hosts "
+            "under jax.distributed (MASTER_ADDR/PORT, WORLD_SIZE/RANK)"
+        )
     return make_mesh(MeshConfig(data=1, fsdp=1, tensor=tp, seq=1, expert=1, pipe=1))
 
 
@@ -61,11 +74,15 @@ class Generator:
     ):
         self.mesh = mesh
         self._act_sharding = None
+        self._multihost = False
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from llm_fine_tune_distributed_tpu.parallel.sharding import shard_params
 
+            self._multihost = any(
+                d.process_index != jax.process_index() for d in mesh.devices.flat
+            )
             params = shard_params(params, mesh)
             # batch-1 decode activations are tiny: keep them replicated and
             # let the weight shardings drive the per-block psums. Passing
@@ -373,10 +390,26 @@ class Generator:
         for i, p in enumerate(prompts):
             padded[i, : len(p)] = p
             lens[i] = len(p)
-        res = run(
-            self.params, jnp.asarray(padded), jnp.asarray(lens),
-            jax.random.PRNGKey(seed),
-        )
+        key = jax.random.PRNGKey(seed)
+        if self._multihost:
+            # a process-spanning mesh needs GLOBAL input arrays; every
+            # process must call with the same prompts/seed (the coordinator
+            # in infer/multihost.py guarantees this for the serving path)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from llm_fine_tune_distributed_tpu.parallel.sharding import (
+                global_array_from_host,
+            )
+
+            rep = NamedSharding(self.mesh, P())
+            inputs = (
+                global_array_from_host(padded, rep),
+                global_array_from_host(lens, rep),
+                global_array_from_host(np.asarray(key), rep),
+            )
+        else:
+            inputs = (jnp.asarray(padded), jnp.asarray(lens), key)
+        res = run(self.params, *inputs)
         out, n = res[0], res[1]
         if speculate:
             # acceptance telemetry: prefill emitted 1 per row and each of a

@@ -1,0 +1,131 @@
+"""Multi-host serving coordination: one HTTP front door, N processes decoding.
+
+A process-spanning inference mesh (``make_tp_mesh`` with tp > local devices)
+means EVERY process must enter the same jitted decode with the same inputs —
+but HTTP requests arrive only at the host running the server. This module is
+the bridge:
+
+- process 0 (the server host) wraps its Generator in ``MultihostCoordinator``
+  and broadcasts each batch's (prompts, GenerationConfig, seed) before
+  decoding;
+- every other process calls ``follow()``, a loop that receives broadcasts and
+  enters the identical ``generate_batch`` call, until the coordinator stops.
+
+Transport is ``multihost_utils.broadcast_one_to_all`` (device collectives —
+the same fabric the decode itself uses, no extra sockets): a fixed-shape
+header (stop flag, batch, bucket width, seed, config-JSON length) followed by
+fixed-shape payloads. GenerationConfig rides as JSON so per-request sampling
+knobs keep working across hosts; all processes therefore jit-compile the
+same (batch, bucket, config) specialization.
+
+The reference has no multi-host serving at all (its inference is a
+single-GPU CLI, reference ``ask_tuned_model.py``); this is what makes the
+framework's own biggest trainable models (70B-class, int8 ~70 GB) servable
+by the framework's own engine on a 2-host v5e-8.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+
+_HEADER_LEN = 5  # [stop, batch, bucket, seed, cfg_len]
+_CFG_BUF = 4096  # fixed JSON buffer so the broadcast shape is static
+
+
+def _broadcast(arr: np.ndarray, is_source: bool) -> np.ndarray:
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(arr, is_source=is_source)
+    )
+
+
+def _encode_cfg(gen: GenerationConfig):
+    raw = json.dumps(dataclasses.asdict(gen)).encode()
+    if len(raw) > _CFG_BUF:
+        raise ValueError(f"GenerationConfig JSON exceeds {_CFG_BUF} bytes")
+    buf = np.zeros((_CFG_BUF,), np.uint8)
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    return buf, len(raw)
+
+
+def _decode_cfg(buf: np.ndarray, length: int) -> GenerationConfig:
+    raw = bytes(buf[:length].astype(np.uint8).tobytes())
+    return GenerationConfig(**json.loads(raw.decode()))
+
+
+class MultihostCoordinator:
+    """Wraps a Generator so ``generate_batch`` fans out to follower hosts.
+
+    Drop-in for the serving path: the BatchingEngine only calls
+    ``generate_batch`` (plus reads the two telemetry attributes), so handing
+    it the coordinator instead of the raw Generator multi-hosts the server
+    without the engine knowing."""
+
+    def __init__(self, generator):
+        import jax
+
+        self.generator = generator
+        self._is_source = jax.process_index() == 0
+
+    # telemetry passthrough (the engine reads these after each batch)
+    @property
+    def last_acceptance_rate(self):
+        return self.generator.last_acceptance_rate
+
+    @property
+    def last_spec_steps(self):
+        return self.generator.last_spec_steps
+
+    def generate_batch(
+        self,
+        prompts: Sequence[Sequence[int]],
+        gen: Optional[GenerationConfig] = None,
+        seed: int = 0,
+    ) -> List[List[int]]:
+        gen = gen or GenerationConfig()
+        prompts = [list(p) for p in prompts]
+        bucket = max(len(p) for p in prompts)
+        cfg_buf, cfg_len = _encode_cfg(gen)
+        header = np.asarray(
+            [0, len(prompts), bucket, seed, cfg_len], np.int64
+        )
+        _broadcast(header, self._is_source)
+        padded = np.zeros((len(prompts), bucket), np.int64)
+        lens = np.zeros((len(prompts),), np.int64)
+        for i, p in enumerate(prompts):
+            padded[i, : len(p)] = p
+            lens[i] = len(p)
+        _broadcast(padded, self._is_source)
+        _broadcast(lens, self._is_source)
+        _broadcast(cfg_buf, self._is_source)
+        return self.generator.generate_batch(prompts, gen, seed=seed)
+
+    def stop(self) -> None:
+        """Release follower hosts (server shutdown)."""
+        stop = np.zeros((_HEADER_LEN,), np.int64)
+        stop[0] = 1
+        _broadcast(stop, self._is_source)
+
+
+def follow(generator) -> None:
+    """Follower loop for processes > 0: mirror every coordinator batch."""
+    while True:
+        header = _broadcast(np.zeros((_HEADER_LEN,), np.int64), False)
+        stop, batch, bucket, seed, cfg_len = (int(x) for x in header)
+        if stop:
+            return
+        padded = _broadcast(np.zeros((batch, bucket), np.int64), False)
+        lens = _broadcast(np.zeros((batch,), np.int64), False)
+        cfg_buf = _broadcast(np.zeros((_CFG_BUF,), np.uint8), False)
+        gen = _decode_cfg(cfg_buf, cfg_len)
+        prompts = [
+            [int(t) for t in padded[i, : int(lens[i])]] for i in range(batch)
+        ]
+        generator.generate_batch(prompts, gen, seed=seed)

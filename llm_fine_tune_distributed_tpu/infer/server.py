@@ -66,7 +66,26 @@ def serve(
         mesh = make_tp_mesh(tp)
         print(f"Tensor-parallel decode over {tp} devices")
     generator = Generator(params, model_config, tokenizer, mesh=mesh)
-    engine = BatchingEngine(generator, max_batch=max_batch, window_ms=batch_window_ms)
+    coordinator = None
+    engine_target = generator
+    if getattr(generator, "_multihost", False):
+        import jax
+
+        from llm_fine_tune_distributed_tpu.infer.multihost import (
+            MultihostCoordinator,
+            follow,
+        )
+
+        if jax.process_index() != 0:
+            # follower hosts never serve HTTP: they mirror process 0's
+            # batches until the coordinator stops them
+            print(f"[serve] process {jax.process_index()}: following host 0")
+            follow(generator)
+            return
+        coordinator = MultihostCoordinator(generator)
+        engine_target = coordinator
+        print(f"[serve] coordinating {jax.process_count()} hosts")
+    engine = BatchingEngine(engine_target, max_batch=max_batch, window_ms=batch_window_ms)
     print(f"Model ready (max_batch={max_batch}, quantize={quantize}).")
 
     class Handler(BaseHTTPRequestHandler):
@@ -166,6 +185,8 @@ def serve(
         pass
     finally:
         httpd.server_close()
+        if coordinator is not None:
+            coordinator.stop()  # release follower hosts
 
 
 def main(argv: Optional[list] = None) -> int:

@@ -115,9 +115,29 @@ def _validate_spec(spec: P, shape, mesh: Mesh) -> P:
 
 
 def shard_params(params, mesh: Mesh):
-    """Place a (host-local) params pytree onto the mesh per the rules."""
+    """Place a (host-local) params pytree onto the mesh per the rules.
+
+    Works on process-spanning meshes too: ``jax.device_put`` cannot target
+    another process's devices, so when the mesh is not fully addressable
+    each leaf is assembled with ``make_array_from_callback`` — every process
+    holds the full host copy (same checkpoint on every host) and contributes
+    its local shards. This is the multi-host inference load path
+    (``Generator(mesh=...)`` with tensor spanning hosts)."""
     shardings = param_sharding_rules(params, mesh)
-    return jax.device_put(params, shardings)
+    if len(mesh.devices.flat) == len([d for d in mesh.devices.flat if d.process_index == jax.process_index()]):
+        return jax.device_put(params, shardings)
+    return jax.tree.map(
+        lambda x, sh: global_array_from_host(np.asarray(x), sh), params, shardings
+    )
+
+
+def global_array_from_host(host_array: np.ndarray, sharding: NamedSharding):
+    """Global jax.Array over a (possibly multi-process) mesh from a host
+    array every process holds in full: each process contributes the shards
+    its devices own."""
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx]
+    )
 
 
 def batch_spec(mesh: Mesh, seq_axis: bool = False) -> P:
