@@ -89,6 +89,10 @@ def serve(
     print(f"Model ready (max_batch={max_batch}, quantize={quantize}).")
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 so /v1/stream may use chunked transfer encoding (every
+        # non-stream response carries an explicit Content-Length)
+        protocol_version = "HTTP/1.1"
+
         def _send(self, code: int, payload: dict | str) -> None:
             body = (
                 payload if isinstance(payload, str) else json.dumps(payload)
@@ -108,19 +112,97 @@ def serve(
             else:
                 self._send(404, {"error": "not found"})
 
+        def _stream(self, req: dict) -> None:
+            """POST /v1/stream: Server-Sent Events, one ``data:`` event per
+            decoded text delta. Cuts time-to-first-token from O(max_new)
+            decode steps to O(chunk): the reference's own default
+            (``max_new_tokens=3768``) otherwise leaves a client staring at
+            nothing for the whole generation.
+
+            Streams run on the handler thread against the same Generator the
+            batching engine uses — concurrent dispatches serialize in the
+            device queue, so batched traffic keeps flowing. Multi-host
+            serving does not stream (the per-chunk host round-trip would
+            need a broadcast each chunk); clients get a 501 there."""
+            if coordinator is not None:
+                self._send(501, {"error": "streaming unavailable in multi-host serving"})
+                return
+            gen_kwargs = {
+                k: cast(req[k])
+                for k, cast in self._FIELD_CASTS.items()
+                if k in req
+            }
+            if "greedy" in req:
+                gen_kwargs["do_sample"] = not req["greedy"]
+            gen = GenerationConfig(**gen_kwargs)
+            messages = [
+                {
+                    "role": "system",
+                    "content": req.get("system_prompt", WILDERNESS_EXPERT_SYSTEM_PROMPT),
+                },
+                {"role": "user", "content": req["question"]},
+            ]
+            prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk_out(data: bytes) -> None:
+                self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+            ids_all, prev_text = [], ""
+            try:
+                for piece in generator.generate_stream(
+                    prompt_ids, gen, seed=int(req.get("seed", 0)),
+                    chunk=int(req.get("stream_chunk", 8)),
+                ):
+                    ids_all.extend(piece)
+                    text = generator.tokenizer.decode(
+                        ids_all, skip_special_tokens=True
+                    )
+                    delta = text[len(prev_text):]
+                    prev_text = text
+                    if delta:
+                        chunk_out(
+                            f"data: {json.dumps({'delta': delta})}\n\n".encode()
+                        )
+                chunk_out(
+                    f"data: {json.dumps({'done': True, 'n_tokens': len(ids_all)})}\n\n".encode()
+                )
+            finally:
+                self.wfile.write(b"0\r\n\r\n")
+
+        _FIELD_CASTS = {
+            "max_new_tokens": int,
+            "temperature": float,
+            "top_p": float,
+            "top_k": int,
+            "repetition_penalty": float,
+        }
+
         def do_POST(self):  # noqa: N802
+            if self.path == "/v1/stream":
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(req, dict) or "question" not in req:
+                        raise TypeError("body must be a JSON object with 'question'")
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    self._stream(req)
+                except Exception as e:  # headers may already be sent: log only
+                    print(f"[serve] stream error: {e}", flush=True)
+                return
             if self.path != "/v1/generate":
                 self._send(404, {"error": "not found"})
                 return
             # Optional fields cast and forwarded only when present, so
             # GenerationConfig stays the single source of sampling defaults.
-            field_casts = {
-                "max_new_tokens": int,
-                "temperature": float,
-                "top_p": float,
-                "top_k": int,
-                "repetition_penalty": float,
-            }
+            field_casts = self._FIELD_CASTS
             # "speculative": K maps to GenerationConfig.speculative_lookup
             # (prompt-lookup decoding, infer/generate.py — greedy exact-match
             # or sampled rejection-sampling verification)

@@ -7,6 +7,7 @@ import threading
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
 from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
@@ -27,6 +28,7 @@ def test_pad_batch_size():
     assert [_pad_batch_size(n, 8) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 8]
 
 
+@pytest.mark.slow
 def test_concurrent_requests_match_solo():
     gen = _make_generator()
     tok = ByteChatMLTokenizer()

@@ -132,6 +132,7 @@ def test_iota_replica_group_decode():
     ]
 
 
+@pytest.mark.slow
 def test_trip_count_multiplier_scales_with_accum(eight_devices):
     """Doubling grad accumulation must ~double loop-body collective bytes —
     the direct check that the known_trip_count multiplier is applied."""
@@ -156,6 +157,7 @@ def test_dp_mesh_volume(eight_devices):
     assert lo <= rep.total_wire_bytes() <= hi
 
 
+@pytest.mark.slow
 def test_dp_fsdp_mesh_volume(eight_devices):
     s = abstract_train_setup({"data": 2, "fsdp": 4}, accum=2)
     rep = s.comm_report()
@@ -177,6 +179,7 @@ def test_dp_fsdp_mesh_volume(eight_devices):
     assert _ar(s.trainable_bytes / 4, 2) * 0.5 <= dp_ar <= _ar(s.trainable_bytes, 2) * 2 * 1.5
 
 
+@pytest.mark.slow
 def test_fsdp_tp_mesh_volume(eight_devices):
     s = abstract_train_setup({"fsdp": 4, "tensor": 2}, accum=2)
     rep = s.comm_report()
@@ -199,6 +202,7 @@ def test_fsdp_tp_mesh_volume(eight_devices):
     assert ag.total_wire_bytes() > 0
 
 
+@pytest.mark.slow
 def test_seq_mesh_has_ring_permutes(eight_devices):
     s = abstract_train_setup(
         {"fsdp": 2, "tensor": 2, "seq": 2},
@@ -216,6 +220,7 @@ def test_seq_mesh_has_ring_permutes(eight_devices):
     assert perm.total_wire_bytes() > 0
 
 
+@pytest.mark.slow
 def test_pipeline_mesh_exact_permute_schedule(eight_devices):
     M, S = 4, 2
     s = abstract_train_setup({"pipe": S, "fsdp": 4}, accum=M)
@@ -242,6 +247,7 @@ def test_pipeline_mesh_exact_permute_schedule(eight_devices):
     assert rep.filter(kind="all-gather", axes=("pipe",)).total_wire_bytes() > 0
 
 
+@pytest.mark.slow
 def test_ep_mesh_volume(eight_devices):
     s = abstract_train_setup(
         {"data": 2, "expert": 4},
@@ -259,6 +265,7 @@ def test_ep_mesh_volume(eight_devices):
     assert rep.filter(kind="all-reduce", axes=("data",)).total_wire_bytes() > 0
 
 
+@pytest.mark.slow
 def test_pipe_ep_mesh_has_both_axes(eight_devices):
     """pipe x EP: the compiled schedule keeps expert parallelism ACTIVE
     inside stages — expert-axis psums appear alongside the pipe ppermutes

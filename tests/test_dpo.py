@@ -118,6 +118,7 @@ def test_dpo_chunked_matches_full(setup):
     assert losses[None][1] == pytest.approx(losses[32][1], abs=2e-2)
 
 
+@pytest.mark.slow
 def test_dpo_loss_matches_manual_logits(setup):
     """Framework sequence logprobs must match a from-scratch log_softmax gather."""
     _, config, params, batch = setup

@@ -174,6 +174,7 @@ def test_batched_eos_stops_rows_independently(tiny_setup):
     assert out[1] == other
 
 
+@pytest.mark.slow
 def test_speculative_greedy_exact_equivalence(tiny_setup):
     """Prompt-lookup speculative decode must emit EXACTLY the plain greedy
     sequence — incl. evolving repetition penalty — on normal and highly
@@ -219,6 +220,7 @@ def test_speculative_eos_stops(tiny_setup):
     assert gen.generate_ids(prompt, spec_cfg) == expect
 
 
+@pytest.mark.slow
 def test_speculative_batched_per_row_equivalence(tiny_setup):
     """Batched speculation (VERDICT r2 #6): every row of a speculative batch
     emits exactly the plain greedy sequence for ITS prompt — rows draft from
@@ -254,6 +256,7 @@ def test_speculative_batched_per_row_equivalence(tiny_setup):
     assert gen.last_acceptance_rate is not None
 
 
+@pytest.mark.slow
 def test_speculative_accepts_on_repetitive_output(tiny_setup):
     """When greedy output repeats a bigram, drafting must accept multiple
     tokens per forward: sequential steps < generated tokens."""
@@ -284,6 +287,7 @@ def test_speculative_accepts_on_repetitive_output(tiny_setup):
 
 
 
+@pytest.mark.slow
 def test_sampled_speculative_near_greedy_temperature_matches(tiny_setup):
     """At a temperature low enough that the warped distribution is a point
     mass, rejection-sampling speculation must reproduce the deterministic
@@ -348,3 +352,38 @@ def test_sampled_speculative_matches_plain_distribution(tiny_setup):
             f"position {j}: TV(plain, spec) = {got:.3f} vs plain-vs-plain "
             f"null {null:.3f} - speculative sampling skews the distribution"
         )
+
+
+def test_generate_stream_matches_plain_decode(tiny_setup):
+    """Streaming decode yields EXACTLY the plain decode's tokens, greedy and
+    sampled (same sampler, same rng split sequence, chunked host readout)."""
+    mc, params, tok = tiny_setup
+    gen = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    prompt = tok.encode("the quick brown fox")
+    for cfg in (
+        GenerationConfig(max_new_tokens=11, do_sample=False, repetition_penalty=1.1),
+        GenerationConfig(max_new_tokens=11, do_sample=True, temperature=0.8),
+    ):
+        plain = gen.generate_ids(prompt, cfg, seed=3)
+        streamed = []
+        for piece in gen.generate_stream(prompt, cfg, seed=3, chunk=4):
+            streamed.extend(piece)
+        assert streamed == plain, (cfg.do_sample, streamed, plain)
+
+
+def test_generate_stream_stops_at_eos(tiny_setup):
+    mc, params, tok = tiny_setup
+    probe = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    cfg = GenerationConfig(max_new_tokens=10, do_sample=False, repetition_penalty=1.0)
+    plain = probe.generate_ids(tok.encode("the quick brown fox"), cfg)
+    eos_tok = plain[4]
+    gen = Generator(
+        params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[eos_tok]
+    )
+    streamed = []
+    for piece in gen.generate_stream(tok.encode("the quick brown fox"), cfg, chunk=3):
+        streamed.extend(piece)
+    # the stream stops at the FIRST occurrence of the eos token (which may
+    # be earlier than index 4 if the greedy sequence repeats tokens)
+    assert streamed == plain[: plain.index(eos_tok)]
+    assert eos_tok not in streamed

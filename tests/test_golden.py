@@ -3,6 +3,7 @@ reference's manual 5-question comparison (reference README.md:15-21)."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
 from llm_fine_tune_distributed_tpu.infer import Generator
@@ -58,6 +59,7 @@ def test_compare_flags_divergence():
     assert report["rows"][1]["answers_differ"] is False
 
 
+@pytest.mark.slow
 def test_same_model_answers_identical():
     a = run_golden_eval(_generator(0), questions=GOLDEN_QUESTIONS[:1], max_new_tokens=6)
     b = run_golden_eval(_generator(0), questions=GOLDEN_QUESTIONS[:1], max_new_tokens=6)

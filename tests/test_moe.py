@@ -134,6 +134,7 @@ def test_forward_tiny_moe_and_aux():
     assert float(aux) > 0  # 2 MoE layers contribute
 
 
+@pytest.mark.slow
 def test_moe_train_step_converges():
     """Loss (CE + aux) decreases over a few steps on tiny_moe."""
     from llm_fine_tune_distributed_tpu.parallel.freeze import trainable_mask
@@ -299,6 +300,7 @@ def test_pipeline_moe_matches_plain(eight_devices):
     np.testing.assert_allclose(float(aux_pipe), np.mean(per_mb), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_dpo_moe_train_step_converges():
     """DPO on tiny_moe: the policy's router aux joins the train objective
     (layer-mean scale) and rewards_accuracy climbs over a few steps."""

@@ -179,3 +179,45 @@ def test_speculative_request_field(server):
     # non-speculative requests carry no speculative block
     with post({"question": "water?", "max_new_tokens": 4, "greedy": True}) as r:
         assert "speculative" not in json.loads(r.read())
+
+
+def test_stream_sse(server):
+    """POST /v1/stream: SSE events with text deltas whose concatenation
+    equals the non-streamed answer for the same greedy request."""
+    body = {"question": "How many cups in a gallon?", "max_new_tokens": 8, "greedy": True}
+    req = urllib.request.Request(
+        f"{server}/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        answer = json.loads(r.read())["answer"]
+
+    sreq = urllib.request.Request(
+        f"{server}/v1/stream", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(sreq, timeout=120) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = r.read().decode()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in raw.splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events and events[-1].get("done") is True
+    text = "".join(e.get("delta", "") for e in events)
+    # decode_reply strips; the streamed deltas carry the raw decode
+    assert text.strip() == answer
+    assert events[-1]["n_tokens"] >= 1
+
+
+def test_stream_bad_request(server):
+    req = urllib.request.Request(
+        f"{server}/v1/stream", data=b"{}",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400

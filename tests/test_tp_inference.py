@@ -93,6 +93,7 @@ def test_tp_batched_ragged(setup):
     assert sharded.generate_batch(prompts, cfg) == solo.generate_batch(prompts, cfg)
 
 
+@pytest.mark.slow
 def test_moe_tp_ep_decode_matches_single_device():
     """Mixtral-style serving: a tensor x expert inference mesh decodes
     identically to single-device (expert weights shard over `expert`,
