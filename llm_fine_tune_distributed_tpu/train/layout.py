@@ -1,0 +1,235 @@
+"""Cross-layout checkpoint resume: pipe ⇄ flat mesh resizes.
+
+A pipe>1 mesh stores layer params STACKED (``model/layers/@stacked/<rest>``
+leaves of shape ``[L, ...]`` — parallel/pipeline.py) while flat meshes store
+per-layer keys, so an Orbax checkpoint written under one ``MESH_PIPE``
+cannot restore directly under another. Elastic resizes (16 chips → 8, pipe
+on → off after an HBM re-plan) would otherwise force export + fresh start,
+losing the optimizer moments and the schedule position.
+
+This module makes the resume exact instead:
+
+1. build an ABSTRACT TrainState in the checkpoint's (alternate) layout —
+   param shapes derived from the current state by stacking/unstacking, the
+   optimizer-state structure from ``jax.eval_shape(optimizer.init, ...)``
+   (same optimizer config ⇒ same saved structure);
+2. restore into it (replicated on the current mesh);
+3. transform every param-keyed dict in the tree — trainable, frozen, and
+   the Adam moment dicts inside the optax state — to the current layout
+   with the SAME stack/unstack used at save time, then place per the
+   current sharding rules.
+
+Moment exactness: a flat checkpoint carries moments only for its trainable
+leaves (e.g. the last-2 layers under ``last_n_and_head``); stacking fills
+the frozen layers' moment slices with zeros — bit-identical to what a
+fresh pipe run would have accumulated there, since the per-layer gradient
+mask zeroes those layers' grads and updates. The reverse direction slices
+the stacked moments and keeps exactly the flat-trainable keys.
+
+The reference has no counterpart (its restart semantics are
+restart-from-scratch; SURVEY.md §5.4) — this is TPU-native beyond-parity,
+enabled by the functional state being a plain pytree.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+    STACKED_PREFIX,
+    stack_flat_layer_leaves,
+    unstack_flat_layer_leaves,
+)
+
+_LAYER_KEY = re.compile(r"^model/layers/(\d+)/(.+)$")
+
+
+def _is_param_dict(node) -> bool:
+    """A flat param-keyed dict (trainable/frozen/moment dicts all share the
+    ``model/...`` / ``lm_head/...`` key space)."""
+    return (
+        isinstance(node, dict)
+        and bool(node)
+        and all(isinstance(k, str) for k in node)
+        and any(k.startswith(("model/", "lm_head/")) for k in node)
+    )
+
+
+def map_param_dicts(tree, fn):
+    """Apply ``fn`` to every flat param-keyed dict inside an arbitrary
+    pytree (TrainState fields, optax NamedTuple chains, ...)."""
+    if _is_param_dict(tree):
+        return fn(tree)
+    if isinstance(tree, dict):
+        return {k: map_param_dicts(v, fn) for k, v in tree.items()}
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # NamedTuple
+        return type(tree)(*(map_param_dicts(v, fn) for v in tree))
+    if isinstance(tree, (tuple, list)):
+        return type(tree)(map_param_dicts(v, fn) for v in tree)
+    return tree
+
+
+def unstack_param_dict(d: Dict, num_layers: int) -> Dict:
+    """Stacked-layout dict -> flat layout (works on arrays AND
+    ShapeDtypeStructs: abstract leaves just split their leading dim)."""
+    out = {}
+    for k, v in d.items():
+        if not k.startswith(STACKED_PREFIX):
+            out[k] = v
+            continue
+        rest = k[len(STACKED_PREFIX):]
+        for i in range(num_layers):
+            if isinstance(v, jax.ShapeDtypeStruct):
+                out[f"model/layers/{i}/{rest}"] = jax.ShapeDtypeStruct(
+                    v.shape[1:], v.dtype, sharding=getattr(v, "sharding", None)
+                )
+            else:
+                out[f"model/layers/{i}/{rest}"] = v[i]
+    return out
+
+
+def stack_param_dict(d: Dict, num_layers: int) -> Dict:
+    """Flat-layout dict -> stacked layout. Layer groups PRESENT for only a
+    subset of layers (flat moment dicts under partial freezing) fill the
+    missing layers with zeros — exactly the moments a pipe run accumulates
+    for masked (frozen) layers."""
+    groups: Dict[str, Dict[int, object]] = {}
+    out = {}
+    for k, v in d.items():
+        m = _LAYER_KEY.match(k)
+        if m is None:
+            out[k] = v
+        else:
+            groups.setdefault(m.group(2), {})[int(m.group(1))] = v
+    for rest, by_layer in groups.items():
+        template = next(iter(by_layer.values()))
+        leaves = [
+            by_layer.get(i, jnp.zeros(template.shape, template.dtype))
+            for i in range(num_layers)
+        ]
+        out[STACKED_PREFIX + rest] = jnp.stack(leaves)
+    return out
+
+
+def restrict_keys(d: Dict, keys) -> Dict:
+    """Keep only ``keys`` (current-layout membership) — used after a layout
+    transform so moment dicts carry exactly the current trainable set."""
+    keys = set(keys)
+    return {k: v for k, v in d.items() if k in keys}
+
+
+def alternate_abstract_state(state, optimizer, flat_mask: Dict, num_layers: int, mesh):
+    """Abstract TrainState in the OTHER layout (the checkpoint's), with
+    replicated shardings on the current mesh — the restore target.
+
+    ``state`` is the current TrainState; whether it is stacked decides the
+    direction. Trainable/frozen membership in the alternate layout follows
+    ``flat_mask`` (flat layout) or build_pipeline_state_leaves' group rule
+    (stacked layout), matching what a trainer RUNNING in that layout saves.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from llm_fine_tune_distributed_tpu.train.state import TrainState
+
+    rep = NamedSharding(mesh, P())
+
+    def abstract(v):
+        return jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=rep)
+
+    currently_stacked = any(k.startswith(STACKED_PREFIX) for k in state.trainable)
+    merged = {**state.trainable, **state.frozen}
+    if currently_stacked:
+        flat = unstack_param_dict({k: abstract(v) for k, v in merged.items()}, num_layers)
+        # re-dtype: flat trainable carries the trainable (master) dtype, flat
+        # frozen the frozen dtype — derive from whichever current leaf the
+        # flat key descends from (dtypes survive both transforms unchanged)
+        alt_trainable = {k: v for k, v in flat.items() if flat_mask.get(k, False)}
+        alt_frozen = {k: v for k, v in flat.items() if not flat_mask.get(k, False)}
+    else:
+        tr, fr = {}, {}
+        from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+            build_pipeline_state_leaves,
+        )
+
+        tr, fr, _ = jax.eval_shape(
+            lambda t, f: build_pipeline_state_leaves(t, f, flat_mask, num_layers),
+            {k: abstract(v) for k, v in state.trainable.items()},
+            {k: abstract(v) for k, v in state.frozen.items()},
+        )
+        alt_trainable = {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=rep)
+            for k, v in tr.items()
+        }
+        alt_frozen = {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=rep)
+            for k, v in fr.items()
+        }
+
+    opt_shapes = jax.eval_shape(optimizer.init, alt_trainable)
+    opt_abstract = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep), opt_shapes
+    )
+    return TrainState(
+        step=jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+        trainable=alt_trainable,
+        frozen=alt_frozen,
+        opt_state=opt_abstract,
+    )
+
+
+def adopt_layout(restored, current_state, flat_mask: Dict, num_layers: int):
+    """Transform a restored alternate-layout TrainState into the CURRENT
+    layout and place every leaf on the current state's shardings. Returns a
+    TrainState structurally identical to ``current_state`` with the
+    checkpoint's values."""
+    target_stacked = any(k.startswith(STACKED_PREFIX) for k in current_state.trainable)
+
+    merged = {**restored.trainable, **restored.frozen}
+    if target_stacked:
+        merged = stack_param_dict(merged, num_layers)
+    else:
+        merged = unstack_flat_layer_leaves_compat(merged)
+
+    new_trainable = restrict_keys(merged, current_state.trainable)
+    new_frozen = restrict_keys(merged, current_state.frozen)
+    missing = (set(current_state.trainable) - set(new_trainable)) | (
+        set(current_state.frozen) - set(new_frozen)
+    )
+    if missing:
+        raise RuntimeError(
+            f"cross-layout resume: checkpoint lacks leaves {sorted(missing)[:5]}..."
+        )
+
+    def moments(d):
+        out = (
+            stack_param_dict(d, num_layers)
+            if target_stacked
+            else unstack_flat_layer_leaves_compat(d)
+        )
+        return restrict_keys(out, current_state.trainable)
+
+    new_opt = map_param_dicts(restored.opt_state, moments)
+
+    def place(new, cur):
+        return jax.tree.map(
+            lambda v, c: jax.device_put(v, c.sharding), new, cur
+        )
+
+    return current_state.replace(
+        step=jax.device_put(restored.step, current_state.step.sharding),
+        trainable=place(new_trainable, current_state.trainable),
+        frozen=place(new_frozen, current_state.frozen),
+        opt_state=place(new_opt, current_state.opt_state),
+    )
+
+
+def unstack_flat_layer_leaves_compat(d: Dict) -> Dict:
+    """unstack_flat_layer_leaves, tolerant of non-stacked dicts."""
+    if any(k.startswith(STACKED_PREFIX) for k in d):
+        return unstack_flat_layer_leaves(d)
+    return dict(d)

@@ -289,6 +289,11 @@ class SFTTrainer:
             self._validate_pipeline_config()
 
         trainable, frozen = split_by_mask(params, mask)
+        from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+        # kept for cross-layout checkpoint resume (train/layout.py): the
+        # per-leaf mask decides flat-layout trainable membership
+        self._flat_mask = flatten_dict(mask)
         if self._pipe_size > 1:
             # Pipeline state representation: per-layer block leaves stacked
             # [num_layers, ...] and sharded over `pipe` (parallel/pipeline.py),
@@ -296,10 +301,9 @@ class SFTTrainer:
             from llm_fine_tune_distributed_tpu.parallel.pipeline import (
                 build_pipeline_state_leaves,
             )
-            from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
 
             trainable, frozen, self._layer_vec = build_pipeline_state_leaves(
-                trainable, frozen, flatten_dict(mask), mc.num_layers
+                trainable, frozen, self._flat_mask, mc.num_layers
             )
         del params
         param_dtype = str_to_dtype(cfg.param_dtype)
@@ -845,23 +849,48 @@ class SFTTrainer:
         try:
             self.state = ckpt.restore(step, abstract)
         except Exception as e:
-            # The most common tree mismatch is a mesh change across resume:
+            # Tree mismatch usually means a mesh-layout change across resume:
             # pipe>1 checkpoints store layer params stacked under
-            # model/layers/@stacked/ while flat meshes store per-layer keys,
-            # so a checkpoint written under one MESH_PIPE cannot be restored
-            # under another. Name that instead of leaking a raw Orbax error.
+            # model/layers/@stacked/ while flat meshes store per-layer keys.
+            # Cross-layout resume (train/layout.py) restores the checkpoint
+            # in ITS layout and transforms params + optimizer moments to the
+            # current one — an exact elastic resize.
+            from llm_fine_tune_distributed_tpu.train.layout import (
+                adopt_layout,
+                alternate_abstract_state,
+            )
+
             cur = (
                 "stacked (pipe>1)"
                 if any("@stacked" in k for k in self.state.trainable)
                 else "flat (pipe=1)"
             )
-            raise RuntimeError(
-                f"failed to restore checkpoint step {step} into the current "
-                f"state layout [{cur}, MESH_PIPE={getattr(self, '_pipe_size', 1)}]. "
-                "If the checkpoint was written under a different MESH_PIPE, "
-                "resume with the original mesh, or export final artifacts "
-                "from the original mesh and start a new run from them."
-            ) from e
+            try:
+                alt = alternate_abstract_state(
+                    self.state, self.optimizer, self._flat_mask,
+                    self.model_config.num_layers, self.mesh,
+                )
+                restored = ckpt.restore(step, alt)
+                self.state = adopt_layout(
+                    restored, self.state, self._flat_mask,
+                    self.model_config.num_layers,
+                )
+                if is_primary_host():
+                    print(
+                        f"Cross-layout resume: checkpoint step {step} "
+                        f"restored from the alternate mesh layout into "
+                        f"[{cur}, MESH_PIPE={getattr(self, '_pipe_size', 1)}] "
+                        "(params + optimizer moments transformed exactly)"
+                    )
+            except Exception:
+                raise RuntimeError(
+                    f"failed to restore checkpoint step {step} into the "
+                    f"current state layout [{cur}, MESH_PIPE="
+                    f"{getattr(self, '_pipe_size', 1)}] or its pipe/flat "
+                    "alternate. If the checkpoint was written under a "
+                    "different mesh family, resume with the original mesh, "
+                    "or export final artifacts and start a new run from them."
+                ) from e
         resumed_step = int(self.state.step)
         if is_primary_host():
             print(f"Resumed from checkpoint step {resumed_step}")

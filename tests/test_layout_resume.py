@@ -1,0 +1,88 @@
+"""Cross-layout checkpoint resume (train/layout.py): a run checkpointed on a
+flat mesh resumes on a pipe mesh and vice versa, with params AND optimizer
+moments transformed exactly (elastic resize — beyond the reference's
+restart-from-scratch semantics, SURVEY.md §5.4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from llm_fine_tune_distributed_tpu.config import MeshConfig
+from llm_fine_tune_distributed_tpu.parallel.pipeline import (
+    STACKED_PREFIX,
+    unstack_flat_layer_leaves,
+)
+
+from tests.test_train_e2e import make_config, qa_parquet  # noqa: F401 (fixture)
+
+
+def _flat_params(state):
+    """Current state's merged params in flat per-layer keying (host numpy)."""
+    merged = {**state.trainable, **state.frozen}
+    if any(k.startswith(STACKED_PREFIX) for k in merged):
+        merged = unstack_flat_layer_leaves(
+            {k: np.asarray(v) for k, v in merged.items()}
+        )
+    return {k: np.asarray(v) for k, v in merged.items()}
+
+
+def _run(cfg):
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    trainer = SFTTrainer(cfg)
+    trainer.train()
+    return trainer
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("first_pipe,second_pipe", [(1, 2), (2, 1)])
+def test_cross_layout_resume(qa_parquet, tmp_path, first_pipe, second_pipe):  # noqa: F811
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    out = tmp_path / f"xresume_{first_pipe}_{second_pipe}"
+
+    def mesh(pipe):
+        return MeshConfig(data=1, fsdp=2, tensor=1, seq=1, pipe=pipe)
+
+    cfg1 = make_config(
+        out, data_dir, dataset_file,
+        epochs=1, save_steps=5, eval_steps=100, mesh=mesh(first_pipe),
+    )
+    t1 = _run(cfg1)
+    params_before = _flat_params(t1.state)
+    steps_done = int(jax.device_get(t1.state.step))
+    assert steps_done > 0
+
+    # resume the SAME output dir under the other layout
+    cfg2 = make_config(
+        out, data_dir, dataset_file,
+        epochs=2, save_steps=100, eval_steps=100, mesh=mesh(second_pipe),
+        resume_from_checkpoint="latest",
+    )
+    t2 = SFTTrainer(cfg2)
+    # _prepare_* ran in __init__; drive the resume path via train()
+    # but first verify the transformed state BEFORE further steps by
+    # resuming manually:
+    from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+
+    ckpt = CheckpointManager(os.path.join(str(out), "checkpoints"))
+    resumed_step = t2._resume(ckpt)
+    # the checkpoint rotation keeps the last saves; the resumed step is one
+    # of them (<= steps at end of run 1)
+    assert 0 < resumed_step <= steps_done
+    params_after = _flat_params(t2.state)
+    assert set(params_after) == set(params_before)
+    if resumed_step == steps_done:
+        for k in params_before:
+            np.testing.assert_array_equal(
+                params_before[k], params_after[k], err_msg=k
+            )
+
+    # and training continues from there without blowing up
+    summary = t2.train()
+    assert np.isfinite(summary["final_train_loss"])
