@@ -38,10 +38,16 @@ def run_ask_cli(
     parser.add_argument("--greedy", action="store_true", help="disable sampling")
     parser.add_argument(
         "--speculative", type=int, default=0, metavar="K",
-        help="prompt-lookup speculative decoding with K drafts/step "
-        "(greedy verifies by exact match; sampled by rejection sampling, "
-        "keeping the output distribution; pays off when answers quote "
-        "the context)",
+        help="speculative decoding with K drafts/step (greedy verifies by "
+        "exact match; sampled by rejection sampling, keeping the output "
+        "distribution). Drafts come from prompt-lookup (default — pays off "
+        "when answers quote the context) or from a small draft MODEL when "
+        "--draft-dir is set (pays off on any text)",
+    )
+    parser.add_argument(
+        "--draft-dir", default=None, metavar="DIR",
+        help="model directory of a SMALL same-vocab draft model for "
+        "--speculative (e.g. a SmolLM2-135M beside a 3B target)",
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
@@ -96,7 +102,7 @@ def run_ask_cli(
         serve(
             args.model_dir, host=args.host, port=args.port,
             quantize=args.quantize, template_kwargs=template_kwargs,
-            tp=args.tp,
+            tp=args.tp, draft_dir=args.draft_dir,
         )
         return 0
     if not question:
@@ -122,7 +128,14 @@ def run_ask_cli(
 
         mesh = make_tp_mesh(args.tp)
         print(f"Tensor-parallel decode over {args.tp} devices")
-    generator = Generator(params, model_config, tokenizer, mesh=mesh)
+    draft_kwargs = {}
+    if args.draft_dir:
+        if not args.speculative:
+            parser.error("--draft-dir requires --speculative K")
+        draft_params, draft_config = load_model_dir(args.draft_dir)
+        draft_kwargs = {"draft_params": draft_params, "draft_config": draft_config}
+        print(f"Draft model for speculation: {args.draft_dir}")
+    generator = Generator(params, model_config, tokenizer, mesh=mesh, **draft_kwargs)
 
     gen = GenerationConfig(
         max_new_tokens=args.max_new_tokens,

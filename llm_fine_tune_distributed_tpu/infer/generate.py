@@ -71,10 +71,25 @@ class Generator:
         compute_dtype=jnp.bfloat16,
         eos_token_ids: Optional[Sequence[int]] = None,
         mesh=None,
+        draft_params=None,
+        draft_config: Optional[ModelConfig] = None,
     ):
+        """``draft_params``/``draft_config``: an optional SMALL model sharing
+        this tokenizer's vocab. With both set and
+        ``GenerationConfig.speculative_lookup > 0``, speculation drafts with
+        the draft MODEL instead of prompt-lookup — the draft generalizes
+        beyond repetition-heavy outputs (prompt-lookup's limit), at the cost
+        of running the small model K steps per verify."""
         self.mesh = mesh
         self._act_sharding = None
         self._multihost = False
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError("draft_params and draft_config come together")
+        if draft_config is not None and draft_config.vocab_size != model_config.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_config.vocab_size} != target vocab "
+                f"{model_config.vocab_size} — speculation verifies token ids"
+            )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -84,11 +99,15 @@ class Generator:
                 d.process_index != jax.process_index() for d in mesh.devices.flat
             )
             params = shard_params(params, mesh)
+            if draft_params is not None:
+                draft_params = shard_params(draft_params, mesh)
             # batch-1 decode activations are tiny: keep them replicated and
             # let the weight shardings drive the per-block psums. Passing
             # the sharding also hands `forward` the mesh (embed/unembed
             # vocab-sharded lookups, MoE expert dispatch).
             self._act_sharding = NamedSharding(mesh, P())
+        self._draft_params = draft_params
+        self._draft_config = draft_config
         self.params = params
         self.config = model_config
         self.tokenizer = tokenizer
@@ -186,8 +205,19 @@ class Generator:
 
         return run
 
-    def _build_spec(self, batch: int, prompt_bucket: int, gen: GenerationConfig):
-        """Compile the prompt-lookup speculative decoder (any batch size).
+    def _build_spec(
+        self, batch: int, prompt_bucket: int, gen: GenerationConfig,
+        with_draft: bool = False,
+    ):
+        """Compile the speculative decoder (any batch size).
+
+        ``with_draft=False``: prompt-lookup proposals (bigram match in each
+        row's own context — zero extra model cost, pays off on
+        repetition-heavy outputs). ``with_draft=True``: DRAFT-MODEL
+        proposals (``draft_params``/``draft_config`` from the constructor) —
+        K greedy tokens from the small model per step, which speculates on
+        any text at the cost of K small forwards. Verification is identical
+        for both sources, so the output guarantees below hold unchanged.
 
         Each step feeds every row's ``[cur, d_1..d_K]`` (K =
         ``gen.speculative_lookup`` drafts found by matching that row's newest
@@ -222,6 +252,7 @@ class Generator:
         mc = self.config
         dtype = self.compute_dtype
         mesh, act = self.mesh, self._act_sharding
+        dmc = self._draft_config if with_draft else None
         K = gen.speculative_lookup
         max_new = gen.max_new_tokens
         buf_len = prompt_bucket + max_new + K + 1
@@ -230,8 +261,11 @@ class Generator:
         def is_eos(tok):
             return jnp.isin(tok, eos) if eos is not None else jnp.zeros_like(tok, bool)
 
-        @jax.jit
-        def run(params, prompt_ids, prompt_lens, rng):
+        import dataclasses
+
+        greedy_gen = dataclasses.replace(gen, do_sample=False)
+
+        def _run(params, dparams, prompt_ids, prompt_lens, rng):
             b, pb = prompt_ids.shape
             rows = jnp.arange(b)
             cache = init_cache(mc, b, buf_len, dtype=dtype)
@@ -244,6 +278,19 @@ class Generator:
                 hidden, (prompt_lens - 1)[:, None, None], axis=1
             )[:, 0]
             logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
+
+            if dmc is not None:
+                # the draft model sees the full prompt too; its cache stays
+                # position-synced with accepted history via the re-ingest
+                # window each step
+                dcache = init_cache(dmc, b, buf_len, dtype=dtype)
+                _, dcache = forward(
+                    dparams, prompt_ids, dmc, cache=dcache, cache_pos=0,
+                    compute_dtype=dtype, output_hidden=True,
+                    activation_sharding=act,
+                )
+            else:
+                dcache = jnp.zeros((), jnp.int32)  # placeholder carry slot
 
             valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
             safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
@@ -262,13 +309,9 @@ class Generator:
             done = is_eos(first)
             n_gen = jnp.ones((b,), jnp.int32)
 
-            def body(c):
-                n_gen, cache, ids_buf, seen, done, n_steps, row_steps, rng = c
-                pos = prompt_lens + n_gen  # [b] position of each next token
-                alive = (n_gen < max_new) & ~done
-
-                # --- draft per row: most recent earlier occurrence of that
-                # row's newest bigram in its own context
+            def lookup_draft(ids_buf, pos, dcache, seen):
+                """Prompt-lookup proposal: continuation of the most recent
+                earlier occurrence of each row's newest bigram."""
                 l0 = ids_buf[rows, pos - 2]
                 l1 = ids_buf[rows, pos - 1]
                 j = jnp.arange(buf_len - 1)
@@ -284,6 +327,71 @@ class Generator:
                 draft = jax.vmap(
                     lambda buf, s: jax.lax.dynamic_slice(buf, (s,), (K,))
                 )(ids_buf, start)  # [b, K]
+                return draft, dcache
+
+            def model_draft(ids_buf, pos, dcache, seen):
+                """Draft-model proposal: K continuations from the small
+                model, drawn with the TARGET's greedy sampler semantics
+                (repetition penalty over a speculatively-updated seen set) —
+                so a perfect draft achieves 100% acceptance. A (K+1)-wide
+                re-ingest window first replays the ACCEPTED tokens since the
+                last step into the draft cache (overwriting any
+                rejected-draft K/V — same slot==position rollback the
+                target uses), and its last logits give d_0."""
+                start = jnp.maximum(pos - (K + 1), 0)
+                win = jax.vmap(
+                    lambda buf, s: jax.lax.dynamic_slice(buf, (s,), (K + 1,))
+                )(ids_buf, start)
+                dh, dcache = forward(
+                    dparams, win, dmc, cache=dcache, cache_pos=start,
+                    compute_dtype=dtype, output_hidden=True,
+                    activation_sharding=act,
+                )
+                idx = pos - 1 - start  # window index of token pos-1
+                cur_h = jnp.take_along_axis(dh, idx[:, None, None], axis=1)[:, 0]
+                spec_seen = seen
+
+                def propose(logits, spec_seen):
+                    # deterministic proposal even under sampled verify (the
+                    # rejection sampler assumes a deterministic proposal,
+                    # like prompt-lookup): greedy with the target's penalty
+                    d = sample_token(None, logits, spec_seen, greedy_gen)
+                    return d, spec_seen.at[rows, d].set(True)
+
+                d0, spec_seen = propose(
+                    unembed(dparams, cur_h, dmc, compute_dtype=dtype, mesh=mesh),
+                    spec_seen,
+                )
+                dbuf = jnp.zeros((b, K), jnp.int32).at[:, 0].set(d0)
+
+                def dstep(i, c):
+                    dcache, dbuf, spec_seen = c
+                    prev = dbuf[rows, i - 1]
+                    dh, dcache = forward(
+                        dparams, prev[:, None], dmc, cache=dcache,
+                        cache_pos=pos + i - 1, compute_dtype=dtype,
+                        output_hidden=True, activation_sharding=act,
+                    )
+                    nxt, spec_seen = propose(
+                        unembed(dparams, dh[:, -1], dmc, compute_dtype=dtype, mesh=mesh),
+                        spec_seen,
+                    )
+                    return (dcache, dbuf.at[:, i].set(nxt), spec_seen)
+
+                if K > 1:
+                    dcache, dbuf, _ = jax.lax.fori_loop(
+                        1, K, dstep, (dcache, dbuf, spec_seen)
+                    )
+                return dbuf, dcache
+
+            draft_fn = model_draft if dmc is not None else lookup_draft
+
+            def body(c):
+                n_gen, cache, dcache, ids_buf, seen, done, n_steps, row_steps, rng = c
+                pos = prompt_lens + n_gen  # [b] position of each next token
+                alive = (n_gen < max_new) & ~done
+
+                draft, dcache = draft_fn(ids_buf, pos, dcache, seen)
 
                 cur = ids_buf[rows, pos - 1]
                 inputs = jnp.concatenate([cur[:, None], draft], axis=1)  # [b, K+1]
@@ -331,18 +439,18 @@ class Generator:
                     (seen, ids_buf, jnp.zeros((b,), jnp.int32), alive, done, rng),
                 )
                 return (
-                    n_gen + n_acc, new_cache, ids_buf, seen, done,
+                    n_gen + n_acc, new_cache, dcache, ids_buf, seen, done,
                     n_steps + 1, row_steps + alive.astype(jnp.int32), rng,
                 )
 
             def cond(c):
-                n_gen, _, _, _, done, _, _, _ = c
+                n_gen, _, _, _, _, done, _, _, _ = c
                 return jnp.any((n_gen < max_new) & ~done)
 
-            n_gen, cache, ids_buf, seen, done, n_steps, row_steps, rng = (
+            n_gen, cache, dcache, ids_buf, seen, done, n_steps, row_steps, rng = (
                 jax.lax.while_loop(
                     cond, body,
-                    (n_gen, cache, ids_buf, seen, done, jnp.int32(1),
+                    (n_gen, cache, dcache, ids_buf, seen, done, jnp.int32(1),
                      jnp.zeros((b,), jnp.int32), rng),
                 )
             )
@@ -354,7 +462,13 @@ class Generator:
             # row's accepted drafts total n_gen - 1 - row_steps
             return out, n_gen, n_steps, row_steps
 
-        return run
+        if with_draft:
+            return jax.jit(_run)
+        return jax.jit(
+            lambda params, prompt_ids, prompt_lens, rng: _run(
+                params, None, prompt_ids, prompt_lens, rng
+            )
+        )
 
     def _build_stream(self, prompt_bucket: int, gen: GenerationConfig, chunk: int):
         """Compile the STREAMING decode pair: a prefill program plus a
@@ -496,14 +610,17 @@ class Generator:
             raise ValueError("generate_batch needs >= 1 non-empty prompt")
         longest = max(len(p) for p in prompts)
         bucket = -(-longest // _PROMPT_BUCKET) * _PROMPT_BUCKET
-        # prompt-lookup speculation, any batch size: rows draft from their
-        # own contexts and desynchronize freely; greedy verifies by exact
-        # match, sampled by rejection sampling
+        # speculation, any batch size: rows draft (from their own contexts,
+        # or via the attached draft model) and desynchronize freely; greedy
+        # verifies by exact match, sampled by rejection sampling
         speculate = gen.speculative_lookup > 0
+        with_draft = speculate and self._draft_params is not None
         if speculate:
-            key = ("spec", len(prompts), bucket, gen)
+            key = ("specd" if with_draft else "spec", len(prompts), bucket, gen)
             if key not in self._jit_cache:
-                self._jit_cache[key] = self._build_spec(len(prompts), bucket, gen)
+                self._jit_cache[key] = self._build_spec(
+                    len(prompts), bucket, gen, with_draft=with_draft
+                )
         else:
             key = ("batch", len(prompts), bucket, gen)
             if key not in self._jit_cache:
@@ -534,7 +651,10 @@ class Generator:
             )
         else:
             inputs = (jnp.asarray(padded), jnp.asarray(lens), key)
-        res = run(self.params, *inputs)
+        if with_draft:
+            res = run(self.params, self._draft_params, *inputs)
+        else:
+            res = run(self.params, *inputs)
         out, n = res[0], res[1]
         if speculate:
             # acceptance telemetry: prefill emitted 1 per row and each of a

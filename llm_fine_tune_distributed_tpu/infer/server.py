@@ -38,6 +38,7 @@ def serve(
     template_kwargs: Optional[dict] = None,
     request_timeout_s: Optional[float] = 600.0,
     tp: int = 1,
+    draft_dir: Optional[str] = None,
 ) -> None:
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
@@ -65,7 +66,15 @@ def serve(
 
         mesh = make_tp_mesh(tp)
         print(f"Tensor-parallel decode over {tp} devices")
-    generator = Generator(params, model_config, tokenizer, mesh=mesh)
+    draft_kwargs = {}
+    if draft_dir:
+        # a small same-vocab model turns "speculative": K requests into
+        # draft-model speculation (Generator docstring); prompt-lookup
+        # remains the draftless fallback behavior when unset
+        draft_params, draft_config = load_model_dir(draft_dir)
+        draft_kwargs = {"draft_params": draft_params, "draft_config": draft_config}
+        print(f"Draft model for speculation: {draft_dir}")
+    generator = Generator(params, model_config, tokenizer, mesh=mesh, **draft_kwargs)
     coordinator = None
     engine_target = generator
     if getattr(generator, "_multihost", False):

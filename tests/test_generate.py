@@ -387,3 +387,63 @@ def test_generate_stream_stops_at_eos(tiny_setup):
     # be earlier than index 4 if the greedy sequence repeats tokens)
     assert streamed == plain[: plain.index(eos_tok)]
     assert eos_tok not in streamed
+
+
+@pytest.mark.slow
+def test_draft_model_speculation_exact_and_accepting(tiny_setup):
+    """Draft-MODEL speculation: greedy output is exactly the plain greedy
+    sequence regardless of the draft's quality; a perfect draft (the target
+    itself) accepts every proposal, finishing in far fewer sequential
+    forwards than tokens."""
+    mc, params, tok = tiny_setup
+    prompt = tok.encode("the quick brown fox")
+    plain_cfg = GenerationConfig(max_new_tokens=12, do_sample=False, repetition_penalty=1.1)
+    spec_cfg = GenerationConfig(
+        max_new_tokens=12, do_sample=False, repetition_penalty=1.1,
+        speculative_lookup=4,
+    )
+    base = Generator(params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[])
+    plain = base.generate_ids(prompt, plain_cfg)
+
+    # an unrelated (differently-initialized) draft: exactness must survive
+    bad_draft = init_params(jax.random.PRNGKey(9), mc, dtype=jnp.float32)
+    g_bad = Generator(
+        params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[],
+        draft_params=bad_draft, draft_config=mc,
+    )
+    assert g_bad.generate_ids(prompt, spec_cfg) == plain
+    assert g_bad.last_acceptance_rate is not None
+
+    # the target as its own draft: greedy proposals == greedy choices, so
+    # every draft is accepted and steps collapse. max_new=11 = 1 (prefill)
+    # + 2 steps x (1 + 4 drafts), so no draft is wasted on the max_new cap
+    # and the acceptance rate is exactly 1.
+    g_self = Generator(
+        params, mc, tok, compute_dtype=jnp.float32, eos_token_ids=[],
+        draft_params=params, draft_config=mc,
+    )
+    exact_cfg = GenerationConfig(
+        max_new_tokens=11, do_sample=False, repetition_penalty=1.1,
+        speculative_lookup=4,
+    )
+    plain11 = base.generate_ids(prompt, GenerationConfig(
+        max_new_tokens=11, do_sample=False, repetition_penalty=1.1,
+    ))
+    assert g_self.generate_ids(prompt, exact_cfg) == plain11
+    assert g_self.last_acceptance_rate == pytest.approx(1.0)
+    assert g_self.last_spec_steps == 1 + 2  # prefill + 2 fully-accepted steps
+
+    # sampled verify stays seeded-deterministic with a draft model
+    sampled = GenerationConfig(max_new_tokens=6, do_sample=True, speculative_lookup=3)
+    a = g_bad.generate_ids(prompt, sampled, seed=5)
+    assert a == g_bad.generate_ids(prompt, sampled, seed=5)
+    assert all(0 <= t < mc.vocab_size for t in a)
+
+
+def test_draft_model_validation():
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="come together"):
+        Generator(params, mc, ByteChatMLTokenizer(), draft_params=params)
