@@ -72,6 +72,9 @@ def run_ask_cli(
     parser.add_argument("--port", type=int, default=8080, help="--serve port")
     args = parser.parse_args(argv)
     question = " ".join(args.question)
+    if args.draft_dir and not args.speculative:
+        # validate BEFORE the (multi-GB) target model load
+        parser.error("--draft-dir requires --speculative K")
     if not args.model_dir or not os.path.isdir(args.model_dir):
         # reference exits with guidance when the artifact is missing
         # (ask_tuned_model.py:17-20)
@@ -130,8 +133,6 @@ def run_ask_cli(
         print(f"Tensor-parallel decode over {args.tp} devices")
     draft_kwargs = {}
     if args.draft_dir:
-        if not args.speculative:
-            parser.error("--draft-dir requires --speculative K")
         draft_params, draft_config = load_model_dir(args.draft_dir)
         draft_kwargs = {"draft_params": draft_params, "draft_config": draft_config}
         print(f"Draft model for speculation: {args.draft_dir}")

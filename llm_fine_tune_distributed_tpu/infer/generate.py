@@ -29,6 +29,33 @@ from llm_fine_tune_distributed_tpu.models.transformer import forward, init_cache
 _PROMPT_BUCKET = 256
 
 
+def _prompt_prefill(params, prompt_ids, prompt_lens, *, mc, dtype, act, mesh,
+                    buf_len, gen, rng):
+    """Shared prompt-ingest for every decode builder: cache init + prefill
+    forward + per-row last-position logits + seen-set init + first sampled
+    token. Returns ``(first [b], cache, seen [b, V], valid [b, pb], rng)``
+    — the single source for the padding/seen semantics all decode paths
+    must agree on."""
+    b, pb = prompt_ids.shape
+    rows = jnp.arange(b)
+    cache = init_cache(mc, b, buf_len, dtype=dtype)
+    hidden, cache = forward(
+        params, prompt_ids, mc, cache=cache, cache_pos=0,
+        compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+    )
+    last_h = jnp.take_along_axis(
+        hidden, (prompt_lens - 1)[:, None, None], axis=1
+    )[:, 0]
+    logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
+    valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
+    safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
+    seen = jnp.zeros((b, mc.vocab_size), bool).at[rows[:, None], safe_ids].set(True)
+    rng, sub = jax.random.split(rng)
+    first = sample_token(sub if gen.do_sample else None, logits0, seen, gen)
+    seen = seen.at[rows, first].set(True)
+    return first, cache, seen, valid, rng
+
+
 def make_tp_mesh(tp: int):
     """Tensor-parallel inference mesh over the first ``tp`` devices of the
     GLOBAL pool (the `--tp` flag of ask_tuned_model.py / smollm3-serve).
@@ -155,30 +182,14 @@ class Generator:
 
         @jax.jit
         def run(params, prompt_ids, prompt_lens, rng):
-            b, pb = prompt_ids.shape
-            cache = init_cache(mc, b, buf_len, dtype=dtype)
-
-            hidden, cache = forward(
-                params, prompt_ids, mc, cache=cache, cache_pos=0,
-                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+            b = prompt_ids.shape[0]
+            first, cache, seen, _, rng = _prompt_prefill(
+                params, prompt_ids, prompt_lens, mc=mc, dtype=dtype, act=act,
+                mesh=mesh, buf_len=buf_len, gen=gen, rng=rng,
             )
-            last_h = jnp.take_along_axis(
-                hidden, (prompt_lens - 1)[:, None, None], axis=1
-            )[:, 0]
-            logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
-
-            valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
-            safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
-            seen = jnp.zeros((b, mc.vocab_size), bool).at[
-                jnp.arange(b)[:, None], safe_ids
-            ].set(True)
-
-            rng, sub = jax.random.split(rng)
-            first = sample_token(sub, logits0, seen, gen)
             out = jnp.zeros((b, gen.max_new_tokens), jnp.int32)
             out = out.at[:, 0].set(first)
             done = jnp.isin(first, eos) if eos is not None else jnp.zeros((b,), bool)
-            seen = seen.at[jnp.arange(b), first].set(True)
 
             def cond(c):
                 t, _, _, _, done, _ = c
@@ -268,16 +279,10 @@ class Generator:
         def _run(params, dparams, prompt_ids, prompt_lens, rng):
             b, pb = prompt_ids.shape
             rows = jnp.arange(b)
-            cache = init_cache(mc, b, buf_len, dtype=dtype)
-
-            hidden, cache = forward(
-                params, prompt_ids, mc, cache=cache, cache_pos=0,
-                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+            first, cache, seen, valid, rng = _prompt_prefill(
+                params, prompt_ids, prompt_lens, mc=mc, dtype=dtype, act=act,
+                mesh=mesh, buf_len=buf_len, gen=gen, rng=rng,
             )
-            last_h = jnp.take_along_axis(
-                hidden, (prompt_lens - 1)[:, None, None], axis=1
-            )[:, 0]
-            logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
 
             if dmc is not None:
                 # the draft model sees the full prompt too; its cache stays
@@ -292,20 +297,10 @@ class Generator:
             else:
                 dcache = jnp.zeros((), jnp.int32)  # placeholder carry slot
 
-            valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
-            safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
-            seen = jnp.zeros((b, mc.vocab_size), bool).at[
-                rows[:, None], safe_ids
-            ].set(True)
-
             # per-row token history: prompt + generated, in logical positions
             ids_buf = jnp.zeros((b, buf_len), jnp.int32)
             ids_buf = ids_buf.at[:, :pb].set(jnp.where(valid, prompt_ids, 0))
-
-            rng, sub = jax.random.split(rng)
-            first = sample_token(sub if gen.do_sample else None, logits0, seen, gen)
             ids_buf = ids_buf.at[rows, prompt_lens].set(first)
-            seen = seen.at[rows, first].set(True)
             done = is_eos(first)
             n_gen = jnp.ones((b,), jnp.int32)
 
@@ -497,24 +492,10 @@ class Generator:
 
         @jax.jit
         def prefill(params, prompt_ids, prompt_lens, rng):
-            b, pb = prompt_ids.shape
-            cache = init_cache(mc, b, buf_len, dtype=dtype)
-            hidden, cache = forward(
-                params, prompt_ids, mc, cache=cache, cache_pos=0,
-                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+            first, cache, seen, _, rng = _prompt_prefill(
+                params, prompt_ids, prompt_lens, mc=mc, dtype=dtype, act=act,
+                mesh=mesh, buf_len=buf_len, gen=gen, rng=rng,
             )
-            last_h = jnp.take_along_axis(
-                hidden, (prompt_lens - 1)[:, None, None], axis=1
-            )[:, 0]
-            logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
-            valid = jnp.arange(pb)[None, :] < prompt_lens[:, None]
-            safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
-            seen = jnp.zeros((b, mc.vocab_size), bool).at[
-                jnp.arange(b)[:, None], safe_ids
-            ].set(True)
-            rng, sub = jax.random.split(rng)
-            first = sample_token(sub, logits0, seen, gen)
-            seen = seen.at[jnp.arange(b), first].set(True)
             return first, cache, seen, rng
 
         @jax.jit
@@ -559,6 +540,8 @@ class Generator:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("generate_stream needs a non-empty prompt")
+        if chunk < 1:
+            raise ValueError(f"stream chunk must be >= 1, got {chunk}")
         bucket = -(-len(prompt) // _PROMPT_BUCKET) * _PROMPT_BUCKET
         key = ("stream", bucket, gen, chunk)
         if key not in self._jit_cache:

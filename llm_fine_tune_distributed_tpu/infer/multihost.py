@@ -115,7 +115,12 @@ class MultihostCoordinator:
 
 
 def follow(generator) -> None:
-    """Follower loop for processes > 0: mirror every coordinator batch."""
+    """Follower loop for processes > 0: mirror every coordinator batch.
+
+    A failing mirrored batch is logged and the loop CONTINUES — the
+    coordinator-side engine survives per-batch errors (they surface as
+    HTTP 500s), and a follower that died instead would wedge every
+    subsequent request at the next broadcast."""
     while True:
         header = _broadcast(np.zeros((_HEADER_LEN,), np.int64), False)
         stop, batch, bucket, seed, cfg_len = (int(x) for x in header)
@@ -124,8 +129,11 @@ def follow(generator) -> None:
         padded = _broadcast(np.zeros((batch, bucket), np.int64), False)
         lens = _broadcast(np.zeros((batch,), np.int64), False)
         cfg_buf = _broadcast(np.zeros((_CFG_BUF,), np.uint8), False)
-        gen = _decode_cfg(cfg_buf, cfg_len)
-        prompts = [
-            [int(t) for t in padded[i, : int(lens[i])]] for i in range(batch)
-        ]
-        generator.generate_batch(prompts, gen, seed=seed)
+        try:
+            gen = _decode_cfg(cfg_buf, cfg_len)
+            prompts = [
+                [int(t) for t in padded[i, : int(lens[i])]] for i in range(batch)
+            ]
+            generator.generate_batch(prompts, gen, seed=seed)
+        except Exception as e:  # keep following; symmetry with engine 500s
+            print(f"[serve] follower batch failed: {e}", flush=True)

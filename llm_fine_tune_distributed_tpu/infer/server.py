@@ -136,22 +136,32 @@ def serve(
             if coordinator is not None:
                 self._send(501, {"error": "streaming unavailable in multi-host serving"})
                 return
-            gen_kwargs = {
-                k: cast(req[k])
-                for k, cast in self._FIELD_CASTS.items()
-                if k in req
-            }
-            if "greedy" in req:
-                gen_kwargs["do_sample"] = not req["greedy"]
-            gen = GenerationConfig(**gen_kwargs)
-            messages = [
-                {
-                    "role": "system",
-                    "content": req.get("system_prompt", WILDERNESS_EXPERT_SYSTEM_PROMPT),
-                },
-                {"role": "user", "content": req["question"]},
-            ]
-            prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
+            # everything fallible happens BEFORE headers go out, so clients
+            # get a 400 instead of a hung keep-alive connection
+            try:
+                gen_kwargs = {
+                    k: cast(req[k])
+                    for k, cast in self._FIELD_CASTS.items()
+                    if k in req
+                }
+                if "greedy" in req:
+                    gen_kwargs["do_sample"] = not req["greedy"]
+                gen = GenerationConfig(**gen_kwargs)
+                stream_chunk = int(req.get("stream_chunk", 8))
+                if stream_chunk < 1:
+                    raise ValueError(f"stream_chunk must be >= 1, got {stream_chunk}")
+                seed = int(req.get("seed", 0))
+                messages = [
+                    {
+                        "role": "system",
+                        "content": req.get("system_prompt", WILDERNESS_EXPERT_SYSTEM_PROMPT),
+                    },
+                    {"role": "user", "content": req["question"]},
+                ]
+                prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -164,8 +174,7 @@ def serve(
             ids_all, prev_text = [], ""
             try:
                 for piece in generator.generate_stream(
-                    prompt_ids, gen, seed=int(req.get("seed", 0)),
-                    chunk=int(req.get("stream_chunk", 8)),
+                    prompt_ids, gen, seed=seed, chunk=stream_chunk,
                 ):
                     ids_all.extend(piece)
                     text = generator.tokenizer.decode(

@@ -882,15 +882,16 @@ class SFTTrainer:
                         f"[{cur}, MESH_PIPE={getattr(self, '_pipe_size', 1)}] "
                         "(params + optimizer moments transformed exactly)"
                     )
-            except Exception:
+            except Exception as e2:
                 raise RuntimeError(
                     f"failed to restore checkpoint step {step} into the "
                     f"current state layout [{cur}, MESH_PIPE="
                     f"{getattr(self, '_pipe_size', 1)}] or its pipe/flat "
                     "alternate. If the checkpoint was written under a "
                     "different mesh family, resume with the original mesh, "
-                    "or export final artifacts and start a new run from them."
-                ) from e
+                    "or export final artifacts and start a new run from "
+                    f"them. (direct restore: {e})"
+                ) from e2
         resumed_step = int(self.state.step)
         if is_primary_host():
             print(f"Resumed from checkpoint step {resumed_step}")
