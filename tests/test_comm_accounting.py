@@ -343,3 +343,53 @@ def test_16_device_meshes_account_clean():
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "PROBE16 dpxfsdp OK" in proc.stdout
     assert "PROBE16 fsdpxtp OK" in proc.stdout
+
+
+def test_projection_math():
+    """project_step_time: compute term from the measured single-chip rate,
+    comm term from wire bytes over the link model, efficiencies consistent."""
+    from llm_fine_tune_distributed_tpu.observe.comm_accounting import (
+        Collective,
+        CommReport,
+    )
+    from llm_fine_tune_distributed_tpu.observe.scaling import project_step_time
+
+    # one FSDP all-gather of 90 GB wire -> exactly 1 s at the 90 GB/s ring
+    rep = CommReport([
+        Collective(
+            kind="all-gather", computation="main", result_bytes=0,
+            group_size=16, axes=("fsdp",), count=1,
+        )
+    ])
+    rep.collectives[0].result_bytes = int(90e9 * 16 / 15)  # wire = b*(g-1)/g
+    proj = project_step_time(
+        rep, {"fsdp": 16},
+        single_chip_samples_per_sec=10.0, samples_per_step=160,
+    )
+    assert proj.compute_s == pytest.approx(1.0)      # 160 / (10 x 16)
+    assert proj.exposed_comm_s == pytest.approx(1.0, rel=1e-6)
+    assert proj.step_s == pytest.approx(2.0)
+    assert proj.samples_per_sec == pytest.approx(80.0)
+    assert proj.scaling_efficiency == pytest.approx(0.5)
+
+    # full overlap hides all communication
+    proj_ovl = project_step_time(
+        rep, {"fsdp": 16},
+        single_chip_samples_per_sec=10.0, samples_per_step=160,
+        overlap_fraction=1.0,
+    )
+    assert proj_ovl.samples_per_sec == pytest.approx(160.0)
+
+    # a data axis marked as DCN uses the slow link
+    rep2 = CommReport([
+        Collective(
+            kind="collective-permute", computation="main",
+            result_bytes=int(6.25e9), group_size=2, axes=("data",), count=1,
+        )
+    ])
+    proj_dcn = project_step_time(
+        rep2, {"data": 16},
+        single_chip_samples_per_sec=10.0, samples_per_step=160,
+        dcn_axes=("data",),
+    )
+    assert proj_dcn.exposed_comm_s == pytest.approx(1.0, rel=1e-6)
