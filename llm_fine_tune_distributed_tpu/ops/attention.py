@@ -172,6 +172,22 @@ def attention(
         ):
             return ring_attention(q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal)
         impl = _seq_parallel_fallback("ring", q, mesh)
+    if impl == "ring_manual":
+        # The caller is ALREADY inside a shard_map that is manual over the
+        # "seq" axis (the pipeline schedule, pipe x ring composition):
+        # q/k/v here are one device's sequence CHUNKS, so dispatch straight
+        # to the local ring kernel — wrapping the global-view entry would
+        # illegally nest a manual "seq" shard_map.
+        from llm_fine_tune_distributed_tpu.parallel.ring_attention import (
+            _local_ring_attention,
+        )
+
+        if sliding_window is not None:
+            raise ValueError("ring attention has no sliding-window support")
+        return _local_ring_attention(
+            q, k, v, padding_mask,
+            axis_name="seq", axis_size=mesh.shape["seq"], causal=causal,
+        )
     if impl == "flash":
         # Pallas kernel requires TPU, no sliding window (falls back otherwise).
         from llm_fine_tune_distributed_tpu.ops.flash_attention import (

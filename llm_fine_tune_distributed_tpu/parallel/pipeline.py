@@ -45,11 +45,16 @@ volume for a v× smaller bubble — worth it only at large S; the mesh sizes
 this framework targets (pipe ≤ 8) prefer raising M (grad-accum) instead.
 
 Composes with LoRA/QLoRA (adapter leaves stack like any per-layer leaf; the
-all-frozen base groups stay out of the optimizer — build_pipeline_state_leaves)
-and with DPO (train/dpo.build_pipeline_dpo_train_step runs both DPO forwards
-as schedules). Scope bounds (raised loudly by the trainer): packing and
-sequence-parallel attention do not compose with the pipe axis yet — stages
-attend locally over full sequences.
+all-frozen base groups stay out of the optimizer — build_pipeline_state_leaves),
+with DPO (train/dpo.build_pipeline_dpo_train_step runs both DPO forwards as
+schedules), with expert parallelism (manual-subset shard_map; stacked experts
+shard over pipe AND expert), and with RING sequence parallelism
+(``attention_impl="ring"`` + a live seq axis: the schedule goes manual over
+seq and stages call the local ring kernel — long-context pipe runs). Scope
+bounds (raised loudly by the trainer): packing (no segment support in the
+schedule), ulysses (its all-to-all head re-partition doesn't run in the
+manual context), and ring x MoE (per-chunk routing would change capacity
+semantics).
 """
 
 from __future__ import annotations
@@ -105,6 +110,7 @@ def pipeline_forward(
     remat_blocks: bool = True,
     output_hidden: bool = False,
     return_aux: bool = False,
+    attention_impl: str = "xla",
 ):
     """Pipelined forward: logits for ``input_ids [M * mb, seq]``.
 
@@ -164,16 +170,34 @@ def pipeline_forward(
     uniform_rope = all(flags_list) or not any(flags_list)
     rope_flags = jnp.asarray(flags_list, jnp.bool_)
 
-    def run_stage(stage_layers, x, mask, stage_flags):
-        """Scan my L_local blocks over x [mb, seq, h]; returns (x, aux_sum)."""
+    # pipe x ring composition: a live seq axis + attention_impl="ring" makes
+    # the schedule manual over "seq" too; each device holds a sequence CHUNK
+    # and the stage compute calls the LOCAL ring kernel ("ring_manual" in
+    # ops/attention.py), rotating K/V over the seq axis per layer.
+    seq_parallel = attention_impl == "ring" and mesh.shape.get("seq", 1) > 1
+    if seq_parallel and config.num_experts > 0:
+        raise ValueError(
+            "pipe x ring does not compose with MoE: inside the manual-seq "
+            "schedule the router would see per-chunk token populations, "
+            "changing capacity semantics"
+        )
+    if seq_parallel and seq % mesh.shape["seq"]:
+        raise ValueError(
+            f"seq {seq} not divisible by the seq axis ({mesh.shape['seq']})"
+        )
+    stage_impl = "ring_manual" if seq_parallel else "xla"
+
+    def run_stage(stage_layers, x, mask, stage_flags, cos_l, sin_l):
+        """Scan my L_local blocks over x [mb, seq_local, h]."""
 
         def one_block(carry, args):
             h, aux = carry
             layer_params, flag = args
             h, _, layer_aux = _block(
-                layer_params, h, cos, sin, mask, None, None, None, 0,
-                config=config, layer_idx=0, attention_impl="xla",
+                layer_params, h, cos_l, sin_l, mask, None, None, None, 0,
+                config=config, layer_idx=0, attention_impl=stage_impl,
                 compute_dtype=compute_dtype,
+                mesh=mesh if seq_parallel else None,
                 rope_flag=None if uniform_rope else flag,
             )
             return (h, aux + layer_aux), None
@@ -192,6 +216,15 @@ def pipeline_forward(
         T = M + S - 1
         h_dim = embed_local.shape[-1]
         mb_local = ids_local.shape[1]
+        seq_local = ids_local.shape[2]
+        if seq_parallel:
+            # my sequence chunk's RoPE tables (cos/sin enter the manual
+            # context replicated at full length; positions are global)
+            s_off = jax.lax.axis_index("seq") * seq_local
+            cos_l = jax.lax.dynamic_slice_in_dim(cos, s_off, seq_local, axis=1)
+            sin_l = jax.lax.dynamic_slice_in_dim(sin, s_off, seq_local, axis=1)
+        else:
+            cos_l, sin_l = cos, sin
 
         def tick(carry, t):
             buf, aux_sum = carry  # [mb_local, seq, h] activation at my stage
@@ -211,7 +244,7 @@ def pipeline_forward(
             )
             # my microbatch's padding mask rides the same timetable
             mask = jax.lax.dynamic_index_in_dim(pm_local, m_safe, axis=0, keepdims=False)
-            y, aux_tick = run_stage(stacked_local, x_in, mask, flags_local)
+            y, aux_tick = run_stage(stacked_local, x_in, mask, flags_local, cos_l, sin_l)
             # mask bubble ticks so garbage never enters the ring (or the aux)
             valid = (m >= 0) & (m < M)
             y = jnp.where(valid, y, jnp.zeros_like(y))
@@ -226,7 +259,7 @@ def pipeline_forward(
 
         (_, aux_local), outs = jax.lax.scan(
             tick,
-            (jnp.zeros((mb_local, seq, h_dim), compute_dtype), jnp.float32(0.0)),
+            (jnp.zeros((mb_local, seq_local, h_dim), compute_dtype), jnp.float32(0.0)),
             jnp.arange(T),
         )
         # total router aux over every (stage, microbatch), averaged over
@@ -255,19 +288,26 @@ def pipeline_forward(
         a for a in ("data", "fsdp") if a in mesh.shape and mesh.shape[a] > 1
     )
     mb_spec = dp_axes if dp_axes else None
-    out_spec = P("pipe", mb_spec) if M % S == 0 else P(None, mb_spec)
+    seq_spec = "seq" if seq_parallel else None
+    out_spec = (
+        P("pipe", mb_spec, seq_spec) if M % S == 0 else P(None, mb_spec, seq_spec)
+    )
     # Manual only over the axes the schedule itself communicates on (pipe
     # ppermute/psum + the dp pmean); every other axis — EXPERT above all —
     # stays automatic, so stacked MoE leaves sharded [L->pipe, E->expert,...]
     # keep their expert-dim sharding inside the stage compute and GSPMD
     # partitions the dispatch/combine einsums over the expert axis exactly as
     # on a flat mesh (pipe x EP composition).
+    manual_axes = {"pipe", *dp_axes} | ({"seq"} if seq_parallel else set())
     outs, aux = shard_map(
         spmd,
         mesh=mesh,
-        in_specs=(P("pipe"), P(), P(None, mb_spec), P(None, mb_spec), P("pipe")),
+        in_specs=(
+            P("pipe"), P(),
+            P(None, mb_spec, seq_spec), P(None, mb_spec, seq_spec), P("pipe"),
+        ),
         out_specs=(out_spec, P()),
-        axis_names={"pipe", *dp_axes},
+        axis_names=manual_axes,
         check_vma=False,
     )(stacked_layers, embed, ids, pm, rope_flags)
 
@@ -294,6 +334,7 @@ def pipeline_loss_fn(
     compute_dtype=jnp.bfloat16,
     loss_chunk_size=None,
     include_router_aux: bool = True,
+    attention_impl: str = "xla",
 ):
     """Masked next-token CE through the pipeline (same objective as
     train/step.py's make_loss_fn, including the chunked large-vocab path and
@@ -325,6 +366,7 @@ def pipeline_loss_fn(
             params, stacked_layers, ids, config, mesh,
             num_microbatches, padding_mask=batch.get("attention_mask"),
             compute_dtype=compute_dtype, output_hidden=True, return_aux=True,
+            attention_impl=attention_impl,
         )
         if micro_dims:
             # one chunked-CE pass per microbatch (lax.map keeps a single
@@ -346,6 +388,7 @@ def pipeline_loss_fn(
         params, stacked_layers, ids, config, mesh,
         num_microbatches, padding_mask=batch.get("attention_mask"),
         compute_dtype=compute_dtype, return_aux=True,
+        attention_impl=attention_impl,
     )
     ce = optax.softmax_cross_entropy_with_integer_labels(logits[..., :-1, :], targets)
     return add_aux((ce * mask).sum() / tokens, aux)
@@ -529,6 +572,7 @@ def build_pipeline_train_step(model_config, train_config, optimizer, mesh, layer
         return pipeline_loss_fn(
             params, stacked_layers, flat_batch, model_config, mesh, M,
             compute_dtype=compute_dtype, loss_chunk_size=chunk,
+            attention_impl=train_config.attention_impl,
         )
 
     def train_step(state, batch):
@@ -591,6 +635,7 @@ def build_pipeline_eval_step(model_config, train_config, mesh):
             params, stacked_layers, micro_batch, model_config, mesh, m,
             compute_dtype=compute_dtype, loss_chunk_size=chunk,
             include_router_aux=False,
+            attention_impl=train_config.attention_impl,
         )
         tokens = jnp.maximum(batch["loss_mask"][:, 1:].astype(jnp.float32).sum(), 1.0)
         return loss * tokens, tokens

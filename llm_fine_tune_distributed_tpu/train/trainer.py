@@ -397,10 +397,23 @@ class SFTTrainer:
         problems = []
         if cfg.packing:
             problems.append("packing=True (the schedule has no segment support)")
-        if cfg.attention_impl in ("ring", "ulysses"):
+        if cfg.attention_impl == "ulysses":
             problems.append(
-                f"attention_impl={cfg.attention_impl!r} (stages attend locally)"
+                "attention_impl='ulysses' (the all-to-all head re-partition "
+                "does not run inside the manual schedule; use 'ring')"
             )
+        if cfg.attention_impl == "ring":
+            # ring composes (the schedule goes manual over seq and stages
+            # call the local ring kernel) — except with MoE, where per-chunk
+            # routing would change capacity semantics (pipeline_forward
+            # raises the same constraint)
+            if mc.num_experts > 0:
+                problems.append("attention_impl='ring' with an MoE preset")
+            if cfg.max_seq_length % max(self.mesh.shape.get("seq", 1), 1):
+                problems.append(
+                    f"max_seq_length={cfg.max_seq_length} not divisible by "
+                    f"the seq axis ({self.mesh.shape.get('seq', 1)})"
+                )
         if cfg.objective not in ("sft", "dpo"):
             problems.append(f"objective={cfg.objective!r}")
         if cfg.freeze_strategy == "qlora" and mc.num_experts > 0:
