@@ -295,6 +295,33 @@ def test_pipe_ep_mesh_has_both_axes(eight_devices):
     assert ag < expert_bytes / 4, (ag, expert_bytes)
 
 
+@pytest.mark.slow
+def test_pipe_ring_mesh_has_both_rings(eight_devices):
+    """pipe x ring: the compiled schedule carries BOTH permute families —
+    stage-boundary ppermutes on pipe (exactly 2(M+S-1), now of seq-chunked
+    activations) and K/V rotation permutes on seq (per layer per tick)."""
+    M, S = 4, 2
+    s = abstract_train_setup(
+        {"pipe": S, "fsdp": 2, "seq": 2},
+        accum=M,
+        train_kwargs={"attention_impl": "ring"},
+    )
+    rep = s.comm_report()
+    assert ("?",) not in {c.axes for c in rep.collectives}
+    pipe_perm = rep.filter(kind="collective-permute", axes=("pipe",))
+    assert sum(c.count for c in pipe_perm.collectives) == 2 * (M + S - 1)
+    # boundary activations are seq-chunked: [mb_local, seq/2, h]
+    rows = s.batch["input_ids"].shape[1] // 2
+    seq_local = s.batch["input_ids"].shape[2] // 2
+    h = s.model_config.hidden_size
+    itemsize = pipe_perm.collectives[0].result_bytes // (rows * seq_local * h)
+    assert itemsize in (2, 4)
+    seq_perm = rep.filter(kind="collective-permute", axes=("seq",))
+    L = s.model_config.num_layers
+    # (seq-1)=1 K/V rotation per layer per tick, fwd + bwd replay
+    assert sum(c.count for c in seq_perm.collectives) >= L * (M + S - 1)
+
+
 # ------------------------------------------------------------- 16-device probe
 
 _PROBE_16 = r"""
