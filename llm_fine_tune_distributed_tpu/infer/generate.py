@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import json
 import os
-from functools import partial
 from types import SimpleNamespace
 from typing import List, Optional, Sequence, Tuple
 

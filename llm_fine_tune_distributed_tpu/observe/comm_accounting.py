@@ -41,7 +41,6 @@ understands sync collectives and the ``-start``/``-done`` async pairs.
 
 from __future__ import annotations
 
-import math
 import re
 from dataclasses import dataclass, field
 from itertools import combinations

@@ -343,18 +343,15 @@ def build_pipeline_dpo_eval_step(
 ) -> Callable:
     """Pipe-mode eval_step(state, ref_trainable, batch) -> (loss_sum,
     acc_sum, n_real), matching build_dpo_eval_step's contract."""
+    from llm_fine_tune_distributed_tpu.parallel.pipeline import eval_microbatches
+
     loss_fn = make_pipeline_dpo_loss_fn(model_config, train_config, mesh)
-    S = mesh.shape["pipe"]
-    dp = 1
-    for ax in ("data", "fsdp"):
-        if ax in mesh.shape:
-            dp *= mesh.shape[ax]
 
     def eval_step(state: TrainState, ref_trainable, batch):
         batch = dict(batch)
         pair_mask = batch.pop("pair_mask")
         b = batch["chosen_input_ids"].shape[0]
-        m = S if b % S == 0 and (b // S) % dp == 0 else 1
+        m = eval_microbatches(mesh, b)
         micro = {k: v.reshape((m, b // m) + v.shape[1:]) for k, v in batch.items()}
         _, aux = loss_fn(state.trainable, ref_trainable, state.frozen, micro)
         loss_sum = (aux["per_pair_loss"].reshape(-1) * pair_mask).sum()

@@ -33,7 +33,6 @@ enabled by the functional state being a plain pytree.
 
 from __future__ import annotations
 
-import re
 from typing import Dict
 
 import jax
@@ -42,11 +41,10 @@ import numpy as np
 
 from llm_fine_tune_distributed_tpu.parallel.pipeline import (
     STACKED_PREFIX,
+    _LAYER_KEY,
     stack_flat_layer_leaves,
     unstack_flat_layer_leaves,
 )
-
-_LAYER_KEY = re.compile(r"^model/layers/(\d+)/(.+)$")
 
 
 def _is_param_dict(node) -> bool:
@@ -151,7 +149,6 @@ def alternate_abstract_state(state, optimizer, flat_mask: Dict, num_layers: int,
         alt_trainable = {k: v for k, v in flat.items() if flat_mask.get(k, False)}
         alt_frozen = {k: v for k, v in flat.items() if not flat_mask.get(k, False)}
     else:
-        tr, fr = {}, {}
         from llm_fine_tune_distributed_tpu.parallel.pipeline import (
             build_pipeline_state_leaves,
         )
