@@ -172,6 +172,20 @@ def attention(
         ):
             return ring_attention(q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal)
         impl = _seq_parallel_fallback("ring", q, mesh)
+    if impl == "ulysses_manual":
+        # Same manual-context contract as ring_manual below: the caller is
+        # inside a shard_map manual over "seq", q/k/v are sequence chunks,
+        # and the local kernel's all_to_all/all_gather ride that axis.
+        from llm_fine_tune_distributed_tpu.parallel.ulysses import (
+            _local_ulysses_attention,
+        )
+
+        if sliding_window is not None:
+            raise ValueError("ulysses attention has no sliding-window support")
+        return _local_ulysses_attention(
+            q, k, v, padding_mask,
+            axis_name="seq", causal=causal, attention_impl="flash",
+        )
     if impl == "ring_manual":
         # The caller is ALREADY inside a shard_map that is manual over the
         # "seq" axis (the pipeline schedule, pipe x ring composition):

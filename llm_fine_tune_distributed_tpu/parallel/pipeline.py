@@ -48,12 +48,11 @@ Composes with LoRA/QLoRA (adapter leaves stack like any per-layer leaf; the
 all-frozen base groups stay out of the optimizer — build_pipeline_state_leaves),
 with DPO (train/dpo.build_pipeline_dpo_train_step runs both DPO forwards as
 schedules), with expert parallelism (manual-subset shard_map; stacked experts
-shard over pipe AND expert), and with RING sequence parallelism
-(``attention_impl="ring"`` + a live seq axis: the schedule goes manual over
-seq and stages call the local ring kernel — long-context pipe runs). Scope
-bounds (raised loudly by the trainer): packing (no segment support in the
-schedule), ulysses (its all-to-all head re-partition doesn't run in the
-manual context), and ring x MoE (per-chunk routing would change capacity
+shard over pipe AND expert), and and with sequence parallelism — BOTH impls (``attention_impl="ring"`` or
+``"ulysses"`` + a live seq axis: the schedule goes manual over seq and
+stages call the local kernels — long-context pipe runs). Scope bounds
+(raised loudly by the trainer): packing (no segment support in the
+schedule) and seq-parallel x MoE (per-chunk routing would change capacity
 semantics).
 """
 
@@ -174,18 +173,29 @@ def pipeline_forward(
     # the schedule manual over "seq" too; each device holds a sequence CHUNK
     # and the stage compute calls the LOCAL ring kernel ("ring_manual" in
     # ops/attention.py), rotating K/V over the seq axis per layer.
-    seq_parallel = attention_impl == "ring" and mesh.shape.get("seq", 1) > 1
+    seq_parallel = (
+        attention_impl in ("ring", "ulysses") and mesh.shape.get("seq", 1) > 1
+    )
     if seq_parallel and config.num_experts > 0:
         raise ValueError(
-            "pipe x ring does not compose with MoE: inside the manual-seq "
-            "schedule the router would see per-chunk token populations, "
-            "changing capacity semantics"
+            f"pipe x {attention_impl} does not compose with MoE: inside the "
+            "manual-seq schedule the router would see per-chunk token "
+            "populations, changing capacity semantics"
         )
     if seq_parallel and seq % mesh.shape["seq"]:
         raise ValueError(
             f"seq {seq} not divisible by the seq axis ({mesh.shape['seq']})"
         )
-    stage_impl = "ring_manual" if seq_parallel else "xla"
+    if (
+        attention_impl == "ulysses"
+        and seq_parallel
+        and config.num_kv_heads % mesh.shape["seq"]
+    ):
+        raise ValueError(
+            f"ulysses needs kv heads ({config.num_kv_heads}) divisible by "
+            f"the seq axis ({mesh.shape['seq']})"
+        )
+    stage_impl = f"{attention_impl}_manual" if seq_parallel else "xla"
 
     def run_stage(stage_layers, x, mask, stage_flags, cos_l, sin_l):
         """Scan my L_local blocks over x [mb, seq_local, h]."""

@@ -397,22 +397,26 @@ class SFTTrainer:
         problems = []
         if cfg.packing:
             problems.append("packing=True (the schedule has no segment support)")
-        if cfg.attention_impl == "ulysses":
-            problems.append(
-                "attention_impl='ulysses' (the all-to-all head re-partition "
-                "does not run inside the manual schedule; use 'ring')"
-            )
-        if cfg.attention_impl == "ring":
-            # ring composes (the schedule goes manual over seq and stages
-            # call the local ring kernel) — except with MoE, where per-chunk
-            # routing would change capacity semantics (pipeline_forward
-            # raises the same constraint)
+        if cfg.attention_impl in ("ring", "ulysses"):
+            # both sequence-parallel impls compose: the schedule goes manual
+            # over seq and stages call the LOCAL kernel (ring_manual /
+            # ulysses_manual) — except with MoE, where per-chunk routing
+            # would change capacity semantics (pipeline_forward raises the
+            # same constraints)
+            seq_size = max(self.mesh.shape.get("seq", 1), 1)
             if mc.num_experts > 0:
-                problems.append("attention_impl='ring' with an MoE preset")
-            if cfg.max_seq_length % max(self.mesh.shape.get("seq", 1), 1):
+                problems.append(
+                    f"attention_impl={cfg.attention_impl!r} with an MoE preset"
+                )
+            if cfg.max_seq_length % seq_size:
                 problems.append(
                     f"max_seq_length={cfg.max_seq_length} not divisible by "
-                    f"the seq axis ({self.mesh.shape.get('seq', 1)})"
+                    f"the seq axis ({seq_size})"
+                )
+            if cfg.attention_impl == "ulysses" and mc.num_kv_heads % seq_size:
+                problems.append(
+                    f"ulysses needs kv heads ({mc.num_kv_heads}) divisible "
+                    f"by the seq axis ({seq_size})"
                 )
         if cfg.objective not in ("sft", "dpo"):
             problems.append(f"objective={cfg.objective!r}")

@@ -171,9 +171,10 @@ def test_pipe_rejects_unsupported_combos(qa_parquet, tmp_path):  # noqa: F811
     data_dir, dataset_file = qa_parquet
     for bad in (
         {"packing": True},
-        {"attention_impl": "ulysses"},
-        # ring composes with pipe — but not on MoE presets
+        # ring/ulysses compose with pipe — but not on MoE presets
         {"attention_impl": "ring", "model_preset": "tiny_moe",
+         "freeze_strategy": "none"},
+        {"attention_impl": "ulysses", "model_preset": "tiny_moe",
          "freeze_strategy": "none"},
     ):
         cfg = make_config(
@@ -347,22 +348,23 @@ def test_pipe_trainer_moe_expert_parallel(qa_parquet, tmp_path):  # noqa: F811
 
 
 @pytest.mark.slow
-def test_pipe_ring_attention_trains(qa_parquet, tmp_path):  # noqa: F811
-    """pipe x ring (sequence parallelism inside the schedule): a
-    pipe=2 x seq=2 x fsdp=2 mesh trains with ring attention — stages go
-    manual over seq and rotate K/V with the local ring kernel — with
-    first-step loss parity against the flat ring mesh."""
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_pipe_seq_parallel_attention_trains(qa_parquet, tmp_path, impl):  # noqa: F811
+    """pipe x sequence parallelism inside the schedule (both impls): a
+    pipe=2 x seq=2 x fsdp=2 mesh trains — stages go manual over seq and
+    call the local ring/ulysses kernel — with first-step loss parity
+    against the flat seq-parallel mesh."""
     from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
 
     data_dir, dataset_file = qa_parquet
     flat_cfg = make_config(
-        tmp_path / "flat_ring", data_dir, dataset_file,
-        epochs=1, attention_impl="ring",
+        tmp_path / f"flat_{impl}", data_dir, dataset_file,
+        epochs=1, attention_impl=impl,
         mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=2),
     )
     pipe_cfg = make_config(
-        tmp_path / "pipe_ring", data_dir, dataset_file,
-        epochs=1, attention_impl="ring",
+        tmp_path / f"pipe_{impl}", data_dir, dataset_file,
+        epochs=1, attention_impl=impl,
         mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=2, pipe=2),
     )
     flat = SFTTrainer(flat_cfg)
@@ -373,5 +375,5 @@ def test_pipe_ring_attention_trains(qa_parquet, tmp_path):  # noqa: F811
     flat_losses = [h["loss"] for h in flat.metrics.history if "loss" in h]
     pipe_losses = [h["loss"] for h in pipe.metrics.history if "loss" in h]
     assert pipe_losses[0] == pytest.approx(flat_losses[0], rel=2e-2)
-    assert pipe_losses[-1] < pipe_losses[0], "pipe x ring did not learn"
+    assert pipe_losses[-1] < pipe_losses[0], f"pipe x {impl} did not learn"
     assert pipe_losses[-1] == pytest.approx(flat_losses[-1], rel=0.15)
