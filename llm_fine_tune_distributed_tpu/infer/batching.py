@@ -183,10 +183,11 @@ class BatchingEngine:
             # pad to a power-of-two batch so generate_batch compiles at most
             # log2(max_batch)+1 batch-size specializations per bucket
             target = _pad_batch_size(len(prompts), self._max_batch)
-            prompts = prompts + [prompts[0]] * (target - len(prompts))
+            n_live = len(prompts)
+            prompts = prompts + [prompts[0]] * (target - n_live)
             try:
                 results = self._generator.generate_batch(
-                    prompts, first.gen, seed=first.seed
+                    prompts, first.gen, seed=first.seed, live_rows=n_live
                 )
                 rate = getattr(self._generator, "last_acceptance_rate", None)
                 steps = getattr(self._generator, "last_spec_steps", None)

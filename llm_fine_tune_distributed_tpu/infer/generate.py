@@ -582,10 +582,16 @@ class Generator:
         prompts: Sequence[Sequence[int]],
         gen: Optional[GenerationConfig] = None,
         seed: int = 0,
+        live_rows: Optional[int] = None,
     ) -> List[List[int]]:
         """Generate continuations for a ragged batch of prompts in ONE device
         program — the weight stream (the batch-1 decode bottleneck) is read
-        once per step for the whole batch."""
+        once per step for the whole batch.
+
+        ``live_rows``: rows past this index are filler (the batching engine
+        pads to a power-of-two batch by duplicating a prompt) and are excluded
+        from the speculative-acceptance telemetry; generation output is
+        unaffected."""
         gen = gen or GenerationConfig()
         prompts = [list(p) for p in prompts]
         if not prompts or any(not p for p in prompts):
@@ -640,9 +646,12 @@ class Generator:
         out, n = res[0], res[1]
         if speculate:
             # acceptance telemetry: prefill emitted 1 per row and each of a
-            # row's row_steps spec steps drafted K and emitted 1 + accepted
-            n_vec = np.asarray(n)
-            row_steps = np.asarray(res[3])
+            # row's row_steps spec steps drafted K and emitted 1 + accepted.
+            # Aggregate over live rows only — padded filler rows (ADVICE r3)
+            # would otherwise skew the per-request acceptance rate.
+            nl = len(prompts) if live_rows is None else min(live_rows, len(prompts))
+            n_vec = np.asarray(n)[:nl]
+            row_steps = np.asarray(res[3])[:nl]
             self.last_spec_steps = int(res[2])
             drafted = int(row_steps.sum()) * gen.speculative_lookup
             accepted = int((n_vec - 1 - row_steps).sum())

@@ -73,6 +73,12 @@ class MultihostCoordinator:
 
         self.generator = generator
         self._is_source = jax.process_index() == 0
+        # Set on the first decode failure and never cleared: the mirrored
+        # failure crashed the follower processes (follow() re-raises), so
+        # every later batch would hang at the broadcast with no peer. The
+        # server's /healthz reports 503 off this flag so orchestrators
+        # restart the whole fleet — the only recovery for a dead follower.
+        self.wedged = False
 
     # telemetry passthrough (the engine reads these after each batch)
     @property
@@ -88,6 +94,7 @@ class MultihostCoordinator:
         prompts: Sequence[Sequence[int]],
         gen: Optional[GenerationConfig] = None,
         seed: int = 0,
+        live_rows: Optional[int] = None,
     ) -> List[List[int]]:
         gen = gen or GenerationConfig()
         prompts = [list(p) for p in prompts]
@@ -105,7 +112,15 @@ class MultihostCoordinator:
         _broadcast(padded, self._is_source)
         _broadcast(lens, self._is_source)
         _broadcast(cfg_buf, self._is_source)
-        return self.generator.generate_batch(prompts, gen, seed=seed)
+        # live_rows shapes only coordinator-side telemetry, so it does not
+        # ride the broadcast (wire format unchanged; followers serve no HTTP)
+        try:
+            return self.generator.generate_batch(
+                prompts, gen, seed=seed, live_rows=live_rows
+            )
+        except Exception:
+            self.wedged = True  # followers died on the mirrored failure
+            raise
 
     def stop(self) -> None:
         """Release follower hosts (server shutdown)."""
@@ -117,10 +132,14 @@ class MultihostCoordinator:
 def follow(generator) -> None:
     """Follower loop for processes > 0: mirror every coordinator batch.
 
-    A failing mirrored batch is logged and the loop CONTINUES — the
-    coordinator-side engine survives per-batch errors (they surface as
-    HTTP 500s), and a follower that died instead would wedge every
-    subsequent request at the next broadcast."""
+    Failure policy (ADVICE r3): the coordinator decodes with its ORIGINAL
+    GenerationConfig object and never runs ``_decode_cfg``, so no follower
+    failure after the broadcasts — config decode, prompt assembly, or the
+    jitted decode itself — is guaranteed to be mirrored coordinator-side.
+    Any of them leaves the coordinator's in-flight (or next) collective
+    without a peer; a follower that logged and kept looping would wedge
+    every later request silently. So the follower re-raises and dies loudly
+    — the visible crash is the recoverable state (restart the fleet)."""
     while True:
         header = _broadcast(np.zeros((_HEADER_LEN,), np.int64), False)
         stop, batch, bucket, seed, cfg_len = (int(x) for x in header)
@@ -135,5 +154,10 @@ def follow(generator) -> None:
                 [int(t) for t in padded[i, : int(lens[i])]] for i in range(batch)
             ]
             generator.generate_batch(prompts, gen, seed=seed)
-        except Exception as e:  # keep following; symmetry with engine 500s
-            print(f"[serve] follower batch failed: {e}", flush=True)
+        except Exception:
+            print(
+                "[serve] follower batch failed; crashing so the wedge is "
+                "visible (restart the serving fleet)",
+                flush=True,
+            )
+            raise

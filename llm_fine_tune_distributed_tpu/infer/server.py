@@ -117,7 +117,13 @@ def serve(
 
         def do_GET(self):  # noqa: N802 (stdlib casing)
             if self.path == "/healthz":
-                self._send(200, "ok")
+                # a multi-host fleet whose followers died on a mirrored
+                # decode failure cannot serve again — report unhealthy so
+                # the orchestrator restarts every host (multihost.py)
+                if coordinator is not None and coordinator.wedged:
+                    self._send(503, {"error": "follower hosts wedged; restart fleet"})
+                else:
+                    self._send(200, "ok")
             else:
                 self._send(404, {"error": "not found"})
 
@@ -139,6 +145,15 @@ def serve(
             # everything fallible happens BEFORE headers go out, so clients
             # get a 400 instead of a hung keep-alive connection
             try:
+                if int(req.get("speculative", 0)):
+                    # /v1/generate honors this knob; streaming decodes in
+                    # fixed chunks with no speculative path — reject rather
+                    # than silently serve plain decode (ADVICE r3).
+                    # speculative=0 (the documented off value) passes through.
+                    raise ValueError(
+                        "'speculative' is not supported on /v1/stream; use "
+                        "/v1/generate for speculative decoding"
+                    )
                 gen_kwargs = {
                     k: cast(req[k])
                     for k, cast in self._FIELD_CASTS.items()

@@ -238,17 +238,28 @@ def _comp_multipliers(comps: Dict[str, List[str]]) -> Dict[str, int]:
                         edges[name].append((callee, 1))
     mults = {k: 0 for k in comps}
     mults[entry] = 1
-    # relax to fixpoint; the call graph is a DAG so |comps| passes suffice
-    for _ in range(len(comps)):
-        changed = False
-        for name, out in edges.items():
-            for callee, n in out:
-                want = mults[name] * n
-                if want > mults[callee]:
-                    mults[callee] = want
-                    changed = True
-        if not changed:
-            break
+    # Topological accumulation over the call DAG: a callee executes the SUM
+    # over call sites of caller_multiplier x per-site count. (A max-relaxation
+    # would count a computation invoked once from each of two call sites as
+    # one execution — ADVICE r3.) Deliberate upper-bound semantics for
+    # conditionals: sibling branches are mutually exclusive per invocation,
+    # so a helper reachable from BOTH arms is credited twice — accounting
+    # reports bound bytes from above, and undercounting is the unsafe
+    # direction (branch probabilities are unknowable statically).
+    from collections import deque
+
+    indeg = {k: 0 for k in comps}
+    for out in edges.values():
+        for callee, _ in out:
+            indeg[callee] += 1
+    ready = deque(k for k, d in indeg.items() if d == 0)
+    while ready:
+        name = ready.popleft()
+        for callee, n in edges[name]:
+            mults[callee] += mults[name] * n
+            indeg[callee] -= 1
+            if indeg[callee] == 0:
+                ready.append(callee)
     return mults
 
 

@@ -261,7 +261,17 @@ class SFTTrainer:
                 f"No local checkpoint at {source!r}; random-initializing "
                 f"{mc.name} ({mc.num_params:,} params)"
             )
-        return init_params(self.rng, mc, dtype=jnp.float32)
+        # Init directly at the target dtype when no full-precision master is
+        # kept anyway: a 3B fp32 init (12.3 GB) plus its bf16 casts overflows
+        # a 16 GB chip, and dense() draws in f32 before casting per-leaf, so
+        # the values are bit-identical either way. QLoRA keeps the f32 init —
+        # NF4 quantizes from full precision (see _prepare_state).
+        init_dtype = jnp.float32
+        if cfg.freeze_strategy != "qlora" and str_to_dtype(
+            cfg.param_dtype
+        ) is str_to_dtype(cfg.compute_dtype):
+            init_dtype = str_to_dtype(cfg.param_dtype)
+        return init_params(self.rng, mc, dtype=init_dtype)
 
     def _prepare_state(self) -> None:
         cfg, mc = self.config, self.model_config

@@ -136,7 +136,7 @@ def test_deferred_requests_keep_fifo_order():
     lock = threading.Lock()
 
     class Recorder:
-        def generate_batch(self, prompts, gen, seed=0):
+        def generate_batch(self, prompts, gen, seed=0, live_rows=None):
             with lock:
                 order.extend(tuple(p) for p in prompts)
             return [[0] * gen.max_new_tokens for _ in prompts]

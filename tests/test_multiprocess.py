@@ -31,7 +31,6 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-@pytest.mark.slow
 def test_two_process_training(tmp_path):
     from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
 
