@@ -98,28 +98,35 @@ class MultihostCoordinator:
     ) -> List[List[int]]:
         gen = gen or GenerationConfig()
         prompts = [list(p) for p in prompts]
-        bucket = max(len(p) for p in prompts)
-        cfg_buf, cfg_len = _encode_cfg(gen)
-        header = np.asarray(
-            [0, len(prompts), bucket, seed, cfg_len], np.int64
-        )
-        _broadcast(header, self._is_source)
-        padded = np.zeros((len(prompts), bucket), np.int64)
-        lens = np.zeros((len(prompts),), np.int64)
-        for i, p in enumerate(prompts):
-            padded[i, : len(p)] = p
-            lens[i] = len(p)
-        _broadcast(padded, self._is_source)
-        _broadcast(lens, self._is_source)
-        _broadcast(cfg_buf, self._is_source)
-        # live_rows shapes only coordinator-side telemetry, so it does not
-        # ride the broadcast (wire format unchanged; followers serve no HTTP)
+        # The whole broadcast+decode sequence wedges the fleet on failure:
+        # followers die on a mirrored decode error (follow() re-raises), and
+        # a coordinator-side failure mid-broadcast leaves them blocked in a
+        # half-received batch. (A failure ONLY on follower hosts is invisible
+        # here — that asymmetry needs the serving fleet's liveness probes on
+        # the follower processes themselves, which exit on failure.)
         try:
+            bucket = max(len(p) for p in prompts)
+            cfg_buf, cfg_len = _encode_cfg(gen)
+            header = np.asarray(
+                [0, len(prompts), bucket, seed, cfg_len], np.int64
+            )
+            _broadcast(header, self._is_source)
+            padded = np.zeros((len(prompts), bucket), np.int64)
+            lens = np.zeros((len(prompts),), np.int64)
+            for i, p in enumerate(prompts):
+                padded[i, : len(p)] = p
+                lens[i] = len(p)
+            _broadcast(padded, self._is_source)
+            _broadcast(lens, self._is_source)
+            _broadcast(cfg_buf, self._is_source)
+            # live_rows shapes only coordinator-side telemetry, so it does
+            # not ride the broadcast (wire format unchanged; followers serve
+            # no HTTP)
             return self.generator.generate_batch(
                 prompts, gen, seed=seed, live_rows=live_rows
             )
         except Exception:
-            self.wedged = True  # followers died on the mirrored failure
+            self.wedged = True
             raise
 
     def stop(self) -> None:

@@ -132,22 +132,6 @@ def attention(
     the flash kernel, which itself degrades to XLA attention when it cannot
     apply.
     """
-    if impl in ("ring", "ulysses") and segment_ids is not None:
-        # the ring rotation has no segment support; packed batches take the
-        # flash kernel (which masks by segment natively) or XLA. Be loud:
-        # a user who provisioned a seq axis should know it is being bypassed
-        # (and beyond the flash kernel's max length this degrades to
-        # quadratic XLA attention).
-        import warnings
-
-        warnings.warn(
-            f"packing (segment_ids) disables {impl} attention (sequence "
-            f"parallelism has no segment support); falling back to flash/XLA "
-            f"for seq {q.shape[1]} — disable packing for sequence-parallel "
-            "long-context runs",
-            stacklevel=2,
-        )
-        impl = "flash"
     if impl == "ulysses":
         from llm_fine_tune_distributed_tpu.parallel.ulysses import (
             ulysses_attention,
@@ -158,7 +142,8 @@ def attention(
             q, k, mesh, sliding_window=sliding_window, causal=causal
         ):
             return ulysses_attention(
-                q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal
+                q, k, v, mesh=mesh, padding_mask=padding_mask,
+                segment_ids=segment_ids, causal=causal
             )
         impl = _seq_parallel_fallback("ulysses", q, mesh)
     if impl == "ring":
@@ -170,7 +155,10 @@ def attention(
         if ring_attention_supported(
             q, k, mesh, sliding_window=sliding_window, causal=causal
         ):
-            return ring_attention(q, k, v, mesh=mesh, padding_mask=padding_mask, causal=causal)
+            return ring_attention(
+                q, k, v, mesh=mesh, padding_mask=padding_mask,
+                segment_ids=segment_ids, causal=causal
+            )
         impl = _seq_parallel_fallback("ring", q, mesh)
     if impl == "ulysses_manual":
         # Same manual-context contract as ring_manual below: the caller is
@@ -182,6 +170,10 @@ def attention(
 
         if sliding_window is not None:
             raise ValueError("ulysses attention has no sliding-window support")
+        if segment_ids is not None:
+            # the pipeline schedule (the only manual-context caller) rejects
+            # packing up front; reaching here would silently drop the mask
+            raise ValueError("ulysses_manual has no segment support")
         return _local_ulysses_attention(
             q, k, v, padding_mask,
             axis_name="seq", causal=causal, attention_impl="flash",
@@ -198,6 +190,8 @@ def attention(
 
         if sliding_window is not None:
             raise ValueError("ring attention has no sliding-window support")
+        if segment_ids is not None:
+            raise ValueError("ring_manual has no segment support")
         return _local_ring_attention(
             q, k, v, padding_mask,
             axis_name="seq", axis_size=mesh.shape["seq"], causal=causal,

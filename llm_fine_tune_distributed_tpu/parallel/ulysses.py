@@ -52,12 +52,16 @@ def _a2a_heads_to_seq(x, axis_name: str):
 
 
 def _local_ulysses_attention(
-    q, k, v, padding_mask, *, axis_name: str, causal: bool, attention_impl: str
+    q, k, v, padding_mask, segment_ids=None, *, axis_name: str, causal: bool,
+    attention_impl: str
 ):
     """Runs on ONE device's shards inside shard_map.
 
     q: [b, lq, h, d], k/v: [b, lq, hk, d] — this device's sequence chunk
     (lq = seq / N). padding_mask: [b, lq] (1 = real token) or None.
+    segment_ids: [b, lq] packing segments or None — all-gathered like the
+    padding mask (each device's full-sequence attention needs every id) and
+    masked natively by the inner flash/XLA kernel.
     """
     # Re-partition: full sequence, 1/N of the heads.
     q = _a2a_seq_to_heads(q, axis_name)  # [b, s, h/N, d]
@@ -68,13 +72,18 @@ def _local_ulysses_attention(
         padding_mask = jax.lax.all_gather(
             padding_mask, axis_name, axis=1, tiled=True
         )  # [b, s]
+    if segment_ids is not None:
+        segment_ids = jax.lax.all_gather(
+            segment_ids, axis_name, axis=1, tiled=True
+        )  # [b, s]
 
     # Ordinary attention on the head-sharded view. The flash kernel applies
     # when shapes allow; otherwise the dispatch falls back to XLA attention.
     from llm_fine_tune_distributed_tpu.ops.attention import attention
 
     out = attention(
-        q, k, v, impl=attention_impl, padding_mask=padding_mask, causal=causal
+        q, k, v, impl=attention_impl, padding_mask=padding_mask,
+        segment_ids=segment_ids, causal=causal
     )  # [b, s, h/N, d]
 
     # Restore sequence sharding for the residual stream.
@@ -119,18 +128,21 @@ def ulysses_attention(
     mesh: Mesh,
     axis_name: str = "seq",
     padding_mask=None,
+    segment_ids=None,
     causal: bool = True,
     attention_impl: str = "flash",
 ):
     """Global-view entry: shard q/k/v over the mesh and run Ulysses.
 
     q: [batch, seq, heads, dim]; k, v: [batch, seq, kv_heads, dim];
-    padding_mask: optional [batch, seq], 1 = real token. Layout contract
-    matches ops/attention.py; call sites go through
+    padding_mask: optional [batch, seq], 1 = real token; segment_ids:
+    optional [batch, seq] packing segments. Layout contract matches
+    ops/attention.py; call sites go through
     ``ops.attention.attention(impl="ulysses", mesh=...)``.
     """
-    qkv_spec = P(("data", "fsdp"), axis_name, "tensor", None)
-    pad_spec = P(("data", "fsdp"), axis_name)
+    from llm_fine_tune_distributed_tpu.parallel.ring_attention import (
+        shard_map_seq_attention,
+    )
 
     local = partial(
         _local_ulysses_attention,
@@ -138,14 +150,7 @@ def ulysses_attention(
         causal=causal,
         attention_impl=attention_impl,
     )
-
-    has_pad = padding_mask is not None
-    fn = jax.shard_map(
-        (lambda q_, k_, v_, p_: local(q_, k_, v_, p_)) if has_pad
-        else (lambda q_, k_, v_: local(q_, k_, v_, None)),
-        mesh=mesh,
-        in_specs=(qkv_spec,) * 3 + ((pad_spec,) if has_pad else ()),
-        out_specs=qkv_spec,
-        check_vma=False,
+    return shard_map_seq_attention(
+        local, mesh, axis_name, q, k, v,
+        padding_mask=padding_mask, segment_ids=segment_ids,
     )
-    return fn(q, k, v, padding_mask) if has_pad else fn(q, k, v)

@@ -469,13 +469,6 @@ class SFTTrainer:
         (parallel/ulysses.py) re-partitions heads with all_to_all.
         Shared by the SFT and DPO step builders so the rules can't drift.
         """
-        if self.config.packing and self.config.attention_impl in ("ring", "ulysses"):
-            raise ValueError(
-                f"packing=True is incompatible with attention_impl="
-                f"{self.config.attention_impl!r} (sequence parallelism has no "
-                "segment support); use flash/xla attention for packed runs, "
-                "or disable packing for sequence-parallel long-context runs"
-            )
         seq_sharded = (
             self.config.attention_impl in ("ring", "ulysses")
             and self.mesh.shape["seq"] > 1

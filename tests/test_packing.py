@@ -141,6 +141,67 @@ def test_packed_arrays_loss_mask_never_crosses_segments(tok):
 
 
 @pytest.mark.slow
+def test_packed_training_with_seq_axis_matches_flat(tmp_path, eight_devices):
+    """packing x sequence parallelism (VERDICT r3 #5): a packed train step on
+    a live seq axis (ring and ulysses) computes the SAME loss as the flat-mesh
+    XLA-attention step — same data, same seed, same init."""
+    import warnings
+
+    from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    jsonl = tmp_path / "qa.jsonl"
+    with open(jsonl, "w") as f:
+        for i in range(64):
+            f.write(json.dumps({
+                "topic": "Knots",
+                "question": f"question {i}?",
+                "answer": f"answer {i}: " + "word " * (3 + i % 6),
+            }) + "\n")
+    convert_jsonl_to_parquet(str(jsonl), str(tmp_path / "qa_dataset.parquet"), verbose=False)
+
+    def make(out, attention_impl, mesh):
+        return TrainConfig(
+            model_name="tiny-random",
+            model_preset="tiny",
+            tokenizer_path="byte-chatml",
+            system_prompt=SYS,
+            data_dir=str(tmp_path),
+            dataset_file="qa_dataset.parquet",
+            output_dir=str(out),
+            packing=True,
+            per_device_batch_size=2,
+            gradient_accumulation_steps=2,
+            max_seq_length=256,
+            mesh=mesh,
+            attention_impl=attention_impl,
+            use_native_loader=False,
+        )
+
+    def one_step_loss(cfg):
+        trainer = SFTTrainer(cfg)
+        batch = next(iter(trainer.loader.epoch(0)))
+        dev = trainer._device_batch(batch, trainer._batch_sharding, local_shards=True)
+        _, metrics = trainer.train_step(trainer.state, dev)
+        return float(metrics["loss"])
+
+    ref = one_step_loss(
+        make(tmp_path / "flat", "xla", MeshConfig(data=1, fsdp=2, tensor=1, seq=1))
+    )
+    with warnings.catch_warnings():
+        # the seq axis must actually be used: the old fallback warned
+        warnings.filterwarnings("error", category=UserWarning, message=".*attention.*")
+        ring = one_step_loss(
+            make(tmp_path / "ring", "ring", MeshConfig(data=1, fsdp=2, tensor=1, seq=2))
+        )
+        uly = one_step_loss(
+            make(tmp_path / "uly", "ulysses", MeshConfig(data=1, fsdp=2, tensor=1, seq=2))
+        )
+    assert abs(ring - ref) < 2e-3, (ring, ref)
+    assert abs(uly - ref) < 2e-3, (uly, ref)
+
+
+@pytest.mark.slow
 def test_packed_sft_end_to_end(tmp_path):
     from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
     from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer

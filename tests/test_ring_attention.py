@@ -82,6 +82,77 @@ def test_ring_gradients_match(eight_devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
+def _segments(b, s, seed=0, n_seg=3, pad_tail=8):
+    """Contiguous per-row segment ids like data/packing.py produces:
+    1..n_seg blocks then a 0 pad tail."""
+    rng = np.random.RandomState(seed)
+    out = np.zeros((b, s), np.int32)
+    for r in range(b):
+        cuts = np.sort(rng.choice(np.arange(1, s - pad_tail), n_seg - 1, replace=False))
+        bounds = [0, *cuts.tolist(), s - pad_tail]
+        for i in range(n_seg):
+            out[r, bounds[i] : bounds[i + 1]] = i + 1
+    return jnp.asarray(out)
+
+
+def test_ring_matches_xla_with_segments(eight_devices):
+    """Packed rows (block-diagonal causal via segment ids) through the ring:
+    the rotated key-side id chunk must reproduce xla_attention's segment
+    masking exactly (packing x sequence parallelism, VERDICT r3 #5)."""
+    mesh = _mesh(eight_devices, seq=8)
+    q, k, v = _qkv(s=64)
+    seg = _segments(2, 64)
+    ref = xla_attention(q, k, v, segment_ids=seg, causal=True)
+    out = jax.jit(
+        lambda a, b_, c, s_: ring_attention(a, b_, c, mesh=mesh, segment_ids=s_)
+    )(q, k, v, seg)
+    real = np.asarray(seg) > 0  # pad-tail rows are garbage in both impls
+    np.testing.assert_allclose(
+        np.asarray(out)[real], np.asarray(ref)[real], atol=2e-5
+    )
+
+
+def test_ring_segments_via_dispatch(eight_devices):
+    """attention(impl='ring', segment_ids=...) keeps the seq axis (no
+    fallback) and matches the xla reference."""
+    mesh = _mesh(eight_devices, seq=4, data=2)
+    q, k, v = _qkv(b=2, s=32)
+    seg = _segments(2, 32, pad_tail=4)
+    ref = xla_attention(q, k, v, segment_ids=seg, causal=True)
+    import warnings
+
+    with warnings.catch_warnings():
+        # the old path warned before falling back; only that warning matters
+        warnings.filterwarnings("error", category=UserWarning, message=".*attention.*")
+        out = jax.jit(
+            lambda a, b_, c, s_: attention(
+                a, b_, c, impl="ring", mesh=mesh, segment_ids=s_
+            )
+        )(q, k, v, seg)
+    real = np.asarray(seg) > 0
+    np.testing.assert_allclose(
+        np.asarray(out)[real], np.asarray(ref)[real], atol=2e-5
+    )
+
+
+def test_ring_segment_gradients_match(eight_devices):
+    mesh = _mesh(eight_devices, seq=8)
+    q, k, v = _qkv(s=32)
+    seg = _segments(2, 32, pad_tail=4)
+    w = (np.asarray(seg) > 0).astype(np.float32)[..., None, None]
+
+    def loss_ring(q, k, v):
+        return ((ring_attention(q, k, v, mesh=mesh, segment_ids=seg) * w) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return ((xla_attention(q, k, v, segment_ids=seg, causal=True) * w) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
 def test_dispatch_falls_back_without_mesh():
     q, k, v = _qkv(b=1, s=16)
     out = attention(q, k, v, impl="ring", mesh=None)  # no mesh -> xla path

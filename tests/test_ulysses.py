@@ -41,6 +41,45 @@ def test_ulysses_matches_xla_causal(eight_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_ulysses_matches_xla_with_segments(eight_devices):
+    """Packed rows through Ulysses: segment ids all-gather over the seq axis
+    and the inner full-sequence kernel masks natively (packing x sequence
+    parallelism, VERDICT r3 #5)."""
+    from tests.test_ring_attention import _segments
+
+    mesh = _mesh(eight_devices, data=2, seq=4)
+    q, k, v = _qkv(b=2, s=32)
+    seg = _segments(2, 32, pad_tail=4)
+    ref = xla_attention(q, k, v, segment_ids=seg, causal=True)
+    out = jax.jit(
+        lambda a, b_, c, s_: ulysses_attention(a, b_, c, mesh=mesh, segment_ids=s_)
+    )(q, k, v, seg)
+    real = np.asarray(seg) > 0
+    np.testing.assert_allclose(
+        np.asarray(out)[real], np.asarray(ref)[real], atol=2e-5
+    )
+
+
+def test_ulysses_segment_gradients_match(eight_devices):
+    from tests.test_ring_attention import _segments
+
+    mesh = _mesh(eight_devices, seq=4)
+    q, k, v = _qkv(b=2, s=32)
+    seg = _segments(2, 32, pad_tail=4)
+    w = (np.asarray(seg) > 0).astype(np.float32)[..., None, None]
+
+    def loss_uly(q, k, v):
+        return ((ulysses_attention(q, k, v, mesh=mesh, segment_ids=seg) * w) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return ((xla_attention(q, k, v, segment_ids=seg, causal=True) * w) ** 2).sum()
+
+    g_uly = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_uly, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
 def test_ulysses_matches_xla_with_padding(eight_devices):
     mesh = _mesh(eight_devices, data=2, seq=4)
     q, k, v = _qkv(b=2, s=32)
