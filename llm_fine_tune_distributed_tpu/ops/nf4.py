@@ -311,6 +311,25 @@ def dequantize_nf4_stacked(q: Dict, dtype=jnp.bfloat16):
     return dequantize_nf4(flat, dtype=dtype).reshape(e, k8 * 8, n)
 
 
+def _quantize_per_layer(w, quantize_fn, block_size, double_quant):
+    """Quantize leading-dim slices independently and stack every produced
+    leaf under that layer dim. Shared by the two layered quantizers so their
+    layer-slicing semantics cannot diverge."""
+    outs = [quantize_fn(w[i], block_size, double_quant) for i in range(w.shape[0])]
+    return {
+        k: jnp.stack([jnp.asarray(o[k]) for o in outs]) for k in outs[0]
+    }
+
+
+def _dequantize_per_layer(q: Dict, dequantize_fn, dtype):
+    """Inverse of ``_quantize_per_layer`` for either per-layer layout."""
+    L = q["nf4"].shape[0]
+    return jnp.stack([
+        dequantize_fn({k: v[i] for k, v in q.items()}, dtype=dtype)
+        for i in range(L)
+    ])
+
+
 def quantize_nf4_layered(w, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
     """NF4-quantize a pipe-stacked kernel ``[L, in, out]`` LAYER BY LAYER.
 
@@ -323,19 +342,12 @@ def quantize_nf4_layered(w, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: 
     Double-quant groups therefore never cross layer boundaries.
     """
     _validate_stacked_in_dim(w.shape[1], block_size)
-    outs = [quantize_nf4(w[i], block_size, double_quant) for i in range(w.shape[0])]
-    return {
-        k: jnp.stack([jnp.asarray(o[k]) for o in outs]) for k in outs[0]
-    }
+    return _quantize_per_layer(w, quantize_nf4, block_size, double_quant)
 
 
 def dequantize_nf4_layered(q: Dict, dtype=jnp.bfloat16):
     """Inverse of ``quantize_nf4_layered``: per-layer leaves -> [L, in, out]."""
-    layers = []
-    L = q["nf4"].shape[0]
-    for i in range(L):
-        layers.append(dequantize_nf4({k: v[i] for k, v in q.items()}, dtype=dtype))
-    return jnp.stack(layers)
+    return _dequantize_per_layer(q, dequantize_nf4, dtype)
 
 
 def quantized_layout_layered(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
@@ -343,6 +355,34 @@ def quantized_layout_layered(shape, block_size: int = DEFAULT_BLOCK_SIZE, double
     leaf gains the leading layer dim (see quantize_nf4_layered)."""
     l, k, n = shape
     per_layer = quantized_layout((k, n), block_size, double_quant)
+    return {key: ((l, *s), dt) for key, (s, dt) in per_layer.items()}
+
+
+def quantize_nf4_layered_stacked(w, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
+    """NF4-quantize a pipe-stacked MoE expert weight ``[L, E, in, out]``
+    LAYER BY LAYER (qlora x pipe x MoE — the dominant bytes of an MoE model).
+
+    Each layer quantizes via ``quantize_nf4_stacked`` and the leaves stack a
+    leading layer dim — ``nf4 [L, E, in/8, out]``, ``absmax_q
+    [L, E, in/block, out]``, ``absmax_scale [L, G]``, ``absmax_offset [L]`` —
+    so the pipeline schedule's per-layer ``lax.scan`` slice is a complete
+    standalone ``quantize_nf4_stacked`` layout that ops/moe.py's
+    ``dequantize_nf4_stacked`` consumes unchanged. Double-quant groups never
+    cross layer boundaries (same invariant as ``quantize_nf4_layered``)."""
+    _validate_stacked_in_dim(w.shape[2], block_size)
+    return _quantize_per_layer(w, quantize_nf4_stacked, block_size, double_quant)
+
+
+def dequantize_nf4_layered_stacked(q: Dict, dtype=jnp.bfloat16):
+    """Inverse of ``quantize_nf4_layered_stacked``: leaves -> [L, E, in, out]."""
+    return _dequantize_per_layer(q, dequantize_nf4_stacked, dtype)
+
+
+def quantized_layout_layered_stacked(shape, block_size: int = DEFAULT_BLOCK_SIZE, double_quant: bool = True):
+    """``quantized_layout`` for a pipe-stacked ``[L, E, in, out]`` expert
+    weight: every per-layer stacked leaf gains the leading layer dim."""
+    l, e, k, n = shape
+    per_layer = quantized_layout_stacked((e, k, n), block_size, double_quant)
     return {key: ((l, *s), dt) for key, (s, dt) in per_layer.items()}
 
 

@@ -499,7 +499,13 @@ def build_pipeline_state_leaves(trainable: Dict, frozen: Dict, flat_mask: Dict, 
     return new_trainable, new_frozen, layer_trainable_vector(flat_mask, num_layers)
 
 
-_STACKED_EXPERT = re.compile(r"block_sparse_moe/experts/(w1|w3|w2)$")
+# NF4-quantized expert leaves ([L, E, in/8, out] packed + [L, E, in/b, out]
+# absmax) keep the base orientation; _validate_spec (the trainer applies it
+# to every pipeline spec) drops any dim the packed shapes no longer divide.
+# absmax_scale [L, G] / absmax_offset [L] fall through to plain P("pipe").
+_STACKED_EXPERT = re.compile(
+    r"block_sparse_moe/experts/(w1|w3|w2)(_nf4|_absmax_q|_absmax)?$"
+)
 
 
 def pipeline_param_spec(path: str, leaf, mesh: Mesh) -> P:

@@ -28,12 +28,15 @@ from llm_fine_tune_distributed_tpu.ops.nf4 import (
     DEQUANT_MARKERS,
     dequantize_nf4,
     dequantize_nf4_layered,
+    dequantize_nf4_layered_stacked,
     dequantize_nf4_stacked,
     quantize_nf4,
     quantize_nf4_layered,
+    quantize_nf4_layered_stacked,
     quantize_nf4_stacked,
     quantized_layout,
     quantized_layout_layered,
+    quantized_layout_layered_stacked,
     quantized_layout_stacked,
 )
 
@@ -55,14 +58,20 @@ def _is_quantizable(path: str, leaf) -> bool:
         # stacked expert case below — packs along the per-layer in dim
         return getattr(leaf, "ndim", 0) == 3 and leaf.shape[1] % 8 == 0
     if path.endswith(tuple(f"/experts/{w}" for w in _EXPERT_LEAVES)):
-        # stacked [E, in, out]: packs along the per-expert in dim
-        return getattr(leaf, "ndim", 0) == 3 and leaf.shape[1] % 8 == 0
+        # stacked [E, in, out]: packs along the per-expert in dim;
+        # pipe-stacked [L, E, in, out] packs along the same per-expert dim
+        if getattr(leaf, "ndim", 0) == 3:
+            return leaf.shape[1] % 8 == 0
+        return getattr(leaf, "ndim", 0) == 4 and leaf.shape[2] % 8 == 0
     return False
 
 
 def _quant_in_dim(leaf) -> int:
-    """The dim the block grid runs along (per-expert in dim for 3-D)."""
-    return leaf.shape[1] if getattr(leaf, "ndim", 0) == 3 else leaf.shape[0]
+    """The dim the block grid runs along (per-expert in dim for 3-D/4-D)."""
+    ndim = getattr(leaf, "ndim", 0)
+    if ndim == 4:
+        return leaf.shape[2]
+    return leaf.shape[1] if ndim == 3 else leaf.shape[0]
 
 
 def quantize_frozen(
@@ -82,7 +91,12 @@ def quantize_frozen(
             continue
         # pass the leaf as-is: on-device arrays quantize on the accelerator
         # (ops/nf4._quantize_codes_jax) with no host round-trip
-        if getattr(leaf, "ndim", 0) == 3:
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 4:
+            # pipe-stacked MoE experts [L, E, in, out]: per-layer stacked
+            # layouts under a leading layer dim (qlora x pipe x MoE)
+            q = quantize_nf4_layered_stacked(leaf, block_size, double_quant)
+        elif ndim == 3:
             # pipe-stacked block kernels [L, in, out] quantize per layer so
             # every leaf keeps the layer dim the schedule's scan slices;
             # MoE expert stacks [E, in, out] keep the flattened layout
@@ -115,7 +129,10 @@ def dequantize_frozen(frozen: Dict, dtype=jnp.bfloat16) -> Dict:
         else:
             out[path] = leaf
     for base, q in groups.items():
-        if getattr(q["nf4"], "ndim", 2) == 3:
+        nf4_ndim = getattr(q["nf4"], "ndim", 2)
+        if nf4_ndim == 4:  # pipe-stacked experts: per-layer stacked layouts
+            out[base] = dequantize_nf4_layered_stacked(q, dtype=dtype)
+        elif nf4_ndim == 3:
             if "@stacked/" in base:  # pipe-stacked kernel: per-layer layout
                 out[base] = dequantize_nf4_layered(q, dtype=dtype)
             else:  # stacked expert weight: flattened layout
@@ -142,7 +159,10 @@ def quantize_frozen_abstract(
         if not _is_quantizable(path, leaf) or _quant_in_dim(leaf) % block_size:
             out[path] = leaf
             continue
-        if getattr(leaf, "ndim", 0) == 3:
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 4:
+            layout_fn = quantized_layout_layered_stacked
+        elif ndim == 3:
             layout_fn = (
                 quantized_layout_layered if "@stacked/" in path else quantized_layout_stacked
             )

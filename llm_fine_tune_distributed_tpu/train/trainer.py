@@ -430,16 +430,6 @@ class SFTTrainer:
                 )
         if cfg.objective not in ("sft", "dpo"):
             problems.append(f"objective={cfg.objective!r}")
-        if cfg.freeze_strategy == "qlora" and mc.num_experts > 0:
-            # pipe stacking makes expert weights 4-D [L, E, in, out], which
-            # the NF4 quantizer does not cover — the dominant (expert) bytes
-            # stay bf16. Loud, because users size HBM from NF4 expert math.
-            print(
-                "[pipeline] WARNING: qlora x pipe leaves MoE EXPERT weights "
-                "UNQUANTIZED (bf16) — only dense block linears take NF4 under "
-                "the pipe axis. Size HBM accordingly, or use a non-pipe mesh "
-                "for NF4-quantized experts."
-            )
         if mc.num_layers % self._pipe_size:
             problems.append(
                 f"{mc.num_layers} layers not divisible by pipe={self._pipe_size}"

@@ -228,3 +228,87 @@ def test_pallas_impl_is_retired():
     q = quantize_nf4(jnp.ones((256, 128)), block_size=64)
     with pytest.raises(ValueError, match="retired"):
         nf4_matmul(jnp.ones((4, 256)), q, impl="pallas")
+
+
+def test_layered_stacked_roundtrip():
+    """4-D [L, E, in, out] pipe-stacked expert quantization (qlora x pipe x
+    MoE, VERDICT r3 #4): per-layer slices are standalone stacked layouts and
+    the roundtrip matches quantizing each layer independently."""
+    from llm_fine_tune_distributed_tpu.ops.nf4 import (
+        dequantize_nf4_layered_stacked,
+        dequantize_nf4_stacked,
+        quantize_nf4_layered_stacked,
+        quantize_nf4_stacked,
+        quantized_layout_layered_stacked,
+    )
+
+    rng = np.random.RandomState(2)
+    w = rng.randn(2, 4, 64, 32).astype(np.float32)  # [L, E, in, out]
+    q = quantize_nf4_layered_stacked(w, block_size=64, double_quant=True)
+    assert q["nf4"].shape == (2, 4, 8, 32)
+    assert q["absmax_q"].shape == (2, 4, 1, 32)
+    assert q["absmax_scale"].ndim == 2 and q["absmax_scale"].shape[0] == 2
+    assert q["absmax_offset"].shape == (2,)
+
+    # the declared layout matches what the quantizer produced
+    layout = quantized_layout_layered_stacked(w.shape, 64, True)
+    for key, (shape, dtype) in layout.items():
+        assert tuple(q[key].shape) == shape, key
+        assert q[key].dtype == dtype, key
+
+    deq = np.asarray(dequantize_nf4_layered_stacked(_j(q), jnp.float32))
+    assert deq.shape == w.shape
+
+    for i in range(2):
+        # each layer slice is a complete standalone stacked layout — the
+        # invariant the pipeline scan relies on (ops/moe consumes slices
+        # with dequantize_nf4_stacked, never seeing the layer dim)
+        per = quantize_nf4_stacked(w[i], block_size=64, double_quant=True)
+        sliced = {k: jnp.asarray(v)[i] for k, v in q.items()}
+        np.testing.assert_array_equal(
+            np.asarray(sliced["nf4"]), np.asarray(per["nf4"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(dequantize_nf4_stacked(sliced, jnp.float32)),
+            np.asarray(dequantize_nf4_stacked(_j(per), jnp.float32)),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(deq[i], np.asarray(
+            dequantize_nf4_stacked(_j(per), jnp.float32)), atol=1e-6)
+
+
+def test_quantize_frozen_handles_pipe_stacked_experts():
+    """quantize_frozen/dequantize_frozen round-trip the 4-D expert leaves the
+    pipeline state carries, and the abstract planner agrees with the real
+    quantizer leaf-for-leaf."""
+    from llm_fine_tune_distributed_tpu.parallel.qlora import (
+        quantize_frozen_abstract,
+    )
+
+    rng = np.random.RandomState(3)
+    frozen = {
+        "model/layers/@stacked/block_sparse_moe/experts/w1":
+            rng.randn(2, 4, 64, 32).astype(np.float32),
+        "model/layers/@stacked/block_sparse_moe/gate/kernel":
+            rng.randn(2, 64, 4).astype(np.float32),
+        "model/norm/weight": np.ones((64,), np.float32),
+    }
+    q = quantize_frozen(frozen, block_size=64)
+    assert "model/layers/@stacked/block_sparse_moe/experts/w1_nf4" in q
+    assert q["model/layers/@stacked/block_sparse_moe/experts/w1_nf4"].ndim == 4
+    # router gate + norm pass through exact
+    assert q["model/layers/@stacked/block_sparse_moe/gate/kernel"].shape == (2, 64, 4)
+
+    abstract = quantize_frozen_abstract(
+        {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in frozen.items()},
+        block_size=64,
+    )
+    assert set(abstract) == set(q)
+    for k in q:
+        assert tuple(abstract[k].shape) == tuple(np.shape(q[k])), k
+
+    deq = dequantize_frozen(q, jnp.float32)
+    assert set(deq) == set(frozen)
+    w = frozen["model/layers/@stacked/block_sparse_moe/experts/w1"]
+    err = np.abs(np.asarray(deq["model/layers/@stacked/block_sparse_moe/experts/w1"]) - w)
+    assert err.mean() < 0.1  # NF4 quantization noise, not garbage

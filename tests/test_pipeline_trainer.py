@@ -289,6 +289,55 @@ def test_pipe_qlora_trains(qa_parquet, tmp_path):  # noqa: F811
 
 
 @pytest.mark.slow
+def test_pipe_qlora_moe_quantizes_experts(qa_parquet, tmp_path):  # noqa: F811
+    """qlora x pipe x MoE (VERDICT r3 #4): the pipe-stacked 4-D expert
+    weights — the dominant bytes of an MoE model — are NF4 at rest, training
+    learns through the dequantizing stage scan, and the export decodes back
+    to plain safetensors."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path / "qlora_moe_pipe", data_dir, dataset_file,
+        epochs=1,
+        model_preset="tiny_moe",
+        freeze_strategy="qlora",
+        mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=1, expert=2, pipe=2),
+    )
+    trainer = SFTTrainer(cfg)
+    # expert leaves are NF4 at rest, with the [L, E, ...] layout the
+    # schedule's per-layer scan slices, sharded over pipe AND expert
+    expert_nf4 = [
+        k for k in trainer.state.frozen
+        if "/experts/" in k and k.endswith("_nf4")
+    ]
+    assert expert_nf4, "pipe-stacked experts were not quantized"
+    for k in expert_nf4:
+        leaf = trainer.state.frozen[k]
+        assert leaf.ndim == 4, (k, leaf.shape)
+        spec = leaf.sharding.spec
+        assert spec[0] == "pipe" and spec[1] == "expert", (k, spec)
+    # no bf16 expert weight remains
+    assert not any(
+        k.endswith(("w1", "w2", "w3")) for k in trainer.state.frozen
+        if "/experts/" in k
+    )
+    summary = trainer.train()
+    losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
+    assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
+    assert np.isfinite(summary["final_train_loss"])
+    from safetensors import safe_open
+
+    with safe_open(
+        os.path.join(tmp_path / "qlora_moe_pipe", "best_model", "model.safetensors"),
+        "np",
+    ) as f:
+        keys = set(f.keys())
+    assert not any("@stacked" in k or "nf4" in k or "lora" in k for k in keys)
+    assert any("experts" in k for k in keys)
+
+
+@pytest.mark.slow
 def test_pipe_trainer_moe(qa_parquet, tmp_path):  # noqa: F811
     """MoE + pipeline at the TRAINER level: stacked expert leaves shard over
     pipe, router aux rides the schedule, training learns."""
