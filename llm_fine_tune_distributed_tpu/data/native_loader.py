@@ -8,6 +8,11 @@ thread that assembles the NEXT batch while the device executes the current
 step, so host input time hides behind device step time (the role torch's
 DataLoader workers play for the reference, SURVEY.md §2.3).
 
+Every per-example array rides the pipeline (sft_loader_create_multi):
+the classic input_ids/loss_mask/attention_mask triplet, or the packed
+five with segment_ids/positions — so packed runs keep the C++ prefetch
+instead of falling back to the Python loader.
+
 The permutation algorithm is splitmix64 Fisher-Yates (defined in loader.cc),
 not numpy's — both are deterministic per (seed, epoch), which is the property
 that matters for cross-host agreement; tests assert the two engines agree on
@@ -50,11 +55,33 @@ class NativeBatchLoader:
             raise RuntimeError(f"native runtime unavailable: {native.build_error()}")
         self._lib = lib
 
+        # Gather EVERY per-example array (packed runs add segment_ids /
+        # positions to the classic triplet) through one C pipeline. Values
+        # are small ints either way, so the int32 staging copies are exact;
+        # outputs convert back to each source dtype for loader parity.
+        self._keys = [k for k in sorted(arrays) if k != "lengths"]
+        self._dtypes = {k: arrays[k].dtype for k in self._keys}
         # Keep C-contiguous int32 copies alive for the library's lifetime.
-        self._ids = np.ascontiguousarray(arrays["input_ids"], dtype=np.int32)
-        self._lm = np.ascontiguousarray(arrays["loss_mask"], dtype=np.int32)
-        self._am = np.ascontiguousarray(arrays["attention_mask"], dtype=np.int32)
-        self.n, self.seq = self._ids.shape
+        self._srcs = {
+            k: np.ascontiguousarray(arrays[k], dtype=np.int32) for k in self._keys
+        }
+        for k in self._keys:
+            # the int32 staging copy must be exact — a fractional mask (e.g.
+            # weighted loss) would silently floor to 0 here while the Python
+            # loader passes it through; fail loud instead
+            if not np.array_equal(
+                self._srcs[k].astype(self._dtypes[k]), arrays[k]
+            ):
+                raise ValueError(
+                    f"array {k!r} ({self._dtypes[k]}) does not round-trip "
+                    "through the native loader's int32 staging; use the "
+                    "Python loader (use_native_loader=False) for non-integer "
+                    "per-example arrays"
+                )
+        shapes = {self._srcs[k].shape for k in self._keys}
+        if len(shapes) != 1:
+            raise ValueError(f"per-example arrays disagree on shape: {shapes}")
+        self.n, self.seq = self._srcs[self._keys[0]].shape
 
         self.per_device_batch_size = per_device_batch_size
         self.grad_accum = grad_accum_steps
@@ -78,14 +105,17 @@ class NativeBatchLoader:
             self.per_host_batch = per_device_batch_size * data_parallel_size // process_count
             host_lo = process_index * self.per_host_batch
 
-        self._handle = lib.sft_loader_create(
-            _i32p(self._ids), _i32p(self._lm), _i32p(self._am),
+        ptrs = (ctypes.POINTER(ctypes.c_int32) * len(self._keys))(
+            *(_i32p(self._srcs[k]) for k in self._keys)
+        )
+        self._handle = lib.sft_loader_create_multi(
+            ptrs, len(self._keys),
             self.n, self.seq, self.global_batch, self.grad_accum,
             self.per_host_batch, host_lo, seed,
             1 if shuffle else 0, 1 if drop_last else 0, queue_depth,
         )
         if not self._handle:
-            raise RuntimeError("sft_loader_create rejected its arguments")
+            raise RuntimeError("sft_loader_create_multi rejected its arguments")
 
     @property
     def steps_per_epoch(self) -> int:
@@ -103,13 +133,16 @@ class NativeBatchLoader:
         self._lib.sft_loader_start_epoch(self._handle, epoch_idx)
         shape = (self.grad_accum, self.per_host_batch, self.seq)
         while True:
-            ids = np.empty(shape, dtype=np.int32)
-            lm = np.empty(shape, dtype=np.int32)
-            am = np.empty(shape, dtype=np.int32)
-            ok = self._lib.sft_loader_next(self._handle, _i32p(ids), _i32p(lm), _i32p(am))
-            if not ok:
+            bufs = {k: np.empty(shape, dtype=np.int32) for k in self._keys}
+            outs = (ctypes.POINTER(ctypes.c_int32) * len(self._keys))(
+                *(_i32p(bufs[k]) for k in self._keys)
+            )
+            if not self._lib.sft_loader_next_multi(self._handle, outs):
                 return
-            yield {"input_ids": ids, "loss_mask": lm, "attention_mask": am}
+            yield {
+                k: (v if self._dtypes[k] == np.int32 else v.astype(self._dtypes[k]))
+                for k, v in bufs.items()
+            }
 
     def __len__(self) -> int:
         return self.steps_per_epoch

@@ -41,14 +41,17 @@ inline uint64_t bounded(uint64_t& state, uint64_t n) {
 }
 
 struct Batch {
-  std::vector<int32_t> ids, lm, am;
+  std::vector<std::vector<int32_t>> bufs;  // one per gathered array
   int64_t step = -1;
 };
 
 }  // namespace
 
 struct SFTLoader {
-  const int32_t *input_ids, *loss_mask, *attention_mask;
+  // Any number of per-example int32 arrays gather with identical row
+  // semantics: the unpacked key triplet (ids/loss/attention), the packed
+  // five (+ segment_ids/positions), or DPO's chosen_*/rejected_* set.
+  std::vector<const int32_t*> srcs;
   int64_t n, seq;
   int64_t global_batch, accum, per_host, host_lo;
   uint64_t seed;
@@ -87,9 +90,8 @@ struct SFTLoader {
 
   void assemble(int64_t step, Batch& out) {
     const int64_t bsz = accum * per_host;
-    out.ids.resize(bsz * seq);
-    out.lm.resize(bsz * seq);
-    out.am.resize(bsz * seq);
+    out.bufs.resize(srcs.size());
+    for (auto& buf : out.bufs) buf.resize(bsz * seq);
     out.step = step;
     const int64_t world_batch = global_batch / accum;  // rows per accum slice
     for (int64_t a = 0; a < accum; ++a) {
@@ -98,9 +100,10 @@ struct SFTLoader {
         int64_t flat = step * global_batch + a * world_batch + host_lo + b;
         int64_t src = order[flat % n];
         int64_t dst = (a * per_host + b) * seq;
-        std::memcpy(&out.ids[dst], input_ids + src * seq, seq * sizeof(int32_t));
-        std::memcpy(&out.lm[dst], loss_mask + src * seq, seq * sizeof(int32_t));
-        std::memcpy(&out.am[dst], attention_mask + src * seq, seq * sizeof(int32_t));
+        for (size_t k = 0; k < srcs.size(); ++k) {
+          std::memcpy(&out.bufs[k][dst], srcs[k] + src * seq,
+                      seq * sizeof(int32_t));
+        }
       }
     }
   }
@@ -122,18 +125,26 @@ struct SFTLoader {
 
 extern "C" {
 
-SFTLoader* sft_loader_create(const int32_t* input_ids, const int32_t* loss_mask,
-                             const int32_t* attention_mask, int64_t n, int64_t seq,
-                             int64_t global_batch, int64_t accum, int64_t per_host,
-                             int64_t host_lo, uint64_t seed, int shuffle,
-                             int drop_last, int queue_cap) {
+// General entry: gather any number of per-example int32 arrays (all
+// [n, seq], same row order) — the packed key set, DPO pairs, or the classic
+// SFT triplet all ride the same pipeline.
+SFTLoader* sft_loader_create_multi(const int32_t* const* arrays,
+                                   int32_t n_arrays, int64_t n, int64_t seq,
+                                   int64_t global_batch, int64_t accum,
+                                   int64_t per_host, int64_t host_lo,
+                                   uint64_t seed, int shuffle, int drop_last,
+                                   int queue_cap) {
   if (n <= 0 || seq <= 0 || global_batch <= 0 || accum <= 0 || per_host <= 0)
     return nullptr;
-  if (global_batch % accum != 0) return nullptr;
+  if (n_arrays <= 0 || global_batch % accum != 0) return nullptr;
   auto* L = new SFTLoader();
-  L->input_ids = input_ids;
-  L->loss_mask = loss_mask;
-  L->attention_mask = attention_mask;
+  L->srcs.assign(arrays, arrays + n_arrays);
+  for (const int32_t* p : L->srcs) {
+    if (p == nullptr) {
+      delete L;
+      return nullptr;
+    }
+  }
   L->n = n;
   L->seq = seq;
   L->global_batch = global_batch;
@@ -145,6 +156,17 @@ SFTLoader* sft_loader_create(const int32_t* input_ids, const int32_t* loss_mask,
   L->drop_last = drop_last != 0;
   L->queue_cap = queue_cap > 0 ? queue_cap : 2;
   return L;
+}
+
+SFTLoader* sft_loader_create(const int32_t* input_ids, const int32_t* loss_mask,
+                             const int32_t* attention_mask, int64_t n, int64_t seq,
+                             int64_t global_batch, int64_t accum, int64_t per_host,
+                             int64_t host_lo, uint64_t seed, int shuffle,
+                             int drop_last, int queue_cap) {
+  const int32_t* arrays[3] = {input_ids, loss_mask, attention_mask};
+  return sft_loader_create_multi(arrays, 3, n, seq, global_batch, accum,
+                                 per_host, host_lo, seed, shuffle, drop_last,
+                                 queue_cap);
 }
 
 int64_t sft_loader_steps_per_epoch(SFTLoader* L) { return L->steps_per_epoch(); }
@@ -167,9 +189,10 @@ void sft_loader_start_epoch(SFTLoader* L, int64_t epoch) {
   L->worker = std::thread([L] { L->run_epoch(); });
 }
 
-// Blocking pop into caller buffers of [accum*per_host*seq] int32.
-// Returns 1 on success, 0 at epoch end.
-int sft_loader_next(SFTLoader* L, int32_t* ids, int32_t* lm, int32_t* am) {
+// Blocking pop into n_arrays caller buffers of [accum*per_host*seq] int32
+// (same order as sft_loader_create_multi's arrays). 1 on success, 0 at
+// epoch end.
+int sft_loader_next_multi(SFTLoader* L, int32_t* const* outs) {
   std::unique_lock<std::mutex> lk(L->mu);
   if (L->consumed >= L->steps) return 0;
   L->cv_pop.wait(lk, [&] { return !L->ready.empty(); });
@@ -178,10 +201,15 @@ int sft_loader_next(SFTLoader* L, int32_t* ids, int32_t* lm, int32_t* am) {
   ++L->consumed;
   L->cv_push.notify_one();
   lk.unlock();
-  std::memcpy(ids, b.ids.data(), b.ids.size() * sizeof(int32_t));
-  std::memcpy(lm, b.lm.data(), b.lm.size() * sizeof(int32_t));
-  std::memcpy(am, b.am.data(), b.am.size() * sizeof(int32_t));
+  for (size_t k = 0; k < b.bufs.size(); ++k) {
+    std::memcpy(outs[k], b.bufs[k].data(), b.bufs[k].size() * sizeof(int32_t));
+  }
   return 1;
+}
+
+int sft_loader_next(SFTLoader* L, int32_t* ids, int32_t* lm, int32_t* am) {
+  int32_t* outs[3] = {ids, lm, am};
+  return sft_loader_next_multi(L, outs);
 }
 
 void sft_loader_destroy(SFTLoader* L) {

@@ -76,6 +76,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i32p, i32p, i32p, c.c_int64, c.c_int64, c.c_int64, c.c_int64,
         c.c_int64, c.c_int64, c.c_uint64, c.c_int, c.c_int, c.c_int,
     ]
+    pp32 = c.POINTER(i32p)
+    lib.sft_loader_create_multi.restype = c.c_void_p
+    lib.sft_loader_create_multi.argtypes = [
+        pp32, c.c_int32, c.c_int64, c.c_int64, c.c_int64, c.c_int64,
+        c.c_int64, c.c_int64, c.c_uint64, c.c_int, c.c_int, c.c_int,
+    ]
+    lib.sft_loader_next_multi.restype = c.c_int
+    lib.sft_loader_next_multi.argtypes = [c.c_void_p, pp32]
     lib.sft_loader_steps_per_epoch.restype = c.c_int64
     lib.sft_loader_steps_per_epoch.argtypes = [c.c_void_p]
     lib.sft_loader_start_epoch.restype = None
@@ -119,15 +127,17 @@ def load() -> Optional[ctypes.CDLL]:
                 _build(lib_path)
             try:
                 _lib = _bind(ctypes.CDLL(lib_path))
-            except OSError:
+            except (OSError, AttributeError):
                 # A pre-existing binary may be stale or built for another
                 # platform (equal mtimes defeat _needs_build on a fresh
-                # checkout): rebuild from the shipped sources and retry once.
+                # checkout): dlopen fails with OSError, a missing symbol
+                # (older ABI than _bind expects) with AttributeError.
+                # Rebuild from the shipped sources and retry once.
                 if not prebuilt:
                     raise
                 _build(lib_path)
                 _lib = _bind(ctypes.CDLL(lib_path))
-        except (OSError, RuntimeError, subprocess.SubprocessError) as e:
+        except (OSError, AttributeError, RuntimeError, subprocess.SubprocessError) as e:
             _build_error = str(e)
             return None
         return _lib

