@@ -52,6 +52,8 @@ def build(model_preset, per_device_batch_size, grad_accum, seq_len, attention_im
 
     model_config = get_preset(model_preset)
     param_dtype = os.environ.get("BENCH_PARAM_DTYPE", "bfloat16")
+    raw_vc = os.environ.get("BENCH_LOSS_VOCAB_CHUNK", "none")
+    vocab_chunk = None if raw_vc.lower() in ("", "none", "0") else int(raw_vc)
     train_config = TrainConfig(
         param_dtype=param_dtype,
         model_preset=model_preset,
@@ -61,6 +63,7 @@ def build(model_preset, per_device_batch_size, grad_accum, seq_len, attention_im
         gradient_checkpointing=os.environ.get("BENCH_REMAT", "1") != "0",
         attention_impl=attention_impl,
         loss_chunk_size=loss_chunk,
+        loss_vocab_chunk=vocab_chunk,
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots_no_batch") or None,
     )
     mesh = make_mesh(MeshConfig(data=1, fsdp=-1, tensor=1, seq=1))

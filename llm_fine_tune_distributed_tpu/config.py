@@ -216,6 +216,11 @@ class TrainConfig:
     # [batch, seq, vocab] float32 logits tensor never materializes (HBM saver
     # for large-vocab models; None = single full-sequence unembed).
     loss_chunk_size: Optional[int] = None
+    # Stream the cross-entropy over VOCAB chunks with an online logsumexp
+    # (train/step.vocab_chunked_ce_sum): the f32 logits never materialize in
+    # fwd OR bwd. Mutually exclusive with loss_chunk_size. vocab_size must
+    # divide by it (SmolLM3's 128256 = 8 x 16032 = 16 x 8016).
+    loss_vocab_chunk: Optional[int] = None
 
     # objective: "sft" (the reference recipe) or "dpo" (preference pairs,
     # BASELINE.json config #4 — the TRL DPOTrainer capability, first-party)
@@ -315,6 +320,7 @@ class TrainConfig:
         "FREEZE_STRATEGY": ("freeze_strategy", str),
         "REMAT_POLICY": ("remat_policy", str),
         "LOSS_CHUNK_SIZE": ("loss_chunk_size", int),
+        "LOSS_VOCAB_CHUNK": ("loss_vocab_chunk", int),
         "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
         "OBJECTIVE": ("objective", str),
         "DPO_BETA": ("dpo_beta", float),

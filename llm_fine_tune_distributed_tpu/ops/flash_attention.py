@@ -304,6 +304,20 @@ def _make_flash_fn(scale: float, block_q: int, block_k: int, groups: int, interp
 
 
 def _pick_block(s: int) -> int:
+    import os
+
+    override = os.environ.get("FLASH_BLOCK", "")
+    if override:
+        blk = int(override)  # perf-sweep knob (BASELINE.md perf ledger)
+        if blk % 128:
+            raise ValueError(
+                f"FLASH_BLOCK={blk} violates the kernel's 128-lane alignment"
+            )
+        if s % blk:
+            raise ValueError(
+                f"FLASH_BLOCK={blk} does not divide seq length {s}"
+            )
+        return blk
     for blk in (512, 256, 128):
         if s % blk == 0:
             return blk

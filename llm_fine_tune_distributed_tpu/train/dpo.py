@@ -118,6 +118,14 @@ def make_dpo_loss_fn(
     """
     compute_dtype = str_to_dtype(train_config.compute_dtype)
     chunk = train_config.loss_chunk_size
+    if getattr(train_config, "loss_vocab_chunk", None) is not None:
+        # DPO's per-token logprobs stream by SEQUENCE (loss_chunk_size);
+        # reject rather than silently materialize the f32 logits the vocab
+        # flag promises to avoid
+        raise ValueError(
+            "loss_vocab_chunk is not supported for objective='dpo'; use "
+            "loss_chunk_size"
+        )
     quant_impl = quant_impl or train_config.quant_matmul_impl
     beta = train_config.dpo_beta
     eps = train_config.dpo_label_smoothing

@@ -426,6 +426,12 @@ class SFTTrainer:
                 )
         if cfg.objective not in ("sft", "dpo"):
             problems.append(f"objective={cfg.objective!r}")
+        if cfg.loss_vocab_chunk is not None:
+            # the schedule's last stage computes CE via loss_chunk_size only
+            # (parallel/pipeline.py) — rejecting beats silently materializing
+            # the f32 logits the flag promises to avoid
+            problems.append("loss_vocab_chunk (pipeline CE streams by sequence; "
+                            "use loss_chunk_size)")
         if mc.num_layers % self._pipe_size:
             problems.append(
                 f"{mc.num_layers} layers not divisible by pipe={self._pipe_size}"

@@ -45,3 +45,53 @@ def test_chunked_ce_matches_full(chunk):
         for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_chunk))
     )
     assert diff < 1e-5
+
+
+@pytest.mark.parametrize("vchunk", [128, 256])  # tiny vocab_size=512
+@pytest.mark.slow
+def test_vocab_streamed_ce_matches_full(vchunk):
+    """Vocab-streamed CE (loss_vocab_chunk, online logsumexp) must match the
+    full-logits loss in value AND gradients — a traffic optimization, not a
+    semantic change (train/step.vocab_chunked_ce_sum)."""
+    mc = get_preset("tiny")
+    common = dict(model_preset="tiny", max_seq_length=96, compute_dtype="float32")
+    tc_full = TrainConfig(**common)
+    tc_v = TrainConfig(loss_vocab_chunk=vchunk, **common)
+
+    params = init_params(jax.random.PRNGKey(0), mc)
+    trainable, frozen = split_by_mask(params, trainable_mask(params, mc, tc_full))
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": rng.randint(0, mc.vocab_size, (2, 96)).astype(np.int32),
+        "loss_mask": (rng.rand(2, 96) > 0.3).astype(np.float32),
+        "attention_mask": np.ones((2, 96), np.int32),
+    }
+
+    loss_full, tok_full = make_loss_fn(mc, tc_full)(trainable, frozen, batch)
+    loss_v, tok_v = make_loss_fn(mc, tc_v)(trainable, frozen, batch)
+    assert float(tok_full) == float(tok_v)
+    assert abs(float(loss_full) - float(loss_v)) < 1e-5
+
+    g_full = jax.grad(lambda t: make_loss_fn(mc, tc_full)(t, frozen, batch)[0])(trainable)
+    g_v = jax.grad(lambda t: make_loss_fn(mc, tc_v)(t, frozen, batch)[0])(trainable)
+    for k in g_full:
+        np.testing.assert_allclose(
+            np.asarray(g_v[k]), np.asarray(g_full[k]), atol=2e-5, err_msg=k
+        )
+
+
+def test_vocab_chunk_validations():
+    mc = get_preset("tiny")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_loss_fn(mc, TrainConfig(model_preset="tiny", loss_chunk_size=64,
+                                     loss_vocab_chunk=128))
+    tc_bad = TrainConfig(model_preset="tiny", loss_vocab_chunk=100)  # 512 % 100
+    params = init_params(jax.random.PRNGKey(0), mc)
+    trainable, frozen = split_by_mask(params, trainable_mask(params, mc, tc_bad))
+    batch = {
+        "input_ids": np.zeros((1, 16), np.int32),
+        "loss_mask": np.ones((1, 16), np.float32),
+        "attention_mask": np.ones((1, 16), np.int32),
+    }
+    with pytest.raises(ValueError, match="not divisible"):
+        make_loss_fn(mc, tc_bad)(trainable, frozen, batch)
