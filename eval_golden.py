@@ -62,9 +62,28 @@ def main(argv=None) -> int:
         max_new_tokens=args.max_new_tokens,
     )
     if args.original_dir is None:
+        import json
+
         for a in tuned:
             print("=" * 72)
             print(f"Q: {a.question}\nA: {a.answer[:400]}")
+        if args.report:
+            # single-model mode still leaves an artifact (the tuned answers)
+            # so CI / the run report can archive the eval, not just stdout
+            with open(args.report, "w") as f:
+                json.dump(
+                    {
+                        "mode": "tuned-only",
+                        "tuned_dir": args.tuned_dir,
+                        "answers": [
+                            {"question": a.question, "answer": a.answer}
+                            for a in tuned
+                        ],
+                    },
+                    f,
+                    indent=2,
+                )
+            print(f"Report written to {args.report}")
         return 0
 
     print(f"Evaluating original model: {args.original_dir}")

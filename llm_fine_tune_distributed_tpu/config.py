@@ -41,6 +41,9 @@ class ModelConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = True
     attention_bias: bool = False
+    # Qwen2-style: bias on q/k/v but NOT o_proj (HF Qwen2Attention). Only
+    # consulted when attention_bias is True; Llama-style configs keep True.
+    attention_out_bias: bool = True
     mlp_bias: bool = False
     # SmolLM3 NoPE: 1 = RoPE on this layer, 0 = no positional embedding.
     # Empty tuple = RoPE everywhere (Llama/Mistral).
@@ -85,7 +88,9 @@ class ModelConfig:
             + 2 * h                            # two RMSNorms
         )
         if self.attention_bias:
-            per_layer += (self.num_heads + 2 * self.num_kv_heads) * d + h
+            per_layer += (self.num_heads + 2 * self.num_kv_heads) * d
+            if self.attention_out_bias:
+                per_layer += h
         if self.mlp_bias:
             per_layer += 2 * f + h
         total = embed + L * per_layer + h  # + final norm

@@ -120,6 +120,22 @@ PRESETS = {
         num_experts=8,
         num_experts_per_tok=2,
     ),
+    "qwen2_7b": ModelConfig(
+        # HF Qwen/Qwen2-7B: qkv bias without o_proj bias, untied embeddings
+        name="qwen2_7b",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        rope_theta=1_000_000.0,
+        max_position_embeddings=32768,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attention_bias=True,
+        attention_out_bias=False,
+    ),
     "mistral_7b": ModelConfig(
         name="mistral_7b",
         vocab_size=32000,
@@ -166,7 +182,21 @@ def from_hf_config(hf_config) -> ModelConfig:
         max_position_embeddings=g("max_position_embeddings", 4096),
         rms_norm_eps=g("rms_norm_eps", 1e-6),
         tie_word_embeddings=bool(g("tie_word_embeddings", False)),
-        attention_bias=bool(g("attention_bias", False)),
+        # HF Qwen2-family configs (qwen2, qwen2_moe, qwen2_vl, ...) carry no
+        # attention_bias field — their attention has qkv bias (no o bias)
+        # implicitly. An explicit attention_out_bias key (written by
+        # trainer._save_model_config) wins over the model_type heuristic so
+        # saved checkpoints round-trip regardless of their model_type string.
+        attention_bias=bool(
+            g("attention_bias", False)
+            or str(g("model_type") or "").startswith("qwen2")
+        ),
+        attention_out_bias=bool(
+            g(
+                "attention_out_bias",
+                not str(g("model_type") or "").startswith("qwen2"),
+            )
+        ),
         mlp_bias=bool(g("mlp_bias", False)),
         no_rope_layers=tuple(no_rope),
         sliding_window=g("sliding_window") if g("use_sliding_window", True) else None,

@@ -63,11 +63,13 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
             "o_proj": {"kernel": dense(next(keys), (qd, h))},
         }
         if config.attention_bias:
-            # HF Llama applies attention_bias to q/k/v/o alike.
+            # HF Llama applies attention_bias to q/k/v/o alike; Qwen2 skips
+            # the o_proj bias (attention_out_bias=False).
             attn["q_proj"]["bias"] = jnp.zeros((qd,), dtype)
             attn["k_proj"]["bias"] = jnp.zeros((kvd,), dtype)
             attn["v_proj"]["bias"] = jnp.zeros((kvd,), dtype)
-            attn["o_proj"]["bias"] = jnp.zeros((h,), dtype)
+            if config.attention_out_bias:
+                attn["o_proj"]["bias"] = jnp.zeros((h,), dtype)
         layer = {
             "input_layernorm": {"weight": jnp.ones((h,), dtype)},
             "self_attn": attn,

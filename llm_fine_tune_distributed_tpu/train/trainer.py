@@ -1041,6 +1041,7 @@ class SFTTrainer:
                     "rms_norm_eps": mc.rms_norm_eps,
                     "tie_word_embeddings": mc.tie_word_embeddings,
                     "attention_bias": mc.attention_bias,
+                    "attention_out_bias": mc.attention_out_bias,
                     "mlp_bias": mc.mlp_bias,
                     "no_rope_layers": list(mc.no_rope_layers),
                     "sliding_window": mc.sliding_window,

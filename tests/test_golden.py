@@ -65,3 +65,50 @@ def test_same_model_answers_identical():
     b = run_golden_eval(_generator(0), questions=GOLDEN_QUESTIONS[:1], max_new_tokens=6)
     report = compare_golden(a, b)
     assert report["n_answers_differ"] == 0
+
+
+@pytest.mark.slow
+def test_cli_tuned_only_writes_report(tmp_path):
+    """eval_golden.py single-model mode archives the answers as JSON (not
+    just stdout) so run reports can attach the eval artifact."""
+    import json
+    import os
+    import sys
+
+    from llm_fine_tune_distributed_tpu.models.hf_io import save_hf_checkpoint
+
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    mdir = tmp_path / "model"
+    save_hf_checkpoint(params, str(mdir))
+    # config.json + tokenizer marker so load_model_dir can rebuild
+    with open(mdir / "config.json", "w") as f:
+        json.dump({
+            "model_type": mc.name, "vocab_size": mc.vocab_size,
+            "hidden_size": mc.hidden_size,
+            "intermediate_size": mc.intermediate_size,
+            "num_hidden_layers": mc.num_layers,
+            "num_attention_heads": mc.num_heads,
+            "num_key_value_heads": mc.num_kv_heads,
+            "rope_theta": mc.rope_theta,
+            "max_position_embeddings": mc.max_position_embeddings,
+            "rms_norm_eps": mc.rms_norm_eps,
+            "tie_word_embeddings": mc.tie_word_embeddings,
+            "no_rope_layers": list(mc.no_rope_layers),
+        }, f)
+    ByteChatMLTokenizer().save_pretrained(str(mdir))
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    import eval_golden
+
+    report = tmp_path / "golden.json"
+    rc = eval_golden.main([
+        "--tuned-dir", str(mdir), "--max-new-tokens", "4",
+        "--report", str(report),
+    ])
+    assert rc == 0
+    data = json.loads(report.read_text())
+    assert data["mode"] == "tuned-only"
+    assert len(data["answers"]) == 5
+    assert all(a["question"] for a in data["answers"])

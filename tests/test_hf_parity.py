@@ -150,3 +150,52 @@ def test_hf_zero_aux_coef_respected():
         router_aux_loss_coef=0.0,
     )
     assert from_hf_config(hf_cfg).router_aux_coef == 0.0
+
+
+def test_qwen2_tiny_logit_parity():
+    """Qwen2 family: qkv bias WITHOUT o_proj bias (attention_out_bias=False)
+    — gates the bias-leaf init/IO asymmetry against HF Qwen2Attention."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        use_sliding_window=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    # HF initializes biases to zero; perturb them so parity actually
+    # exercises the bias path
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if name.endswith("bias"):
+                p.add_(torch.randn_like(p) * 0.1)
+    cfg = from_hf_config(hf_cfg)
+    assert cfg.attention_bias and not cfg.attention_out_bias
+    _compare(model, hf_cfg)
+
+
+def test_qwen2_preset_param_count():
+    """qwen2_7b preset num_params matches the arch arithmetic with the
+    o-bias excluded (7.62B, HF Qwen2-7B)."""
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.utils.tree import count_params
+
+    mc = get_preset("qwen2_7b")
+    assert 7.5e9 < mc.num_params < 7.8e9
+    tiny = mc.replace(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=None,
+    )
+    params = init_params(jax.random.PRNGKey(0), tiny, dtype=jnp.float32)
+    assert count_params(params) == tiny.num_params
+    # o_proj carries no bias leaf
+    assert "bias" not in params["model"]["layers"]["0"]["self_attn"]["o_proj"]
+    assert "bias" in params["model"]["layers"]["0"]["self_attn"]["q_proj"]
