@@ -44,6 +44,9 @@ class ModelConfig:
     # Qwen2-style: bias on q/k/v but NOT o_proj (HF Qwen2Attention). Only
     # consulted when attention_bias is True; Llama-style configs keep True.
     attention_out_bias: bool = True
+    # Qwen3-style per-head RMSNorm on q and k (over head_dim, applied after
+    # the projections, before RoPE — HF Qwen3Attention q_norm/k_norm).
+    qk_norm: bool = False
     mlp_bias: bool = False
     # SmolLM3 NoPE: 1 = RoPE on this layer, 0 = no positional embedding.
     # Empty tuple = RoPE everywhere (Llama/Mistral).
@@ -91,6 +94,8 @@ class ModelConfig:
             per_layer += (self.num_heads + 2 * self.num_kv_heads) * d
             if self.attention_out_bias:
                 per_layer += h
+        if self.qk_norm:
+            per_layer += 2 * d                 # q_norm, k_norm (per head_dim)
         if self.mlp_bias:
             per_layer += 2 * f + h
         total = embed + L * per_layer + h  # + final norm

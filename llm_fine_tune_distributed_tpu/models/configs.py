@@ -136,6 +136,22 @@ PRESETS = {
         attention_bias=True,
         attention_out_bias=False,
     ),
+    "qwen3_8b": ModelConfig(
+        # HF Qwen/Qwen3-8B: per-head q/k RMSNorm, no attention bias, untied
+        name="qwen3_8b",
+        vocab_size=151936,
+        hidden_size=4096,
+        intermediate_size=12288,
+        num_layers=36,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1_000_000.0,
+        max_position_embeddings=40960,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        qk_norm=True,
+    ),
     "mistral_7b": ModelConfig(
         name="mistral_7b",
         vocab_size=32000,
@@ -196,6 +212,11 @@ def from_hf_config(hf_config) -> ModelConfig:
                 "attention_out_bias",
                 not str(g("model_type") or "").startswith("qwen2"),
             )
+        ),
+        # Qwen3-family: per-head q/k RMSNorm is architectural (HF carries no
+        # flag); an explicit qk_norm key (trainer._save_model_config) wins.
+        qk_norm=bool(
+            g("qk_norm", str(g("model_type") or "").startswith("qwen3"))
         ),
         mlp_bias=bool(g("mlp_bias", False)),
         no_rope_layers=tuple(no_rope),

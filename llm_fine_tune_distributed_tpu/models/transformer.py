@@ -70,6 +70,9 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
             attn["v_proj"]["bias"] = jnp.zeros((kvd,), dtype)
             if config.attention_out_bias:
                 attn["o_proj"]["bias"] = jnp.zeros((h,), dtype)
+        if config.qk_norm:
+            attn["q_norm"] = {"weight": jnp.ones((d,), dtype)}
+            attn["k_norm"] = {"weight": jnp.ones((d,), dtype)}
         layer = {
             "input_layernorm": {"weight": jnp.ones((h,), dtype)},
             "self_attn": attn,
@@ -186,6 +189,11 @@ def _block(
     q = _linear(hid, attn_p["q_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_heads, d)
     k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
     v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
+
+    if config.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim, before RoPE (HF Qwen3Attention)
+        q = rms_norm(q, attn_p["q_norm"]["weight"], eps)
+        k = rms_norm(k, attn_p["k_norm"]["weight"], eps)
 
     if rope_flag is not None:
         qr, kr = apply_rope(q, k, cos, sin)

@@ -1042,6 +1042,7 @@ class SFTTrainer:
                     "tie_word_embeddings": mc.tie_word_embeddings,
                     "attention_bias": mc.attention_bias,
                     "attention_out_bias": mc.attention_out_bias,
+                    "qk_norm": mc.qk_norm,
                     "mlp_bias": mc.mlp_bias,
                     "no_rope_layers": list(mc.no_rope_layers),
                     "sliding_window": mc.sliding_window,

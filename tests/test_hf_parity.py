@@ -181,6 +181,56 @@ def test_qwen2_tiny_logit_parity():
     _compare(model, hf_cfg)
 
 
+def test_qwen3_tiny_logit_parity():
+    """Qwen3 family: per-head q/k RMSNorm (qk_norm), no attention bias —
+    gates norm placement (post-projection, pre-RoPE) against HF
+    Qwen3Attention."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        use_sliding_window=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    # q_norm/k_norm init to ones; perturb so parity exercises the norm scale
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if "q_norm" in name or "k_norm" in name:
+                p.add_(torch.randn_like(p) * 0.1)
+    cfg = from_hf_config(hf_cfg)
+    assert cfg.qk_norm and not cfg.attention_bias
+    _compare(model, hf_cfg)
+
+
+def test_qwen3_preset_param_count():
+    """qwen3_8b preset num_params matches init arithmetic incl. the per-head
+    q/k norm leaves (8.19B, HF Qwen3-8B)."""
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+    from llm_fine_tune_distributed_tpu.utils.tree import count_params
+
+    mc = get_preset("qwen3_8b")
+    assert 8.0e9 < mc.num_params < 8.4e9
+    tiny = mc.replace(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+    )
+    params = init_params(jax.random.PRNGKey(0), tiny, dtype=jnp.float32)
+    assert count_params(params) == tiny.num_params
+    attn = params["model"]["layers"]["0"]["self_attn"]
+    assert attn["q_norm"]["weight"].shape == (16,)
+    assert attn["k_norm"]["weight"].shape == (16,)
+
+
 def test_qwen2_preset_param_count():
     """qwen2_7b preset num_params matches the arch arithmetic with the
     o-bias excluded (7.62B, HF Qwen2-7B)."""

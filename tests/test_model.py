@@ -108,3 +108,31 @@ def test_smollm3_nope_pattern():
     assert not cfg.uses_rope(3) and not cfg.uses_rope(7) and not cfg.uses_rope(35)
     assert cfg.uses_rope(0) and cfg.uses_rope(34)
     assert sum(cfg.no_rope_layers) == 27
+
+
+def test_qk_norm_cache_decode_and_grad():
+    """Qwen3-style qk_norm: cached decode matches the full forward, and the
+    norm weights receive gradient (they sit inside the attention block)."""
+    cfg = get_preset("tiny").replace(qk_norm=True, name="tiny_qwen3")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+    full_logits, _ = forward(params, ids, cfg, compute_dtype=jnp.float32)
+
+    cache = init_cache(cfg, batch_size=2, max_len=8, dtype=jnp.float32)
+    lg, cache = forward(params, ids[:, :5], cfg, cache=cache, cache_pos=0,
+                        compute_dtype=jnp.float32)
+    for t in range(5, 8):
+        lg, cache = forward(params, ids[:, t:t + 1], cfg, cache=cache,
+                            cache_pos=t, compute_dtype=jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full_logits[:, t]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def loss(p):
+        out, _ = forward(p, ids, cfg, compute_dtype=jnp.float32)
+        return jnp.mean(out**2)
+
+    g = jax.jit(jax.grad(loss))(params)
+    gq = g["model"]["layers"]["0"]["self_attn"]["q_norm"]["weight"]
+    assert float(jnp.abs(gq).sum()) > 0.0
