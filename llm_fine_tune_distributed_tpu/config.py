@@ -47,6 +47,13 @@ class ModelConfig:
     # Qwen3-style per-head RMSNorm on q and k (over head_dim, applied after
     # the projections, before RoPE — HF Qwen3Attention q_norm/k_norm).
     qk_norm: bool = False
+    # RoPE context extension (HF config.rope_scaling). None = plain RoPE;
+    # "llama3" = Llama-3.1 smoothed NTK; "linear" = position interpolation.
+    rope_scaling_type: Optional[str] = None
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
     mlp_bias: bool = False
     # SmolLM3 NoPE: 1 = RoPE on this layer, 0 = no positional embedding.
     # Empty tuple = RoPE everywhere (Llama/Mistral).

@@ -77,6 +77,26 @@ PRESETS = {
         rms_norm_eps=1e-5,
         tie_word_embeddings=False,
     ),
+    "llama3_1_8b": ModelConfig(
+        # HF meta-llama/Llama-3.1-8B: same arch as llama3_8b, 128k context
+        # via the "llama3" smoothed-NTK rope scaling
+        name="llama3_1_8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        rope_theta=500_000.0,
+        max_position_embeddings=131072,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        rope_scaling_type="llama3",
+        rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_position=8192,
+    ),
     "llama3_70b": ModelConfig(
         name="llama3_70b",
         vocab_size=128256,
@@ -185,6 +205,22 @@ def from_hf_config(hf_config) -> ModelConfig:
     """
     g = lambda k, default=None: getattr(hf_config, k, default)
     no_rope = g("no_rope_layers") or ()
+    # HF rope_scaling dict: {"rope_type"|"type": "llama3"|"linear"|"default",
+    # "factor", "low_freq_factor", "high_freq_factor",
+    # "original_max_position_embeddings"} (Llama-3.1+ checkpoints).
+    rs = g("rope_scaling") or {}
+    if not isinstance(rs, dict):
+        rs = dict(rs)
+    rs_type = rs.get("rope_type", rs.get("type"))
+    if rs_type in ("default", None):
+        rs_type = None
+    elif rs_type not in ("linear", "llama3"):
+        # reject at config-load time, not minutes later inside the first
+        # forward's jit trace (after multi-GB weight loading)
+        raise ValueError(
+            f"unsupported rope_scaling type {rs_type!r}; supported: "
+            "'llama3' (Llama-3.1 smoothed NTK), 'linear', 'default'"
+        )
     return ModelConfig(
         name=g("model_type", "hf_model"),
         vocab_size=g("vocab_size"),
@@ -217,6 +253,13 @@ def from_hf_config(hf_config) -> ModelConfig:
         # flag); an explicit qk_norm key (trainer._save_model_config) wins.
         qk_norm=bool(
             g("qk_norm", str(g("model_type") or "").startswith("qwen3"))
+        ),
+        rope_scaling_type=rs_type,
+        rope_scaling_factor=float(rs.get("factor", 1.0)),
+        rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+        rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+        rope_original_max_position=int(
+            rs.get("original_max_position_embeddings", 8192)
         ),
         mlp_bias=bool(g("mlp_bias", False)),
         no_rope_layers=tuple(no_rope),

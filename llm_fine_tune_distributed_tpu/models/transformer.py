@@ -367,7 +367,9 @@ def forward(
         # without help, so they skip this.
         embed = _lookup_table_constraint(embed, mesh)
     x = constrain(embed[input_ids])
-    cos, sin = rope_cos_sin(positions, config.resolved_head_dim, config.rope_theta)
+    cos, sin = rope_cos_sin(
+        positions, config.resolved_head_dim, config.rope_theta, config=config
+    )
 
     explicit_mask = None
     if segment_ids is not None:

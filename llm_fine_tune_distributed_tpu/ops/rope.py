@@ -16,20 +16,75 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rope_cos_sin(positions, head_dim: int, theta: float, dtype=jnp.float32):
+def rope_inv_freq(
+    head_dim: int,
+    theta: float,
+    *,
+    scaling_type=None,
+    factor: float = 1.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_position: int = 8192,
+):
+    """Per-frequency inverse wavelengths, with optional context extension.
+
+    ``scaling_type``:
+      - None: plain RoPE.
+      - "linear": positions effectively divided by ``factor`` (HF "linear").
+      - "llama3": HF's Llama-3.1 smoothed NTK scheme
+        (modeling_rope_utils._compute_llama3_parameters) — long wavelengths
+        (> original_max/low_freq_factor) are slowed by ``factor``, short ones
+        (< original_max/high_freq_factor) untouched, with linear interpolation
+        in between. Matching HF exactly is required for imported Llama-3.1+
+        checkpoints to reproduce reference logits.
+    """
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if scaling_type in (None, "default"):
+        return inv_freq
+    if scaling_type == "linear":
+        return inv_freq / factor
+    if scaling_type == "llama3":
+        low_freq_wavelen = original_max_position / low_freq_factor
+        high_freq_wavelen = original_max_position / high_freq_factor
+        wavelen = 2.0 * jnp.pi / inv_freq
+        scaled = inv_freq / factor
+        smooth = (original_max_position / wavelen - low_freq_factor) / (
+            high_freq_factor - low_freq_factor
+        )
+        smoothed = (1.0 - smooth) * scaled + smooth * inv_freq
+        out = jnp.where(wavelen > low_freq_wavelen, scaled, inv_freq)
+        is_medium = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+        return jnp.where(is_medium, smoothed, out)
+    raise ValueError(f"unsupported rope scaling type: {scaling_type!r}")
+
+
+def rope_cos_sin(positions, head_dim: int, theta: float, dtype=jnp.float32, *, config=None):
     """Compute cos/sin tables for given positions.
 
     Args:
       positions: int array [...,] token positions (any leading shape).
       head_dim: per-head dimension (must be even).
       theta: RoPE base frequency.
+      config: optional ModelConfig; when given, its rope_scaling_* fields
+        select the context-extension scheme (Llama-3.1 "llama3", "linear").
 
     Returns:
       (cos, sin) arrays of shape positions.shape + (head_dim,).
     """
-    half = head_dim // 2
     # f32 throughout: bf16 position phases destroy long-context accuracy.
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if config is not None and config.rope_scaling_type:
+        inv_freq = rope_inv_freq(
+            head_dim,
+            theta,
+            scaling_type=config.rope_scaling_type,
+            factor=config.rope_scaling_factor,
+            low_freq_factor=config.rope_low_freq_factor,
+            high_freq_factor=config.rope_high_freq_factor,
+            original_max_position=config.rope_original_max_position,
+        )
+    else:
+        inv_freq = rope_inv_freq(head_dim, theta)
     freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., head_dim]
     return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)

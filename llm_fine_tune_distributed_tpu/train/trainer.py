@@ -1043,6 +1043,19 @@ class SFTTrainer:
                     "attention_bias": mc.attention_bias,
                     "attention_out_bias": mc.attention_out_bias,
                     "qk_norm": mc.qk_norm,
+                    # HF rope_scaling dict shape so any HF-compatible loader
+                    # (and our from_hf_config) reads the context extension
+                    "rope_scaling": (
+                        {
+                            "rope_type": mc.rope_scaling_type,
+                            "factor": mc.rope_scaling_factor,
+                            "low_freq_factor": mc.rope_low_freq_factor,
+                            "high_freq_factor": mc.rope_high_freq_factor,
+                            "original_max_position_embeddings": mc.rope_original_max_position,
+                        }
+                        if mc.rope_scaling_type
+                        else None
+                    ),
                     "mlp_bias": mc.mlp_bias,
                     "no_rope_layers": list(mc.no_rope_layers),
                     "sliding_window": mc.sliding_window,

@@ -249,3 +249,66 @@ def test_qwen2_preset_param_count():
     # o_proj carries no bias leaf
     assert "bias" not in params["model"]["layers"]["0"]["self_attn"]["o_proj"]
     assert "bias" in params["model"]["layers"]["0"]["self_attn"]["q_proj"]
+
+
+def test_llama31_rope_scaling_logit_parity():
+    """Llama-3.1 'llama3' smoothed-NTK rope scaling — gates rope_inv_freq's
+    wavelength-banded rescale against HF _compute_llama3_parameters."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = from_hf_config(hf_cfg)
+    assert cfg.rope_scaling_type == "llama3"
+    assert cfg.rope_scaling_factor == 8.0
+    # seq past original_max_position so the slowed long wavelengths matter
+    _compare(model, hf_cfg, seq=48)
+
+
+def test_linear_rope_scaling_logit_parity():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        rope_scaling={"rope_type": "linear", "factor": 4.0},
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    assert from_hf_config(hf_cfg).rope_scaling_type == "linear"
+    _compare(model, hf_cfg, seq=40)
+
+
+def test_unsupported_rope_scaling_rejected_at_load():
+    """yarn/longrope/dynamic must fail at config load, not inside the first
+    forward's jit trace after weights are already in HBM."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0},
+    )
+    with pytest.raises(ValueError, match="unsupported rope_scaling"):
+        from_hf_config(hf_cfg)
