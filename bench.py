@@ -54,6 +54,7 @@ def build(model_preset, per_device_batch_size, grad_accum, seq_len, attention_im
     param_dtype = os.environ.get("BENCH_PARAM_DTYPE", "bfloat16")
     raw_vc = os.environ.get("BENCH_LOSS_VOCAB_CHUNK", "none")
     vocab_chunk = None if raw_vc.lower() in ("", "none", "0") else int(raw_vc)
+    freeze_strategy = os.environ.get("BENCH_FREEZE", "last_n_and_head")
     train_config = TrainConfig(
         param_dtype=param_dtype,
         model_preset=model_preset,
@@ -65,6 +66,7 @@ def build(model_preset, per_device_batch_size, grad_accum, seq_len, attention_im
         loss_chunk_size=loss_chunk,
         loss_vocab_chunk=vocab_chunk,
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots_no_batch") or None,
+        freeze_strategy=freeze_strategy,
     )
     mesh = make_mesh(MeshConfig(data=1, fsdp=-1, tensor=1, seq=1))
     dp = data_parallel_size(mesh)
@@ -74,9 +76,20 @@ def build(model_preset, per_device_batch_size, grad_accum, seq_len, attention_im
     # AdamW whose states live in the model's bf16; set float32 for f32
     # masters — a full-f32 init of 3B params would not fit 16GB HBM).
     params = init_params(jax.random.PRNGKey(0), model_config, dtype=jnp.bfloat16)
+    if freeze_strategy in ("lora", "qlora"):
+        from llm_fine_tune_distributed_tpu.parallel.lora import add_lora_from_config
+
+        params = add_lora_from_config(params, jax.random.PRNGKey(1), train_config)
     mask = trainable_mask(params, model_config, train_config)
     trainable, frozen = split_by_mask(params, mask)
     del params
+    if freeze_strategy == "qlora":
+        # NF4 base from the bf16 init (the trainer quantizes from f32; for a
+        # throughput measurement the extra bf16 rounding is irrelevant and a
+        # 3B f32 init would not fit the 16G chip alongside the batch)
+        from llm_fine_tune_distributed_tpu.parallel.qlora import quantize_frozen
+
+        frozen = quantize_frozen(frozen)
     from llm_fine_tune_distributed_tpu.config import str_to_dtype
     trainable = {k: v.astype(str_to_dtype(param_dtype)) for k, v in trainable.items()}
 

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Long-context single-chip sweep: train-step throughput vs sequence length.
+
+Runs bench.py once per sequence length with the measured-best single-chip
+recipe for that cell (BASELINE.md "Long-context single-chip series") and
+prints one JSON line per point plus a summary table. The recipes encode the
+HBM findings from the round-4 sweep on the 16G v5e chip (SmolLM3-3B):
+
+  seq 1024  mb2 accum16  dots_no_batch remat, full-sequence unembed
+  seq 2048  mb1 accum16  dots_no_batch remat, seq-chunked CE 512, vmem 32M
+  seq 4096  mb1 accum8   mlp remat (dots_no_batch OOMs: 19.4G), CE 512, 48M
+  seq 8192  mb1 accum4   QLoRA (NF4 base) — full-SFT does not fit a single
+                         16G chip at 8k even under full remat (16.9G);
+                         beyond that the supported path is the seq axis
+                         (ring/ulysses) across chips.
+
+Usage: python benchmarks/long_context.py [--seqs 1024,2048,4096,8192]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# seq -> env recipe (measured-best on one v5e; see module docstring)
+RECIPES = {
+    1024: {"BENCH_BATCH": "2", "BENCH_ACCUM": "16"},
+    2048: {
+        "BENCH_BATCH": "1",
+        "BENCH_ACCUM": "16",
+        "BENCH_LOSS_CHUNK": "512",
+    },
+    4096: {
+        "BENCH_BATCH": "1",
+        "BENCH_ACCUM": "8",
+        "BENCH_LOSS_CHUNK": "512",
+        "BENCH_REMAT_POLICY": "mlp",
+        "LIBTPU_INIT_ARGS": "--xla_tpu_scoped_vmem_limit_kib=49152",
+    },
+    8192: {
+        "BENCH_BATCH": "1",
+        "BENCH_ACCUM": "4",
+        "BENCH_LOSS_CHUNK": "512",
+        "BENCH_REMAT_POLICY": "full",
+        "BENCH_FREEZE": "qlora",
+        "LIBTPU_INIT_ARGS": "--xla_tpu_scoped_vmem_limit_kib=65536",
+    },
+}
+
+
+def run_point(seq: int, steps: int) -> dict | None:
+    env = dict(os.environ)
+    env.update(RECIPES[seq])
+    env["BENCH_SEQ"] = str(seq)
+    env["BENCH_STEPS"] = str(steps)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    print(f"seq {seq}: bench failed rc={proc.returncode}", file=sys.stderr)
+    tail = proc.stderr.strip().splitlines()[-3:]
+    for t in tail:
+        print(f"  {t}", file=sys.stderr)
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="1024,2048,4096,8192")
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args()
+
+    rows = []
+    for seq in (int(s) for s in args.seqs.split(",")):
+        if seq not in RECIPES:
+            print(f"seq {seq}: no recipe (known: {sorted(RECIPES)})", file=sys.stderr)
+            continue
+        res = run_point(seq, args.steps)
+        if res is not None:
+            res["recipe"] = {
+                k: v for k, v in RECIPES[seq].items() if k != "LIBTPU_INIT_ARGS"
+            }
+            rows.append(res)
+            print(json.dumps(res))
+
+    if rows:
+        print(f"\n{'seq':>6} {'samples/s/chip':>15} {'tokens/s/chip':>14} {'step_s':>7}")
+        for r in rows:
+            print(
+                f"{r['seq_len']:>6} {r['value']:>15.3f} "
+                f"{r['tokens_per_sec_per_chip']:>14.1f} {r['step_seconds']:>7.2f}"
+            )
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    main()

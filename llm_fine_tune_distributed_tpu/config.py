@@ -221,10 +221,11 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"  # activations / matmuls
     gradient_checkpointing: bool = True
     # remat granularity: "full" (recompute whole block — min memory),
-    # "dots" / "dots_no_batch" (save matmul outputs — less recompute, more
-    # HBM). None = auto (resolved_remat_policy): matmul-saving remat for
-    # models that comfortably fit (measured ~25% faster on v5e for the 3B
-    # flagship, bench.py), minimum-HBM full-block remat at >= 6B params.
+    # "dots" / "dots_no_batch" (save matmul outputs — least recompute, most
+    # HBM), "mlp" (save only the [s,f] SwiGLU product — the middle ground).
+    # None = auto (resolved_remat_policy): picked by model size and PER-CHIP
+    # sequence length from the measured ledger in BASELINE.md
+    # ("Long-context single-chip series").
     remat_policy: Optional[str] = None
     # loss on completion tokens only? TRL SFTTrainer default (packing=False,
     # no completion_only flag in the reference) trains on the full sequence.
@@ -303,13 +304,32 @@ class TrainConfig:
     def effective_batch_size(self, data_parallel_size: int) -> int:
         return self.per_device_batch_size * self.gradient_accumulation_steps * data_parallel_size
 
-    def resolved_remat_policy(self, model_config: "ModelConfig") -> str:
-        """Resolve remat_policy=None ("auto") by model size: small models
-        take the measured-fastest matmul-saving policy, big ones the
-        minimum-HBM full-block remat. An explicit setting always wins."""
+    def resolved_remat_policy(
+        self, model_config: "ModelConfig", seq_parallel_size: int = 1
+    ) -> str:
+        """Resolve remat_policy=None ("auto") by model size AND per-chip
+        sequence length. An explicit setting always wins.
+
+        Measured on the single v5e chip (SmolLM3-3B, bf16, BASELINE.md
+        "Long-context single-chip series"): at seq 1024/2048 the
+        matmul-saving "dots_no_batch" is fastest; at seq 4096 its saved dot
+        products (~256MB/layer) blow HBM (19.4G > 15.75G) while "mlp" (save
+        only the [s,f] SwiGLU product) fits and runs 2.4x faster than
+        full-block remat; at 8k even the mlp saves OOM (17.1G). Big models
+        always take minimum-HBM "full".
+
+        ``seq_parallel_size``: the mesh's seq-axis size. A ring/ulysses run
+        at global seq 8192 over 4 chips holds 2048 tokens per chip — the
+        HBM pressure the ledger keys on is per-chip, so auto resolves on
+        ``max_seq_length / seq_parallel_size``."""
         if self.remat_policy is not None:
             return self.remat_policy
-        return "dots_no_batch" if model_config.num_params < 6e9 else "full"
+        if model_config.num_params >= 6e9:
+            return "full"
+        per_chip_seq = self.max_seq_length // max(seq_parallel_size, 1)
+        if per_chip_seq >= 8192:
+            return "full"
+        return "mlp" if per_chip_seq >= 4096 else "dots_no_batch"
 
     def scaled_learning_rate(self, data_parallel_size: int) -> float:
         if self.scale_lr_by_data_parallel:

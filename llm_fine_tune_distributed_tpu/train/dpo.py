@@ -117,6 +117,9 @@ def make_dpo_loss_fn(
       loss   = -(1-eps) log sigma(beta * margin) - eps log sigma(-beta * margin)
     """
     compute_dtype = str_to_dtype(train_config.compute_dtype)
+    _mesh = getattr(activation_sharding, "mesh", None)
+    _seq_parallel = _mesh.shape.get("seq", 1) if _mesh is not None else 1
+    remat_policy = train_config.resolved_remat_policy(model_config, _seq_parallel)
     chunk = train_config.loss_chunk_size
     if getattr(train_config, "loss_vocab_chunk", None) is not None:
         # DPO's per-token logprobs stream by SEQUENCE (loss_chunk_size);
@@ -143,7 +146,7 @@ def make_dpo_loss_fn(
             attention_impl=train_config.attention_impl,
             compute_dtype=compute_dtype,
             remat=train_config.gradient_checkpointing,
-            remat_policy=train_config.resolved_remat_policy(model_config),
+            remat_policy=remat_policy,
             activation_sharding=activation_sharding,
             output_hidden=True,
             quant_impl=quant_impl,

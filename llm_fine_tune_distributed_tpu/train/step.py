@@ -131,6 +131,9 @@ def vocab_chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfi
 def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activation_sharding=None,
                  quant_impl: Optional[str] = None, include_router_aux: bool = True):
     compute_dtype = str_to_dtype(train_config.compute_dtype)
+    _mesh = getattr(activation_sharding, "mesh", None)
+    seq_parallel = _mesh.shape.get("seq", 1) if _mesh is not None else 1
+    remat_policy = train_config.resolved_remat_policy(model_config, seq_parallel)
     chunk = train_config.loss_chunk_size
     vocab_chunk = getattr(train_config, "loss_vocab_chunk", None)
     if chunk is not None and vocab_chunk is not None:
@@ -164,7 +167,7 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             attention_impl=train_config.attention_impl,
             compute_dtype=compute_dtype,
             remat=train_config.gradient_checkpointing,
-            remat_policy=train_config.resolved_remat_policy(model_config),
+            remat_policy=remat_policy,
             activation_sharding=activation_sharding,
             logits_dtype=jnp.float32,
             output_hidden=chunk is not None or vocab_chunk is not None,

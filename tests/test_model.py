@@ -136,3 +136,29 @@ def test_qk_norm_cache_decode_and_grad():
     g = jax.jit(jax.grad(loss))(params)
     gq = g["model"]["layers"]["0"]["self_attn"]["q_norm"]["weight"]
     assert float(jnp.abs(gq).sum()) > 0.0
+
+
+def test_auto_remat_policy_by_size_and_seq():
+    """Auto remat resolution: measured-fastest per (model size, seq) cell —
+    BASELINE.md 'Long-context single-chip series'."""
+    from llm_fine_tune_distributed_tpu.config import TrainConfig
+
+    small, big = get_preset("smollm3_3b"), get_preset("llama3_8b")
+    assert TrainConfig(max_seq_length=1024).resolved_remat_policy(small) == "dots_no_batch"
+    assert TrainConfig(max_seq_length=4096).resolved_remat_policy(small) == "mlp"
+    assert TrainConfig(max_seq_length=8192).resolved_remat_policy(small) == "full"
+    # seq-parallel: the ledger keys on PER-CHIP seq — global 8k over a
+    # 4-chip seq axis is 2k/chip, back to the fastest policy
+    assert (
+        TrainConfig(max_seq_length=8192).resolved_remat_policy(small, seq_parallel_size=4)
+        == "dots_no_batch"
+    )
+    assert (
+        TrainConfig(max_seq_length=8192).resolved_remat_policy(small, seq_parallel_size=2)
+        == "mlp"
+    )
+    assert TrainConfig(max_seq_length=1024).resolved_remat_policy(big) == "full"
+    assert (
+        TrainConfig(max_seq_length=4096, remat_policy="dots").resolved_remat_policy(small)
+        == "dots"
+    )
