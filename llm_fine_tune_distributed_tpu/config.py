@@ -47,6 +47,24 @@ class ModelConfig:
     # Qwen3-style per-head RMSNorm on q and k (over head_dim, applied after
     # the projections, before RoPE — HF Qwen3Attention q_norm/k_norm).
     qk_norm: bool = False
+    # --- Gemma2-style architecture knobs (HF Gemma2Config) ---
+    # "silu" (Llama SwiGLU) or "gelu_tanh" (Gemma GeGLU, gelu_pytorch_tanh)
+    hidden_act: str = "silu"
+    # Four norms per layer: post-attention and post-feedforward OUTPUT norms
+    # in addition to the two pre-norms (HF Gemma2DecoderLayer ordering)
+    sandwich_norms: bool = False
+    # RMSNorm weight stored zero-centered: out = normed * (1 + w), w init 0
+    zero_centered_norm: bool = False
+    # Multiply embedding output by sqrt(hidden_size) (Gemma normalizer)
+    embed_scale: bool = False
+    # Soft caps: score -> cap * tanh(score / cap)
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    # Attention scale = query_pre_attn_scalar**-0.5 instead of head_dim**-0.5
+    query_pre_attn_scalar: Optional[float] = None
+    # Sliding window only on even layers (Gemma2's local/global alternation);
+    # False = the window (if any) applies to every layer (Mistral)
+    alternating_sliding_window: bool = False
     # RoPE context extension (HF config.rope_scaling). None = plain RoPE;
     # "llama3" = Llama-3.1 smoothed NTK; "linear" = position interpolation.
     rope_scaling_type: Optional[str] = None
@@ -103,6 +121,8 @@ class ModelConfig:
                 per_layer += h
         if self.qk_norm:
             per_layer += 2 * d                 # q_norm, k_norm (per head_dim)
+        if self.sandwich_norms:
+            per_layer += 2 * h                 # post-attn + post-ffn norms
         if self.mlp_bias:
             per_layer += 2 * f + h
         total = embed + L * per_layer + h  # + final norm
@@ -114,6 +134,15 @@ class ModelConfig:
         if not self.no_rope_layers:
             return True
         return bool(self.no_rope_layers[layer_idx])
+
+    def layer_sliding_window(self, layer_idx: int) -> Optional[int]:
+        """Per-layer sliding window: Gemma2 alternates local (even layers) /
+        global (odd); Mistral applies the window everywhere."""
+        if self.sliding_window is None:
+            return None
+        if self.alternating_sliding_window and layer_idx % 2 != 0:
+            return None
+        return self.sliding_window
 
     def replace(self, **kw) -> "ModelConfig":
         return dataclasses.replace(self, **kw)

@@ -172,6 +172,56 @@ PRESETS = {
         tie_word_embeddings=False,
         qk_norm=True,
     ),
+    "tiny_gemma2": ModelConfig(
+        # unit-test scale Gemma2: every family knob live. vocab 512 = the
+        # byte-chatml test tokenizer's vocab (256 bytes + specials + pad)
+        name="tiny_gemma2",
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=4,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        rope_theta=10_000.0,
+        max_position_embeddings=512,
+        tie_word_embeddings=True,
+        sliding_window=8,
+        alternating_sliding_window=True,
+        hidden_act="gelu_tanh",
+        sandwich_norms=True,
+        zero_centered_norm=True,
+        embed_scale=True,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        query_pre_attn_scalar=16.0,
+    ),
+    "gemma2_9b": ModelConfig(
+        # HF google/gemma-2-9b: GeGLU, sandwich norms, zero-centered RMSNorm,
+        # scaled embeddings, attn/final logit softcaps, local/global
+        # alternating sliding window, tied embeddings
+        name="gemma2_9b",
+        vocab_size=256000,
+        hidden_size=3584,
+        intermediate_size=14336,
+        num_layers=42,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=256,
+        rope_theta=10_000.0,
+        max_position_embeddings=8192,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        sliding_window=4096,
+        alternating_sliding_window=True,
+        hidden_act="gelu_tanh",
+        sandwich_norms=True,
+        zero_centered_norm=True,
+        embed_scale=True,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        query_pre_attn_scalar=256.0,
+    ),
     "mistral_7b": ModelConfig(
         name="mistral_7b",
         vocab_size=32000,
@@ -193,6 +243,21 @@ def get_preset(name: str) -> ModelConfig:
         return PRESETS[name]
     except KeyError:
         raise KeyError(f"unknown model preset {name!r}; available: {sorted(PRESETS)}")
+
+
+def _parse_hidden_act(act) -> str:
+    """Map HF activation names to the two implemented gate activations —
+    reject anything else at load time (same contract as the rope_scaling
+    check below: fail before multi-GB weights load, not inside jit)."""
+    act = str(act)
+    if act in ("silu", "swish"):
+        return "silu"
+    if act in ("gelu_tanh", "gelu_pytorch_tanh", "gelu_new"):
+        return "gelu_tanh"
+    raise ValueError(
+        f"unsupported hidden_act {act!r}; supported: silu/swish, "
+        "gelu_pytorch_tanh (tanh-approx GeGLU)"
+    )
 
 
 def from_hf_config(hf_config) -> ModelConfig:
@@ -253,6 +318,37 @@ def from_hf_config(hf_config) -> ModelConfig:
         # flag); an explicit qk_norm key (trainer._save_model_config) wins.
         qk_norm=bool(
             g("qk_norm", str(g("model_type") or "").startswith("qwen3"))
+        ),
+        # Gemma2 family: GeGLU/sandwich-norm/zero-centered/softcap knobs.
+        # Explicit keys (written by trainer._save_model_config) win; the
+        # model_type heuristic covers pristine HF gemma2 checkpoints.
+        hidden_act=_parse_hidden_act(
+            g("hidden_act") or g("hidden_activation") or "silu"
+        ),
+        sandwich_norms=bool(
+            g("sandwich_norms", str(g("model_type") or "").startswith("gemma2"))
+        ),
+        zero_centered_norm=bool(
+            g(
+                "zero_centered_norm",
+                str(g("model_type") or "").startswith("gemma"),
+            )
+        ),
+        embed_scale=bool(
+            g("embed_scale", str(g("model_type") or "").startswith("gemma"))
+        ),
+        attn_logit_softcap=(
+            g("attn_logit_softcap", None) or g("attn_logit_softcapping", None)
+        ),
+        final_logit_softcap=(
+            g("final_logit_softcap", None) or g("final_logit_softcapping", None)
+        ),
+        query_pre_attn_scalar=g("query_pre_attn_scalar"),
+        alternating_sliding_window=bool(
+            g(
+                "alternating_sliding_window",
+                str(g("model_type") or "").startswith("gemma2"),
+            )
         ),
         rope_scaling_type=rs_type,
         rope_scaling_factor=float(rs.get("factor", 1.0)),

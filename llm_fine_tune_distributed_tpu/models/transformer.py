@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from llm_fine_tune_distributed_tpu.config import ModelConfig
-from llm_fine_tune_distributed_tpu.ops.attention import attention, xla_attention
+from llm_fine_tune_distributed_tpu.ops.attention import attention, softcap, xla_attention
 from llm_fine_tune_distributed_tpu.ops.norms import rms_norm
 from llm_fine_tune_distributed_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -54,6 +54,13 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
     def dense(key, shape):
         return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
 
+    # Gemma zero-centered RMSNorm stores the weight as an offset from 1
+    # (init 0); Llama-style stores the multiplier itself (init 1).
+    def norm_init():
+        if config.zero_centered_norm:
+            return {"weight": jnp.zeros((h,), dtype)}
+        return {"weight": jnp.ones((h,), dtype)}
+
     layers = {}
     for i in range(config.num_layers):
         attn = {
@@ -74,10 +81,15 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
             attn["q_norm"] = {"weight": jnp.ones((d,), dtype)}
             attn["k_norm"] = {"weight": jnp.ones((d,), dtype)}
         layer = {
-            "input_layernorm": {"weight": jnp.ones((h,), dtype)},
+            "input_layernorm": norm_init(),
             "self_attn": attn,
-            "post_attention_layernorm": {"weight": jnp.ones((h,), dtype)},
+            "post_attention_layernorm": norm_init(),
         }
+        if config.sandwich_norms:
+            # Gemma2: post_attention_layernorm norms the attention OUTPUT;
+            # pre_feedforward replaces Llama's post_attention pre-MLP role
+            layer["pre_feedforward_layernorm"] = norm_init()
+            layer["post_feedforward_layernorm"] = norm_init()
         if config.num_experts > 0:
             from llm_fine_tune_distributed_tpu.ops.moe import init_moe_params
 
@@ -101,7 +113,7 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
         "model": {
             "embed_tokens": {"weight": dense(next(keys), (v, h))},
             "layers": layers,
-            "norm": {"weight": jnp.ones((h,), dtype)},
+            "norm": norm_init(),
         }
     }
     if not config.tie_word_embeddings:
@@ -171,6 +183,7 @@ def _block(
     mesh=None,
     quant_impl: str = "auto",
     rope_flag=None,
+    windowed_mask=None,
 ):
     """One transformer block. Returns (x, new_cache_entry, moe_aux).
 
@@ -183,9 +196,10 @@ def _block(
     b, s, h = x.shape
     d = config.resolved_head_dim
     eps = config.rms_norm_eps
+    zc = config.zero_centered_norm
     attn_p = lp["self_attn"]
 
-    hid = rms_norm(x, lp["input_layernorm"]["weight"], eps)
+    hid = rms_norm(x, lp["input_layernorm"]["weight"], eps, zero_centered=zc)
     q = _linear(hid, attn_p["q_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_heads, d)
     k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
     v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
@@ -223,8 +237,22 @@ def _block(
         new_entry = {"k": ck, "v": cv}
         k, v = ck, cv
 
+    # Per-layer attention knobs (Gemma2: alternating local/global windows,
+    # query_pre_attn_scalar scale, logit softcap — all None for Llama-family)
+    layer_window = config.layer_sliding_window(layer_idx)
+    attn_scale = (
+        None
+        if config.query_pre_attn_scalar is None
+        else float(config.query_pre_attn_scalar) ** -0.5
+    )
     if explicit_mask is not None:
-        out = xla_attention(q, k, v, mask=explicit_mask, causal=False)
+        # windowed_mask carries the window restriction; a global layer (no
+        # window) uses the plain causal/padding mask
+        m = windowed_mask if (layer_window is not None and windowed_mask is not None) else explicit_mask
+        out = xla_attention(
+            q, k, v, mask=m, causal=False,
+            scale=attn_scale, logit_softcap=config.attn_logit_softcap,
+        )
     else:
         out = attention(
             q,
@@ -234,14 +262,26 @@ def _block(
             padding_mask=padding_mask,
             segment_ids=segment_ids,
             causal=True,
-            sliding_window=config.sliding_window,
+            sliding_window=layer_window,
             mesh=mesh,
+            scale=attn_scale,
+            logit_softcap=config.attn_logit_softcap,
         )
 
     out = out.reshape(b, s, config.num_heads * d)
-    x = x + _linear(out, attn_p["o_proj"], compute_dtype, quant_impl)
+    attn_out = _linear(out, attn_p["o_proj"], compute_dtype, quant_impl)
+    if config.sandwich_norms:
+        # Gemma2: post_attention_layernorm norms the attention OUTPUT
+        attn_out = rms_norm(
+            attn_out, lp["post_attention_layernorm"]["weight"], eps, zero_centered=zc
+        )
+    x = x + attn_out
 
-    hid = rms_norm(x, lp["post_attention_layernorm"]["weight"], eps)
+    pre_ffn = (
+        "pre_feedforward_layernorm" if config.sandwich_norms
+        else "post_attention_layernorm"
+    )
+    hid = rms_norm(x, lp[pre_ffn]["weight"], eps, zero_centered=zc)
     aux = jnp.float32(0.0)
     if config.num_experts > 0:
         from llm_fine_tune_distributed_tpu.ops.moe import moe_mlp
@@ -261,6 +301,11 @@ def _block(
             # capacity drops would make outputs depend on batch/chunk shape
             dropless=cache_entry is not None,
         )
+        if config.sandwich_norms:
+            moe_out = rms_norm(
+                moe_out, lp["post_feedforward_layernorm"]["weight"], eps,
+                zero_centered=zc,
+            )
         x = x + moe_out
     else:
         gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl)
@@ -269,8 +314,17 @@ def _block(
         # gate/up matmuls are ~58% of a block's param FLOPs, so saving their
         # fused output avoids most of full-remat's recompute at one tensor per
         # layer of extra HBM (vs. two for saving gate and up separately).
-        prod = checkpoint_name(jax.nn.silu(gate) * up, "mlp_act")
-        x = x + _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl)
+        if config.hidden_act == "gelu_tanh":
+            act = jax.nn.gelu(gate.astype(jnp.float32), approximate=True).astype(gate.dtype)
+        else:
+            act = jax.nn.silu(gate)
+        prod = checkpoint_name(act * up, "mlp_act")
+        mlp_out = _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl)
+        if config.sandwich_norms:
+            mlp_out = rms_norm(
+                mlp_out, lp["post_feedforward_layernorm"]["weight"], eps, zero_centered=zc
+            )
+        x = x + mlp_out
     return x, new_entry, aux
 
 
@@ -367,11 +421,16 @@ def forward(
         # without help, so they skip this.
         embed = _lookup_table_constraint(embed, mesh)
     x = constrain(embed[input_ids])
+    if config.embed_scale:
+        # Gemma normalizer: HF multiplies by a sqrt(hidden) scalar cast to
+        # the activation dtype first — mirror the cast for bf16 bit-parity
+        x = x * jnp.asarray(config.hidden_size**0.5, dtype=x.dtype)
     cos, sin = rope_cos_sin(
         positions, config.resolved_head_dim, config.rope_theta, config=config
     )
 
     explicit_mask = None
+    windowed_mask = None
     if segment_ids is not None:
         if cache is not None:
             raise ValueError("segment_ids (packing) and KV cache are exclusive")
@@ -386,7 +445,9 @@ def forward(
             same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]
             explicit_mask = causal & same_seg
             q_pos, k_pos = positions[:, :, None], positions[:, None, :]
-            explicit_mask &= k_pos > q_pos - config.sliding_window
+            # windowed variant for the layers the window applies to; global
+            # layers (Gemma2 odd layers) keep the plain block-causal mask
+            windowed_mask = explicit_mask & (k_pos > q_pos - config.sliding_window)
             segment_ids = None  # consumed into the explicit mask
     elif cache is not None:
         # Mask over the fixed-size buffer: key j visible to query i iff
@@ -395,8 +456,6 @@ def forward(
         k_pos = jnp.arange(kv_len, dtype=jnp.int32)[None, None, :]
         q_pos = positions[:, :, None]
         explicit_mask = k_pos <= q_pos
-        if config.sliding_window is not None:
-            explicit_mask &= k_pos > q_pos - config.sliding_window
         if padding_mask is not None:
             # With a cache, padding_mask must cover the WHOLE buffer
             # [batch, kv_len] (1 = real token at that cache slot), so batched
@@ -407,6 +466,9 @@ def forward(
                     f"(full buffer), got {padding_mask.shape}"
                 )
             explicit_mask &= padding_mask.astype(bool)[:, None, :]
+        if config.sliding_window is not None:
+            # after padding so the windowed variant carries the pad bits too
+            windowed_mask = explicit_mask & (k_pos > q_pos - config.sliding_window)
 
     new_layers = {}
     moe_aux = jnp.float32(0.0)
@@ -420,6 +482,7 @@ def forward(
             compute_dtype=compute_dtype,
             mesh=mesh,
             quant_impl=quant_impl,
+            windowed_mask=windowed_mask,
         )
         if remat and cache is None:
             if remat_policy in (None, "full"):
@@ -455,7 +518,12 @@ def forward(
         if new_entry is not None:
             new_layers[str(i)] = new_entry
 
-    x = rms_norm(x, params["model"]["norm"]["weight"], config.rms_norm_eps)
+    x = rms_norm(
+        x,
+        params["model"]["norm"]["weight"],
+        config.rms_norm_eps,
+        zero_centered=config.zero_centered_norm,
+    )
 
     new_cache = {"layers": new_layers} if cache is not None else None
     if output_hidden:
@@ -522,7 +590,12 @@ def unembed(params: Params, hidden, config: ModelConfig, *, compute_dtype=jnp.bf
         if mesh is not None:
             kernel = _lookup_table_constraint(kernel, mesh, vocab_dim=1)
         logits = h @ kernel
-    return logits.astype(logits_dtype)
+    logits = logits.astype(logits_dtype)
+    if config.final_logit_softcap is not None:
+        # Gemma2 final_logit_softcapping — elementwise, so it composes with
+        # both CE chunking schemes (each slice caps its own logits)
+        logits = softcap(logits, config.final_logit_softcap)
+    return logits
 
 
 def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):

@@ -40,6 +40,15 @@ def _causal_mask(q_len: int, kv_len: int, sliding_window: Optional[int] = None):
     return mask
 
 
+def softcap(x, cap):
+    """Gemma2 logit soft-capping: cap * tanh(x / cap).
+
+    Single definition shared by attention scores, unembed, and the
+    vocab-streamed CE — the streamed loss must stay bit-identical to the
+    materialized-logits path, so the formula must not fork."""
+    return cap * jnp.tanh(x / cap)
+
+
 def xla_attention(
     q,
     k,
@@ -50,6 +59,8 @@ def xla_attention(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     mask=None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ):
     """Reference masked attention with GQA, f32 softmax.
 
@@ -63,12 +74,16 @@ def xla_attention(
     kv_len, num_kv = k.shape[1], k.shape[2]
     groups = num_heads // num_kv
 
-    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
     # [b, q, kv_heads, groups, d]
     qg = q.reshape(b, q_len, num_kv, groups, head_dim)
     # scores: [b, kv_heads, groups, q, kv]
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32))
     scores = scores * scale
+    if logit_softcap is not None:
+        # Gemma2: cap BEFORE masking (HF Gemma2Attention eager path)
+        scores = softcap(scores, logit_softcap)
 
     if mask is not None:
         scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
@@ -123,6 +138,8 @@ def attention(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     mesh=None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ):
     """Dispatch to the selected attention implementation.
 
@@ -131,7 +148,29 @@ def attention(
     those. Without a mesh (or with an unsupported shape) they fall back to
     the flash kernel, which itself degrades to XLA attention when it cannot
     apply.
+
+    ``scale`` / ``logit_softcap`` (Gemma2 query_pre_attn_scalar and
+    attn_logit_softcapping): only the XLA path implements them, so a
+    non-default value routes there directly — the tanh softcap breaks the
+    flash kernel's running-max algebra, and correctness beats kernel speed
+    for the families that need it.
     """
+    if scale is not None or logit_softcap is not None:
+        if impl in ("ring_manual", "ulysses_manual"):
+            # inside a shard_map manual over seq, a block-local xla fallback
+            # would silently drop cross-shard attention — refuse instead
+            raise ValueError(
+                f"{impl} does not support custom scale / logit softcap"
+            )
+        if impl in ("ring", "ulysses"):
+            # loud when a provisioned seq axis goes unused (same contract as
+            # the shape-based fallback)
+            impl = _seq_parallel_fallback(impl, q, mesh)
+        return xla_attention(
+            q, k, v, padding_mask=padding_mask, segment_ids=segment_ids,
+            causal=causal, sliding_window=sliding_window,
+            scale=scale, logit_softcap=logit_softcap,
+        )
     if impl == "ulysses":
         from llm_fine_tune_distributed_tpu.parallel.ulysses import (
             ulysses_attention,

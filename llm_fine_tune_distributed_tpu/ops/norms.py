@@ -6,12 +6,18 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x, weight, eps: float = 1e-6):
-    """x: [..., hidden]; weight: [hidden]. Returns same dtype as x."""
+def rms_norm(x, weight, eps: float = 1e-6, zero_centered: bool = False):
+    """x: [..., hidden]; weight: [hidden]. Returns same dtype as x.
+
+    ``zero_centered``: Gemma convention — the stored weight is an offset
+    from 1 (init zeros), out = normed * (1 + w) (HF Gemma2RMSNorm)."""
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
     normed = x32 * jax.lax.rsqrt(var + eps)
+    w32 = weight.astype(jnp.float32)
+    if zero_centered:
+        w32 = 1.0 + w32
     # HF casts back to input dtype before multiplying by the weight; doing the
     # multiply in f32 and casting once at the end is equivalent within bf16 ulp.
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    return (normed * w32).astype(dtype)

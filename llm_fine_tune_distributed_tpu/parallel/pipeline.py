@@ -328,7 +328,12 @@ def pipeline_forward(
     # micro_dims the [M, mb, ...] layout survives to the caller so the M dim
     # stays cleanly pipe-sharded all the way into the loss.
     h = outs if micro_dims else outs.reshape(M * mb, seq, -1)
-    h = rms_norm(h, params["model"]["norm"]["weight"], config.rms_norm_eps)
+    h = rms_norm(
+        h,
+        params["model"]["norm"]["weight"],
+        config.rms_norm_eps,
+        zero_centered=config.zero_centered_norm,
+    )
     if output_hidden:
         out = h.astype(compute_dtype)
     else:

@@ -109,6 +109,11 @@ def vocab_chunked_ce_sum(params, hidden, targets, mask, model_config: ModelConfi
                 table, (0, i * vocab_chunk), (h, vocab_chunk)
             ).astype(compute_dtype)
             lg = (x @ wc).astype(jnp.float32)
+        if model_config.final_logit_softcap is not None:
+            from llm_fine_tune_distributed_tpu.ops.attention import softcap
+
+            # Gemma2 softcap is elementwise per logit, so it streams
+            lg = softcap(lg, model_config.final_logit_softcap)
         m_new = jnp.maximum(m, lg.max(axis=-1))
         acc = acc * jnp.exp(m - m_new) + jnp.exp(lg - m_new[:, None]).sum(-1)
         loc = flat_targets - i * vocab_chunk

@@ -426,6 +426,14 @@ class SFTTrainer:
                 )
         if cfg.objective not in ("sft", "dpo"):
             problems.append(f"objective={cfg.objective!r}")
+        if mc.alternating_sliding_window:
+            # the schedule's layer-scan treats every layer identically
+            # (layer_idx is data, not Python); the local/global window
+            # alternation needs per-layer static masks
+            problems.append(
+                "alternating_sliding_window (Gemma2) — the pipeline "
+                "layer-scan has no per-layer window support"
+            )
         if cfg.loss_vocab_chunk is not None:
             # the schedule's last stage computes CE via loss_chunk_size only
             # (parallel/pipeline.py) — rejecting beats silently materializing
@@ -1043,6 +1051,16 @@ class SFTTrainer:
                     "attention_bias": mc.attention_bias,
                     "attention_out_bias": mc.attention_out_bias,
                     "qk_norm": mc.qk_norm,
+                    # Gemma2-family knobs (explicit keys beat the
+                    # from_hf_config model_type heuristics on reload)
+                    "hidden_act": mc.hidden_act,
+                    "sandwich_norms": mc.sandwich_norms,
+                    "zero_centered_norm": mc.zero_centered_norm,
+                    "embed_scale": mc.embed_scale,
+                    "attn_logit_softcap": mc.attn_logit_softcap,
+                    "final_logit_softcap": mc.final_logit_softcap,
+                    "query_pre_attn_scalar": mc.query_pre_attn_scalar,
+                    "alternating_sliding_window": mc.alternating_sliding_window,
                     # HF rope_scaling dict shape so any HF-compatible loader
                     # (and our from_hf_config) reads the context extension
                     "rope_scaling": (

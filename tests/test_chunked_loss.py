@@ -95,3 +95,30 @@ def test_vocab_chunk_validations():
     }
     with pytest.raises(ValueError, match="not divisible"):
         make_loss_fn(mc, tc_bad)(trainable, frozen, batch)
+
+
+def test_softcap_streams_through_both_chunking_schemes():
+    """Gemma2 final_logit_softcap must produce the SAME loss from the full
+    path, seq-chunked CE, and vocab-streamed CE (elementwise cap streams)."""
+    mc = get_preset("tiny_gemma2")
+    common = dict(
+        model_preset="tiny_gemma2", max_seq_length=64, compute_dtype="float32"
+    )
+    params = init_params(jax.random.PRNGKey(0), mc)
+    tc_full = TrainConfig(loss_chunk_size=None, **common)
+    trainable, frozen = split_by_mask(params, trainable_mask(params, mc, tc_full))
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": rng.randint(0, mc.vocab_size, (2, 64)).astype(np.int32),
+        "loss_mask": np.ones((2, 64), np.float32),
+        "attention_mask": np.ones((2, 64), np.int32),
+    }
+    loss_full, _ = make_loss_fn(mc, tc_full)(trainable, frozen, batch)
+    loss_seq, _ = make_loss_fn(mc, TrainConfig(loss_chunk_size=32, **common))(
+        trainable, frozen, batch
+    )
+    loss_voc, _ = make_loss_fn(mc, TrainConfig(loss_vocab_chunk=128, **common))(
+        trainable, frozen, batch
+    )
+    assert abs(float(loss_full) - float(loss_seq)) < 1e-5
+    assert abs(float(loss_full) - float(loss_voc)) < 1e-5

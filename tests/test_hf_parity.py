@@ -312,3 +312,41 @@ def test_unsupported_rope_scaling_rejected_at_load():
     )
     with pytest.raises(ValueError, match="unsupported rope_scaling"):
         from_hf_config(hf_cfg)
+
+
+def test_gemma2_tiny_logit_parity():
+    """Gemma2 family: GeGLU, sandwich norms, zero-centered RMSNorm, scaled
+    embeddings, q/final softcaps, query_pre_attn_scalar, alternating
+    local/global sliding window — all gated against HF Gemma2ForCausalLM
+    (eager attention, the impl that honors softcapping)."""
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,  # layers 0/2 sliding, 1/3 global
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=128,
+        sliding_window=8,
+        query_pre_attn_scalar=16.0,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        rope_theta=10000.0,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    hf_cfg._attn_implementation = "eager"
+    torch.manual_seed(0)
+    model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    # zero-centered norms init at 0; perturb so (1+w) != 1 everywhere
+    with torch.no_grad():
+        for name, p in model.named_parameters():
+            if "layernorm" in name or name.endswith("norm.weight"):
+                p.add_(torch.randn_like(p) * 0.1)
+    cfg = from_hf_config(hf_cfg)
+    assert cfg.sandwich_norms and cfg.zero_centered_norm and cfg.embed_scale
+    assert cfg.hidden_act == "gelu_tanh"
+    assert cfg.attn_logit_softcap == 50.0 and cfg.final_logit_softcap == 30.0
+    assert cfg.alternating_sliding_window and cfg.sliding_window == 8
+    # seq > window so the local/global alternation actually differs
+    _compare(model, hf_cfg, seq=24, atol=5e-4)

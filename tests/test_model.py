@@ -162,3 +162,42 @@ def test_auto_remat_policy_by_size_and_seq():
         TrainConfig(max_seq_length=4096, remat_policy="dots").resolved_remat_policy(small)
         == "dots"
     )
+
+
+def test_gemma2_preset_param_count_and_decode():
+    """gemma2_9b preset arithmetic (9.24B, HF google/gemma-2-9b) and
+    KV-cache decode self-consistency for the full Gemma2 feature set
+    (sandwich norms, softcaps, alternating local/global window)."""
+    cfg9 = get_preset("gemma2_9b")
+    assert 9.0e9 < cfg9.num_params < 9.5e9
+    # local/global alternation
+    assert cfg9.layer_sliding_window(0) == 4096
+    assert cfg9.layer_sliding_window(1) is None
+
+    tiny = cfg9.replace(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=2, head_dim=16, sliding_window=6,
+        query_pre_attn_scalar=16.0, max_position_embeddings=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), tiny, dtype=jnp.float32)
+    assert count_params(params) == tiny.num_params
+    l0 = params["model"]["layers"]["0"]
+    assert "pre_feedforward_layernorm" in l0
+    assert float(l0["input_layernorm"]["weight"].sum()) == 0.0  # zero-centered
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, tiny.vocab_size)
+    full_logits, _ = forward(params, ids, tiny, compute_dtype=jnp.float32)
+
+    cache = init_cache(tiny, batch_size=2, max_len=12, dtype=jnp.float32)
+    lg, cache = forward(params, ids[:, :7], tiny, cache=cache, cache_pos=0,
+                        compute_dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(lg), np.asarray(full_logits[:, :7]), rtol=2e-4, atol=2e-4
+    )
+    for t in range(7, 12):
+        lg, cache = forward(params, ids[:, t:t + 1], tiny, cache=cache,
+                            cache_pos=t, compute_dtype=jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(lg[:, 0]), np.asarray(full_logits[:, t]),
+            rtol=2e-4, atol=2e-4,
+        )

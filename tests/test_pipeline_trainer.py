@@ -176,6 +176,9 @@ def test_pipe_rejects_unsupported_combos(qa_parquet, tmp_path):  # noqa: F811
          "freeze_strategy": "none"},
         {"attention_impl": "ulysses", "model_preset": "tiny_moe",
          "freeze_strategy": "none"},
+        # Gemma2's local/global window alternation needs per-layer masks the
+        # pipeline layer-scan cannot express
+        {"model_preset": "tiny_gemma2", "freeze_strategy": "none"},
     ):
         cfg = make_config(
             tmp_path / "bad", data_dir, dataset_file,

@@ -137,3 +137,35 @@ def test_resume_from_checkpoint(qa_parquet, tmp_path):
     t2 = SFTTrainer(config2)
     t2.train()
     assert int(t2.state.step) > step_after
+
+
+@pytest.mark.slow
+def test_gemma2_family_sft_smoke(qa_parquet, tmp_path):
+    """The full Gemma2 knob set survives the real trainer loop (freeze
+    policy, sharding over the 4-norm layers, save) and the saved
+    config.json round-trips every family knob through from_hf_config."""
+    from llm_fine_tune_distributed_tpu.models.configs import from_hf_config
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    out = tmp_path / "outputs"
+    config = make_config(
+        out, data_dir, dataset_file, model_preset="tiny_gemma2", epochs=1
+    )
+    trainer = SFTTrainer(config)
+    trainer.train()
+    losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
+    assert losses[-1] < losses[0]
+
+    import types
+
+    with open(out / "best_model" / "config.json") as f:
+        saved = json.load(f)
+    cfg = from_hf_config(types.SimpleNamespace(**saved))
+    src = trainer.model_config
+    for field in (
+        "hidden_act", "sandwich_norms", "zero_centered_norm", "embed_scale",
+        "attn_logit_softcap", "final_logit_softcap", "query_pre_attn_scalar",
+        "alternating_sliding_window", "sliding_window",
+    ):
+        assert getattr(cfg, field) == getattr(src, field), field
