@@ -201,3 +201,46 @@ def test_gemma2_preset_param_count_and_decode():
             np.asarray(lg[:, 0]), np.asarray(full_logits[:, t]),
             rtol=2e-4, atol=2e-4,
         )
+
+
+def test_new_families_shard_on_mesh():
+    """Qwen3 (qk_norm) and Gemma2 (sandwich norms etc.) param trees shard
+    and train-step on the 8-device mesh: the new 1-D leaves replicate, the
+    jitted fwd+grad matches the unsharded forward."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from llm_fine_tune_distributed_tpu.config import MeshConfig
+    from llm_fine_tune_distributed_tpu.parallel.sharding import param_sharding_rules
+    from llm_fine_tune_distributed_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, tensor=2, seq=1))
+    for preset, tweak in (
+        ("tiny", dict(qk_norm=True, name="tiny_qwen3")),
+        ("tiny_gemma2", {}),
+    ):
+        cfg = get_preset(preset).replace(**tweak)
+        params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+        ids = jnp.ones((4, 16), jnp.int32)
+
+        def loss(p, sharding=None):
+            lg, _ = forward(p, ids, cfg, compute_dtype=jnp.float32,
+                            activation_sharding=sharding)
+            return lg.mean(), lg
+
+        (_, ref_logits), ref_grads = jax.value_and_grad(loss, has_aux=True)(params)
+        sharded = jax.tree_util.tree_map(
+            jax.device_put, params, param_sharding_rules(params, mesh)
+        )
+        act = NamedSharding(mesh, P(("data", "fsdp"), None, None))
+        (_, lg), g = jax.jit(
+            jax.value_and_grad(lambda p: loss(p, act), has_aux=True)
+        )(sharded)
+        np.testing.assert_allclose(
+            np.asarray(lg), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(ref_grads), jax.tree_util.tree_leaves(g)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+            )
