@@ -48,7 +48,9 @@ class ModelConfig:
     # the projections, before RoPE — HF Qwen3Attention q_norm/k_norm).
     qk_norm: bool = False
     # --- Gemma2-style architecture knobs (HF Gemma2Config) ---
-    # "silu" (Llama SwiGLU) or "gelu_tanh" (Gemma GeGLU, gelu_pytorch_tanh)
+    # Gate activation: "silu" (Llama SwiGLU), "gelu_tanh" (Gemma GeGLU /
+    # gelu_pytorch_tanh), or "gelu" (exact erf). MoE models support "silu"
+    # only (enforced in __post_init__; ops/moe.py hardcodes the expert MLP).
     hidden_act: str = "silu"
     # Four norms per layer: post-attention and post-feedforward OUTPUT norms
     # in addition to the two pre-norms (HF Gemma2DecoderLayer ordering)
@@ -92,6 +94,16 @@ class ModelConfig:
     # [b * s/chunk, chunk, E, C_chunk] instead of [b, s, E, C]. Tokens
     # compete for capacity within their chunk only.
     moe_dispatch_chunk: int = 1024
+
+    def __post_init__(self):
+        if self.num_experts and self.hidden_act != "silu":
+            # ops/moe.py's expert MLP hardcodes silu — reject at config
+            # construction rather than silently training with the wrong
+            # activation (same fail-fast contract as rope_scaling parsing)
+            raise ValueError(
+                f"MoE models support hidden_act='silu' only "
+                f"(got {self.hidden_act!r})"
+            )
 
     @property
     def resolved_head_dim(self) -> int:

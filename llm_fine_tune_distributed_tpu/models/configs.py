@@ -97,6 +97,46 @@ PRESETS = {
         rope_high_freq_factor=4.0,
         rope_original_max_position=8192,
     ),
+    "llama3_2_1b": ModelConfig(
+        # HF meta-llama/Llama-3.2-1B: tied embeddings, llama3 rope factor 32
+        name="llama3_2_1b",
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        rope_theta=500_000.0,
+        max_position_embeddings=131072,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=True,
+        rope_scaling_type="llama3",
+        rope_scaling_factor=32.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_position=8192,
+    ),
+    "llama3_2_3b": ModelConfig(
+        # HF meta-llama/Llama-3.2-3B
+        name="llama3_2_3b",
+        vocab_size=128256,
+        hidden_size=3072,
+        intermediate_size=8192,
+        num_layers=28,
+        num_heads=24,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500_000.0,
+        max_position_embeddings=131072,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=True,
+        rope_scaling_type="llama3",
+        rope_scaling_factor=32.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_position=8192,
+    ),
     "llama3_70b": ModelConfig(
         name="llama3_70b",
         vocab_size=128256,
@@ -254,9 +294,11 @@ def _parse_hidden_act(act) -> str:
         return "silu"
     if act in ("gelu_tanh", "gelu_pytorch_tanh", "gelu_new"):
         return "gelu_tanh"
+    if act == "gelu":
+        return "gelu"  # exact (erf) GeLU — early Gemma configs
     raise ValueError(
         f"unsupported hidden_act {act!r}; supported: silu/swish, "
-        "gelu_pytorch_tanh (tanh-approx GeGLU)"
+        "gelu (exact), gelu_pytorch_tanh (tanh-approx GeGLU)"
     )
 
 
@@ -323,7 +365,13 @@ def from_hf_config(hf_config) -> ModelConfig:
         # Explicit keys (written by trainer._save_model_config) win; the
         # model_type heuristic covers pristine HF gemma2 checkpoints.
         hidden_act=_parse_hidden_act(
-            g("hidden_act") or g("hidden_activation") or "silu"
+            # Gemma family: HF's GemmaConfig/Gemma2Config resolve the
+            # activation from hidden_activation, DEFAULTING to
+            # gelu_pytorch_tanh and overriding a stale hidden_act="gelu"
+            # (early gemma configs) with a warning — mirror that precedence.
+            (g("hidden_activation") or "gelu_pytorch_tanh")
+            if str(g("model_type") or "").startswith("gemma")
+            else (g("hidden_act") or g("hidden_activation") or "silu")
         ),
         sandwich_norms=bool(
             g("sandwich_norms", str(g("model_type") or "").startswith("gemma2"))

@@ -316,6 +316,8 @@ def _block(
         # layer of extra HBM (vs. two for saving gate and up separately).
         if config.hidden_act == "gelu_tanh":
             act = jax.nn.gelu(gate.astype(jnp.float32), approximate=True).astype(gate.dtype)
+        elif config.hidden_act == "gelu":
+            act = jax.nn.gelu(gate.astype(jnp.float32), approximate=False).astype(gate.dtype)
         else:
             act = jax.nn.silu(gate)
         prod = checkpoint_name(act * up, "mlp_act")

@@ -350,3 +350,61 @@ def test_gemma2_tiny_logit_parity():
     assert cfg.alternating_sliding_window and cfg.sliding_window == 8
     # seq > window so the local/global alternation actually differs
     _compare(model, hf_cfg, seq=24, atol=5e-4)
+
+
+def test_llama32_presets_param_counts():
+    """Llama-3.2 1B/3B presets: tied embeddings + llama3 rope factor 32 —
+    published HF sizes 1.24B / 3.21B."""
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+
+    p1 = get_preset("llama3_2_1b")
+    assert 1.2e9 < p1.num_params < 1.3e9
+    assert p1.tie_word_embeddings and p1.rope_scaling_factor == 32.0
+    p3 = get_preset("llama3_2_3b")
+    assert 3.1e9 < p3.num_params < 3.3e9
+
+
+def test_exact_gelu_logit_parity():
+    """hidden_act='gelu' (exact erf GeLU) against HF — LlamaConfig with the
+    mlp activation swapped, the one non-tanh GeLU family path."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        rope_theta=10000.0,
+        hidden_act="gelu",
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    assert from_hf_config(hf_cfg).hidden_act == "gelu"
+    _compare(model, hf_cfg)
+
+
+def test_gemma_hidden_act_precedence_and_moe_act_guard():
+    """(a) Gemma-family configs resolve the activation from
+    hidden_activation with a gelu_pytorch_tanh default — a stale
+    hidden_act='gelu' (early gemma configs) must NOT select exact GeLU.
+    (b) MoE + non-silu activation is rejected at config construction."""
+    import types
+
+    cfg = from_hf_config(types.SimpleNamespace(
+        model_type="gemma", vocab_size=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2, hidden_act="gelu",
+    ))
+    assert cfg.hidden_act == "gelu_tanh"
+
+    from llm_fine_tune_distributed_tpu.config import ModelConfig
+
+    with pytest.raises(ValueError, match="silu"):
+        ModelConfig(
+            name="bad", vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_layers=1, num_heads=2, num_kv_heads=2, num_experts=4,
+            hidden_act="gelu_tanh",
+        )
