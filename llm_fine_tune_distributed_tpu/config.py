@@ -308,6 +308,11 @@ class TrainConfig:
     logging_steps: int = 2
     logging_first_step: bool = True
     eval_steps: int = 10
+    # per-device EVAL batch size. None = per_device_batch_size (reference HF
+    # semantics). Forward-only eval holds no grads/optimizer traffic, so much
+    # larger batches fit — fewer scan iterations per sweep, directly cutting
+    # the eval pause the r4 hardware run measured at 60-100s (VERDICT r4 #7).
+    eval_batch_size: Optional[int] = None
     save_steps: int = 500
     save_total_limit: int = 3
     metric_for_best_model: str = "eval_loss"
@@ -404,6 +409,7 @@ class TrainConfig:
         "DPO_BETA": ("dpo_beta", float),
         "LOGGING_STEPS": ("logging_steps", int),
         "EVAL_STEPS": ("eval_steps", int),
+        "EVAL_BATCH_SIZE": ("eval_batch_size", int),
         "EXPERIMENT_NAME": ("experiment_name", str),
     }
 

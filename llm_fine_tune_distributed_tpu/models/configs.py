@@ -285,6 +285,68 @@ def get_preset(name: str) -> ModelConfig:
         raise KeyError(f"unknown model preset {name!r}; available: {sorted(PRESETS)}")
 
 
+def to_hf_dict(mc: ModelConfig) -> dict:
+    """ModelConfig -> HF-style config.json dict (the trainer's saved-artifact
+    contract; reference ``training.py:310-311`` writes HF config.json via
+    save_model). Every architecture knob is explicit so ``from_hf_config``
+    round-trips EXACTLY regardless of the model_type string — the
+    model_type-prefix heuristics below never apply to this framework's own
+    saves. Round-trip pinned by tests/test_hf_parity.py."""
+    return {
+        "model_type": mc.name,
+        "vocab_size": mc.vocab_size,
+        "hidden_size": mc.hidden_size,
+        "intermediate_size": mc.intermediate_size,
+        "num_hidden_layers": mc.num_layers,
+        "num_attention_heads": mc.num_heads,
+        "num_key_value_heads": mc.num_kv_heads,
+        "head_dim": mc.head_dim,
+        "rope_theta": mc.rope_theta,
+        "max_position_embeddings": mc.max_position_embeddings,
+        "rms_norm_eps": mc.rms_norm_eps,
+        "tie_word_embeddings": mc.tie_word_embeddings,
+        "attention_bias": mc.attention_bias,
+        "attention_out_bias": mc.attention_out_bias,
+        "qk_norm": mc.qk_norm,
+        # Gemma2-family knobs (explicit keys beat the from_hf_config
+        # model_type heuristics on reload)
+        "hidden_act": mc.hidden_act,
+        # gemma-family model_types resolve their activation from
+        # hidden_activation (with a gelu_pytorch_tanh default that would
+        # override an exact-GeLU hidden_act on round-trip — ADVICE r4);
+        # write both keys so reload is exact for every family
+        "hidden_activation": mc.hidden_act,
+        "sandwich_norms": mc.sandwich_norms,
+        "zero_centered_norm": mc.zero_centered_norm,
+        "embed_scale": mc.embed_scale,
+        "attn_logit_softcap": mc.attn_logit_softcap,
+        "final_logit_softcap": mc.final_logit_softcap,
+        "query_pre_attn_scalar": mc.query_pre_attn_scalar,
+        "alternating_sliding_window": mc.alternating_sliding_window,
+        # HF rope_scaling dict shape so any HF-compatible loader (and our
+        # from_hf_config) reads the context extension
+        "rope_scaling": (
+            {
+                "rope_type": mc.rope_scaling_type,
+                "factor": mc.rope_scaling_factor,
+                "low_freq_factor": mc.rope_low_freq_factor,
+                "high_freq_factor": mc.rope_high_freq_factor,
+                "original_max_position_embeddings": mc.rope_original_max_position,
+            }
+            if mc.rope_scaling_type
+            else None
+        ),
+        "mlp_bias": mc.mlp_bias,
+        "no_rope_layers": list(mc.no_rope_layers),
+        "sliding_window": mc.sliding_window,
+        # MoE round trip (HF MixtralConfig naming — consumed by
+        # models/configs.from_hf_config at inference load time)
+        "num_local_experts": mc.num_experts,
+        "num_experts_per_tok": mc.num_experts_per_tok,
+        "router_aux_loss_coef": mc.router_aux_coef,
+    }
+
+
 def _parse_hidden_act(act) -> str:
     """Map HF activation names to the two implemented gate activations —
     reject anything else at load time (same contract as the rope_scaling
@@ -311,6 +373,32 @@ def from_hf_config(hf_config) -> ModelConfig:
     (reference ``training.py:97-102``).
     """
     g = lambda k, default=None: getattr(hf_config, k, default)
+    # The qwen*/gemma* model_type-prefix heuristics below were validated
+    # against these exact HF model_types (logit-parity tests,
+    # tests/test_hf_parity.py). An ADJACENT family member — e.g. gemma3_text
+    # (5:1 local/global window pattern, qk-norm, per-layer rope base) or
+    # qwen2_moe (different expert-config keys) — would match the prefix,
+    # load without error, and produce wrong logits. Fail before the
+    # multi-GB weights load instead (same contract as the rope_scaling and
+    # hidden_act checks — ADVICE r4). Checkpoints written by this
+    # framework's trainer carry every knob explicitly (_save_model_config
+    # always writes sandwich_norms AND qk_norm), so they bypass the
+    # heuristics and are accepted under any model_type name.
+    mt = str(g("model_type") or "")
+    _VALIDATED_HEURISTIC_TYPES = {"qwen2", "qwen3", "gemma", "gemma2"}
+    framework_save = g("sandwich_norms") is not None and g("qk_norm") is not None
+    if (
+        mt.startswith(("qwen", "gemma"))
+        and mt not in _VALIDATED_HEURISTIC_TYPES
+        and not framework_save
+    ):
+        raise ValueError(
+            f"unrecognized {mt!r} model_type: the qwen*/gemma* architecture "
+            f"heuristics are validated only for {sorted(_VALIDATED_HEURISTIC_TYPES)} "
+            "(adjacent variants like gemma3/qwen2_moe differ architecturally "
+            "and would silently produce wrong logits). Convert the config to "
+            "explicit keys or add a validated preset."
+        )
     no_rope = g("no_rope_layers") or ()
     # HF rope_scaling dict: {"rope_type"|"type": "llama3"|"linear"|"default",
     # "factor", "low_freq_factor", "high_freq_factor",

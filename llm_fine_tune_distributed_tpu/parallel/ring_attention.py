@@ -141,32 +141,47 @@ def shard_map_seq_attention(local, mesh: Mesh, axis_name: str, q, k, v,
     return fn(*args)
 
 
-def seq_parallel_preconditions(q, k, mesh: Optional[Mesh], *, axis_name: str = "seq",
-                               sliding_window: Optional[int] = None,
-                               causal: bool = True) -> bool:
-    """Checks shared by BOTH sequence-parallel strategies (ring here, Ulysses
-    in parallel/ulysses.py): a live seq axis, causal non-windowed training
-    attention (no decode q_len != kv_len), and shapes divisible by the mesh.
-    Keeping one source of truth stops the two ``*_supported`` predicates from
-    drifting apart."""
+def seq_parallel_static_preconditions(
+    seq_len: int, num_heads: int, num_kv: int, mesh: Optional[Mesh], *,
+    axis_name: str = "seq", sliding_window: Optional[int] = None,
+    causal: bool = True,
+) -> bool:
+    """The MODEL/CONFIG-decidable half of the seq-parallel preconditions:
+    live seq axis, causal non-windowed attention, seq length and (kv) heads
+    divisible by the mesh. Shared by the runtime predicates below AND the
+    trainer's static remat resolution (train/step.static_seq_parallel_size) —
+    one source of truth so a precondition added here can never make runtime
+    fall back while the remat policy still divides per-chip seq (ADVICE r4)."""
     if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] <= 1:
         return False
     if sliding_window is not None or not causal:
         return False  # cross-chunk window bookkeeping not implemented
-    if q.shape[1] != k.shape[1]:
-        return False  # decode/KV-cache path (q_len != kv_len): positions would lie
     n_seq = mesh.shape[axis_name]
     tensor = mesh.shape.get("tensor", 1)
-    batch_ways = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
-    b, s, num_heads, _ = q.shape
-    num_kv = k.shape[2]
     return (
-        s % n_seq == 0
-        and b % batch_ways == 0
+        seq_len % n_seq == 0
         and num_heads % tensor == 0
         and num_kv % tensor == 0
         and (num_heads // tensor) % max(num_kv // tensor, 1) == 0
     )
+
+
+def seq_parallel_preconditions(q, k, mesh: Optional[Mesh], *, axis_name: str = "seq",
+                               sliding_window: Optional[int] = None,
+                               causal: bool = True) -> bool:
+    """Checks shared by BOTH sequence-parallel strategies (ring here, Ulysses
+    in parallel/ulysses.py): the static preconditions above plus the
+    batch/shape facts only known at dispatch time. Keeping one source of
+    truth stops the two ``*_supported`` predicates from drifting apart."""
+    if q.shape[1] != k.shape[1]:
+        return False  # decode/KV-cache path (q_len != kv_len): positions would lie
+    if not seq_parallel_static_preconditions(
+        q.shape[1], q.shape[2], k.shape[2], mesh,
+        axis_name=axis_name, sliding_window=sliding_window, causal=causal,
+    ):
+        return False
+    batch_ways = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    return q.shape[0] % batch_ways == 0
 
 
 def ring_attention_supported(q, k, mesh: Optional[Mesh], *, axis_name: str = "seq",

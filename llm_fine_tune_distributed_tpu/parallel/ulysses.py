@@ -90,6 +90,24 @@ def _local_ulysses_attention(
     return _a2a_heads_to_seq(out, axis_name)  # [b, lq, h, d]
 
 
+def ulysses_static_preconditions(
+    num_heads: int, num_kv: int, mesh: Optional[Mesh], *, axis_name: str = "seq"
+) -> bool:
+    """The ulysses-specific static half: the all_to_all re-partition needs
+    each seq-axis device to receive whole (query and KV) heads. Shared by the
+    runtime predicate below and train/step.static_seq_parallel_size.
+    (Post-a2a GQA grouping needs no extra check: the shared preconditions
+    give heads_local % kv_local == 0, so whole groups divide alongside kv
+    heads.)"""
+    if mesh is None or axis_name not in mesh.shape:
+        return False
+    n_seq = mesh.shape[axis_name]
+    tensor = mesh.shape.get("tensor", 1)
+    heads_local = num_heads // max(tensor, 1)
+    kv_local = num_kv // max(tensor, 1)
+    return heads_local % n_seq == 0 and kv_local % n_seq == 0
+
+
 def ulysses_attention_supported(
     q,
     k,
@@ -100,9 +118,7 @@ def ulysses_attention_supported(
     causal: bool = True,
 ) -> bool:
     """Same contract as ``ring_attention_supported``: the dispatch calls this
-    with global-view shapes and falls back to XLA attention when False.
-    Beyond the shared preconditions, the all_to_all re-partition needs each
-    seq-axis device to receive whole (query and KV) heads."""
+    with global-view shapes and falls back to XLA attention when False."""
     from llm_fine_tune_distributed_tpu.parallel.ring_attention import (
         seq_parallel_preconditions,
     )
@@ -111,13 +127,9 @@ def ulysses_attention_supported(
         q, k, mesh, axis_name=axis_name, sliding_window=sliding_window, causal=causal
     ):
         return False
-    n_seq = mesh.shape[axis_name]
-    tensor = mesh.shape.get("tensor", 1)
-    heads_local = q.shape[2] // max(tensor, 1)
-    kv_local = k.shape[2] // max(tensor, 1)
-    # (post-a2a GQA grouping needs no extra check: the preconditions give
-    # heads_local % kv_local == 0, so whole groups divide alongside kv heads)
-    return heads_local % n_seq == 0 and kv_local % n_seq == 0
+    return ulysses_static_preconditions(
+        q.shape[2], k.shape[2], mesh, axis_name=axis_name
+    )
 
 
 def ulysses_attention(

@@ -512,8 +512,7 @@ class DPOTrainer(SFTTrainer):
     def evaluate(self) -> float:
         import numpy as np
 
-        cfg = self.config
-        bs = cfg.per_device_batch_size * self.dp_size
+        bs = self._eval_global_batch_size()
         n = self.val_arrays["chosen_input_ids"].shape[0]
         if n == 0:
             return float("nan")

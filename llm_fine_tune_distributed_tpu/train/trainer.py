@@ -208,6 +208,7 @@ class SFTTrainer:
                 val_rows, self.tokenizer, cfg.max_seq_length, cfg.completion_only_loss,
                 **prompt_kw,
             )
+        self._attach_completion_mask(val_rows, prompt_kw)
         loader_kw = self._loader_kwargs()
         self.loader = None
         if cfg.use_native_loader:
@@ -241,6 +242,52 @@ class SFTTrainer:
             self.loader = SFTBatchLoader(self.train_arrays, **loader_kw)
         self.steps_per_epoch = self.loader.steps_per_epoch
         self.total_steps = self.steps_per_epoch * cfg.epochs
+
+    def _attach_completion_mask(self, val_rows, prompt_kw) -> None:
+        """Add a ``completion_mask`` to the validation arrays: the loss mask
+        restricted to assistant-answer tokens. The full-sequence ``eval_loss``
+        (reference parity, ``training.py:282`` semantics) is dominated by the
+        constant system prompt — near-zero values mostly measure prompt
+        memorization — so the trainer additionally logs ``eval_loss_answer``
+        computed over this mask in the same eval forward (VERDICT r4 #4).
+
+        Tokenization is identical to the main build (same rows, same
+        tokenizer, same truncation), so under packing the deterministic
+        packer produces the same row layout and the masks align."""
+        cfg = self.config
+        if cfg.completion_only_loss:
+            return  # loss_mask already IS the completion span
+        pipe = "pipe" in self.mesh.axis_names and self.mesh.shape["pipe"] > 1
+        if pipe:
+            return  # the pipeline eval step aggregates a single CE sum
+        if cfg.packing:
+            from llm_fine_tune_distributed_tpu.data.packing import (
+                build_packed_sft_arrays,
+            )
+
+            masked = build_packed_sft_arrays(
+                val_rows, self.tokenizer, cfg.max_seq_length, True, **prompt_kw
+            )
+        else:
+            masked = build_sft_arrays(
+                val_rows, self.tokenizer, cfg.max_seq_length, True, **prompt_kw
+            )
+        assert masked["input_ids"].shape == self.val_arrays["input_ids"].shape
+        self.val_arrays["completion_mask"] = masked["loss_mask"]
+        if masked["loss_mask"].sum() == 0 and is_primary_host():
+            # This is a DATA bug worth shouting about: with the byte-level
+            # test tokenizer the 1378-byte wilderness prompt alone exceeds
+            # seq 1024, so every row truncates to the same prompt prefix and
+            # the model never sees a single answer token — training "loss"
+            # then measures memorization of one constant sequence (exactly
+            # the r4 flagship's unreconciled eval_loss 0.0045 vs babble,
+            # VERDICT r4 weak #2). Fail loud at prep time, not after 3 epochs.
+            print(
+                "WARNING: every validation completion was truncated away "
+                f"(max_seq_length={cfg.max_seq_length} too small for the "
+                "prompt) — the model will never train on answer tokens. "
+                "Raise MAX_SEQ_LENGTH or shorten the system prompt."
+            )
 
     # ----------------------------------------------------------------- state
 
@@ -524,18 +571,21 @@ class SFTTrainer:
         self.eval_step = jax.jit(self._eval_step_fn)
 
         def eval_all(state, staged):
-            """(ce_sum, token_sum) over every staged eval batch in ONE XLA
-            program: a lax.scan over [nb, bs, seq] slabs. One dispatch + one
-            host sync per eval instead of one per batch; the per-batch
-            compute is the same dp-sharded eval step."""
+            """Summed eval-step outputs over every staged eval batch in ONE
+            XLA program: a lax.scan over [nb, bs, seq] slabs. One dispatch +
+            one host sync per eval instead of one per batch; the per-batch
+            compute is the same dp-sharded eval step. The tuple is
+            (ce_sum, tokens) or (ce_sum, tokens, answer_ce_sum,
+            answer_tokens) depending on whether the staged arrays carry a
+            completion_mask (static per compile)."""
             def body(carry, batch):
-                ce, tok = self._eval_step_fn(state, batch)
-                return (carry[0] + ce, carry[1] + tok), None
+                out = self._eval_step_fn(state, batch)
+                return tuple(c + o for c, o in zip(carry, out)), None
 
-            (ce, tok), _ = jax.lax.scan(
-                body, (jnp.float32(0.0), jnp.float32(0.0)), staged
-            )
-            return ce, tok
+            width = 4 if "completion_mask" in staged else 2
+            init = tuple(jnp.float32(0.0) for _ in range(width))
+            sums, _ = jax.lax.scan(body, init, staged)
+            return sums
 
         self._eval_all = jax.jit(eval_all)
         self._staged_eval = None
@@ -599,13 +649,19 @@ class SFTTrainer:
             pad_block[:] = 1
         return np.concatenate([arr, pad_block])
 
+    def _eval_global_batch_size(self) -> int:
+        """Global eval batch: eval_batch_size (per device; forward-only eval
+        fits far larger batches than training — VERDICT r4 #7) or the
+        training microbatch size, x the data-parallel degree."""
+        cfg = self.config
+        return (cfg.eval_batch_size or cfg.per_device_batch_size) * self.dp_size
+
     def _stage_eval_batches(self):
         """Pad + reshape the validation arrays into device-resident
         [nb, bs, seq] slabs, sharded like training batches (batch dim over
         data x fsdp). Built once; every eval after the first is a single
         dispatch with zero host-side array work."""
-        cfg = self.config
-        bs = cfg.per_device_batch_size * self.dp_size
+        bs = self._eval_global_batch_size()
         n = self.val_arrays["input_ids"].shape[0]
         nb = -(-n // bs)
         staged = {
@@ -621,14 +677,20 @@ class SFTTrainer:
         """Token-weighted eval loss over the validation split
         (eval cadence contract: reference ``training.py:270-271``).
 
+        Also computes the answer-only metric (``eval_loss_answer``,
+        VERDICT r4 #4) from the same forward when the validation arrays
+        carry a completion_mask; it is stashed on ``self._last_eval_answer``
+        and logged beside eval_loss — the RETURNED value stays the
+        full-sequence loss (the reference-parity best-model metric).
+
         Distributed: the validation batch dim is sharded over the
         data-parallel axes exactly like a training batch, so per-device work
         is ~1/dp of the set (pinned by tests/test_distributed_eval.py), and
         XLA inserts the (ce_sum, token_count) psum. The whole sweep compiles
         to one scan program with a single host sync per eval."""
-        cfg = self.config
-        bs = cfg.per_device_batch_size * self.dp_size
+        bs = self._eval_global_batch_size()
         n = self.val_arrays["input_ids"].shape[0]
+        self._last_eval_answer = None
         if n == 0:
             return float("nan")
         staged_bytes = sum(
@@ -637,26 +699,33 @@ class SFTTrainer:
         if staged_bytes <= self._EVAL_STAGE_BYTES:
             if self._staged_eval is None:
                 self._staged_eval = self._stage_eval_batches()
-            ce, tokens = self._eval_all(self.state, self._staged_eval)
-            return float(ce) / max(float(tokens), 1.0)
-        # very large validation sets: stream host->device batch by batch
-        total_ce, total_tokens = 0.0, 0.0
-        for lo in range(0, n, bs):
-            batch = {
-                k: v[lo : lo + bs]
-                for k, v in self.val_arrays.items()
-                if k != "lengths"
-            }
-            short = bs - batch["input_ids"].shape[0]
-            if short > 0:
+            sums = [float(x) for x in self._eval_all(self.state, self._staged_eval)]
+        else:
+            # very large validation sets: stream host->device batch by batch
+            sums = None
+            for lo in range(0, n, bs):
                 batch = {
-                    k: self._pad_eval_rows(k, v, short) for k, v in batch.items()
+                    k: v[lo : lo + bs]
+                    for k, v in self.val_arrays.items()
+                    if k != "lengths"
                 }
-            batch = self._device_batch(batch, self._eval_sharding)
-            ce, tokens = self.eval_step(self.state, batch)
-            total_ce += float(ce)
-            total_tokens += float(tokens)
-        return total_ce / max(total_tokens, 1.0)
+                short = bs - batch["input_ids"].shape[0]
+                if short > 0:
+                    batch = {
+                        k: self._pad_eval_rows(k, v, short) for k, v in batch.items()
+                    }
+                batch = self._device_batch(batch, self._eval_sharding)
+                out = self.eval_step(self.state, batch)
+                if sums is None:
+                    sums = [0.0] * len(out)
+                for i, x in enumerate(out):
+                    sums[i] += float(x)
+        if len(sums) == 4 and sums[3] > 0:
+            # ans_tokens == 0 means every completion truncated away (see
+            # _attach_completion_mask's warning) — a 0/1 "loss" would read
+            # as perfect; suppress the metric instead
+            self._last_eval_answer = sums[2] / sums[3]
+        return sums[0] / max(sums[1], 1.0)
 
     # ------------------------------------------------------------------ train
 
@@ -806,6 +875,8 @@ class SFTTrainer:
                                 logs[k] = float(v)
                         if do_eval:
                             logs["eval_loss"] = last_eval
+                            if getattr(self, "_last_eval_answer", None) is not None:
+                                logs["eval_loss_answer"] = self._last_eval_answer
                             logs.update(self.extra_eval_logs)
                         self.metrics.log(step, step / self.steps_per_epoch, logs)
 
@@ -1032,57 +1103,7 @@ class SFTTrainer:
 
     def _save_model_config(self, path: str) -> None:
         """Write a config.json so the inference CLI can rebuild the model."""
-        mc = self.model_config
+        from llm_fine_tune_distributed_tpu.models.configs import to_hf_dict
+
         with open(os.path.join(path, "config.json"), "w") as f:
-            json.dump(
-                {
-                    "model_type": mc.name,
-                    "vocab_size": mc.vocab_size,
-                    "hidden_size": mc.hidden_size,
-                    "intermediate_size": mc.intermediate_size,
-                    "num_hidden_layers": mc.num_layers,
-                    "num_attention_heads": mc.num_heads,
-                    "num_key_value_heads": mc.num_kv_heads,
-                    "head_dim": mc.head_dim,
-                    "rope_theta": mc.rope_theta,
-                    "max_position_embeddings": mc.max_position_embeddings,
-                    "rms_norm_eps": mc.rms_norm_eps,
-                    "tie_word_embeddings": mc.tie_word_embeddings,
-                    "attention_bias": mc.attention_bias,
-                    "attention_out_bias": mc.attention_out_bias,
-                    "qk_norm": mc.qk_norm,
-                    # Gemma2-family knobs (explicit keys beat the
-                    # from_hf_config model_type heuristics on reload)
-                    "hidden_act": mc.hidden_act,
-                    "sandwich_norms": mc.sandwich_norms,
-                    "zero_centered_norm": mc.zero_centered_norm,
-                    "embed_scale": mc.embed_scale,
-                    "attn_logit_softcap": mc.attn_logit_softcap,
-                    "final_logit_softcap": mc.final_logit_softcap,
-                    "query_pre_attn_scalar": mc.query_pre_attn_scalar,
-                    "alternating_sliding_window": mc.alternating_sliding_window,
-                    # HF rope_scaling dict shape so any HF-compatible loader
-                    # (and our from_hf_config) reads the context extension
-                    "rope_scaling": (
-                        {
-                            "rope_type": mc.rope_scaling_type,
-                            "factor": mc.rope_scaling_factor,
-                            "low_freq_factor": mc.rope_low_freq_factor,
-                            "high_freq_factor": mc.rope_high_freq_factor,
-                            "original_max_position_embeddings": mc.rope_original_max_position,
-                        }
-                        if mc.rope_scaling_type
-                        else None
-                    ),
-                    "mlp_bias": mc.mlp_bias,
-                    "no_rope_layers": list(mc.no_rope_layers),
-                    "sliding_window": mc.sliding_window,
-                    # MoE round trip (HF MixtralConfig naming — consumed by
-                    # models/configs.from_hf_config at inference load time)
-                    "num_local_experts": mc.num_experts,
-                    "num_experts_per_tok": mc.num_experts_per_tok,
-                    "router_aux_loss_coef": mc.router_aux_coef,
-                },
-                f,
-                indent=2,
-            )
+            json.dump(to_hf_dict(self.model_config), f, indent=2)

@@ -261,7 +261,9 @@ def test_smollm3_long_context_seq_parallel_traces(impl, eight_devices):
         "attention_mask": jax.ShapeDtypeStruct((accum, b, seq), jnp.int32),
     }
     step = build_train_step(mc, tc, optimizer, activation_sharding=act)
-    with mesh:
+    from llm_fine_tune_distributed_tpu.parallel.diagnostics import assert_seq_parallel
+
+    with assert_seq_parallel(impl), mesh:
         new_state, metrics = jax.eval_shape(step, state, batch)
     assert metrics["loss"].shape == ()
     assert jax.tree.structure(new_state.trainable) == jax.tree.structure(state.trainable)

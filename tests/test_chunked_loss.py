@@ -122,3 +122,42 @@ def test_softcap_streams_through_both_chunking_schemes():
     )
     assert abs(float(loss_full) - float(loss_seq)) < 1e-5
     assert abs(float(loss_full) - float(loss_voc)) < 1e-5
+
+
+@pytest.mark.parametrize("kind", ["full", "seq_chunk", "vocab_chunk"])
+def test_dual_mask_eval_metrics_agree_across_ce_paths(kind):
+    """The answer-only eval metric (completion_mask in the batch) must come
+    out identical from every CE implementation, computed from ONE unembed
+    per path (no doubled eval pause — r5 review finding)."""
+    mc = get_preset("tiny")
+    kw = {"seq_chunk": dict(loss_chunk_size=40),
+          "vocab_chunk": dict(loss_vocab_chunk=128)}.get(kind, {})
+    tc = TrainConfig(model_preset="tiny", max_seq_length=96,
+                     compute_dtype="float32", **kw)
+    params = init_params(jax.random.PRNGKey(0), mc)
+    trainable, frozen = split_by_mask(params, trainable_mask(params, mc, tc))
+    rng = np.random.RandomState(1)
+    lm = (rng.rand(2, 96) > 0.2).astype(np.float32)
+    cm = lm * (rng.rand(2, 96) > 0.5).astype(np.float32)  # strict subset
+    batch = {
+        "input_ids": rng.randint(0, mc.vocab_size, (2, 96)).astype(np.int32),
+        "loss_mask": lm,
+        "attention_mask": np.ones((2, 96), np.int32),
+        "completion_mask": cm,
+    }
+    loss, tokens, ans_ce, ans_tok = make_loss_fn(mc, tc)(trainable, frozen, batch)
+    # reference: full-logits path with the completion mask AS the loss mask
+    ref_batch = dict(batch, loss_mask=cm)
+    ref_batch.pop("completion_mask")
+    ref_loss, ref_tok = make_loss_fn(mc, TrainConfig(
+        model_preset="tiny", max_seq_length=96, compute_dtype="float32"
+    ))(trainable, frozen, ref_batch)
+    assert float(ans_tok) == float(ref_tok)
+    np.testing.assert_allclose(
+        float(ans_ce) / float(ans_tok), float(ref_loss), rtol=2e-5
+    )
+    # and the primary loss is unaffected by the extra mask
+    plain = dict(batch)
+    plain.pop("completion_mask")
+    loss_plain, _ = make_loss_fn(mc, tc)(trainable, frozen, plain)
+    np.testing.assert_allclose(float(loss), float(loss_plain), rtol=1e-6)

@@ -408,3 +408,48 @@ def test_gemma_hidden_act_precedence_and_moe_act_guard():
             num_layers=1, num_heads=2, num_kv_heads=2, num_experts=4,
             hidden_act="gelu_tanh",
         )
+
+
+def test_saved_config_round_trips_exactly_for_every_preset():
+    """to_hf_dict -> from_hf_config must be the identity for this
+    framework's own saves (ADVICE r4: a gemma-family model trained with
+    exact hidden_act='gelu' reloaded as 'gelu_tanh' because only hidden_act
+    was written while the gemma branch reads hidden_activation). Pinned for
+    ALL presets plus the exact-GeLU gemma corner."""
+    import types
+
+    from llm_fine_tune_distributed_tpu.models.configs import PRESETS, to_hf_dict
+
+    cases = list(PRESETS.values()) + [
+        PRESETS["tiny_gemma2"].replace(name="gemma2_tuned", hidden_act="gelu"),
+    ]
+    for mc in cases:
+        restored = from_hf_config(types.SimpleNamespace(**to_hf_dict(mc)))
+        assert restored == mc, (
+            f"{mc.name}: save/load round-trip drifted: "
+            f"{[(f, getattr(mc, f), getattr(restored, f)) for f in mc.__dataclass_fields__ if getattr(mc, f) != getattr(restored, f)]}"
+        )
+
+
+def test_unvalidated_gemma_qwen_model_types_rejected():
+    """Adjacent family members (gemma3*, qwen2_moe, ...) match the
+    model_type-prefix heuristics but differ architecturally — they must be
+    rejected at config-load time, before weights load (ADVICE r4), while
+    validated types and this framework's own saves still load."""
+    import types
+
+    base = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+    )
+    for bad in ("gemma3_text", "gemma3", "qwen2_moe", "qwen2_vl", "qwen3_moe"):
+        with pytest.raises(ValueError, match="model_type"):
+            from_hf_config(types.SimpleNamespace(model_type=bad, **base))
+    # validated HF types still load
+    for ok in ("gemma", "gemma2", "qwen2", "qwen3"):
+        from_hf_config(types.SimpleNamespace(model_type=ok, **base))
+    # framework saves carry explicit keys -> accepted under any name
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset, to_hf_dict
+
+    d = to_hf_dict(get_preset("tiny_gemma2").replace(name="gemma3_style_tuned"))
+    assert from_hf_config(types.SimpleNamespace(**d)).sandwich_norms

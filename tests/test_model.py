@@ -164,6 +164,44 @@ def test_auto_remat_policy_by_size_and_seq():
     )
 
 
+def test_static_seq_parallel_size_gates_on_live_seq_path(eight_devices):
+    """The auto remat policy must key on the seq sharding that ACTUALLY
+    applies (ADVICE r4): a provisioned seq axis counts only when the
+    attention impl is ring/ulysses AND the static preconditions hold —
+    otherwise runtime falls back to full per-chip sequences and a divided
+    policy would under-remat and OOM."""
+    from jax.sharding import Mesh
+
+    from llm_fine_tune_distributed_tpu.config import MeshConfig, TrainConfig
+    from llm_fine_tune_distributed_tpu.runtime.mesh import make_mesh
+    from llm_fine_tune_distributed_tpu.train.step import static_seq_parallel_size
+
+    small = get_preset("smollm3_3b")
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, tensor=1, seq=4), eight_devices)
+
+    # live seq axis + ring + divisible -> the axis counts
+    tc = TrainConfig(max_seq_length=8192, attention_impl="ring")
+    assert static_seq_parallel_size(small, tc, mesh) == 4
+    # seq axis provisioned but attention_impl is not sequence-parallel:
+    # runtime never shards the sequence -> full per-chip seq
+    tc = TrainConfig(max_seq_length=8192, attention_impl="flash")
+    assert static_seq_parallel_size(small, tc, mesh) == 1
+    # indivisible seq length -> runtime fallback -> full per-chip seq
+    tc = TrainConfig(max_seq_length=8190, attention_impl="ring")
+    assert static_seq_parallel_size(small, tc, mesh) == 1
+    # ulysses capped by kv heads: smollm3 has 4 kv heads, seq=4 divides ->
+    # live; a model with 2 kv heads on seq=4 falls back
+    tc = TrainConfig(max_seq_length=8192, attention_impl="ulysses")
+    assert static_seq_parallel_size(small, tc, mesh) == 4
+    assert static_seq_parallel_size(get_preset("tiny"), tc, mesh) == 1
+    # sliding-window models: seq-parallel impls reject windows
+    tc = TrainConfig(max_seq_length=8192, attention_impl="ring")
+    assert static_seq_parallel_size(get_preset("mistral_7b").replace(
+        sliding_window=4096), tc, mesh) == 1
+    # no mesh -> 1
+    assert static_seq_parallel_size(small, tc, None) == 1
+
+
 def test_gemma2_preset_param_count_and_decode():
     """gemma2_9b preset arithmetic (9.24B, HF google/gemma-2-9b) and
     KV-cache decode self-consistency for the full Gemma2 feature set

@@ -145,8 +145,6 @@ def test_packed_training_with_seq_axis_matches_flat(tmp_path, eight_devices):
     """packing x sequence parallelism (VERDICT r3 #5): a packed train step on
     a live seq axis (ring and ulysses) computes the SAME loss as the flat-mesh
     XLA-attention step — same data, same seed, same init."""
-    import warnings
-
     from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
     from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
 
@@ -188,12 +186,13 @@ def test_packed_training_with_seq_axis_matches_flat(tmp_path, eight_devices):
     ref = one_step_loss(
         make(tmp_path / "flat", "xla", MeshConfig(data=1, fsdp=2, tensor=1, seq=1))
     )
-    with warnings.catch_warnings():
-        # the seq axis must actually be used: the old fallback warned
-        warnings.filterwarnings("error", category=UserWarning, message=".*attention.*")
+    from llm_fine_tune_distributed_tpu.parallel.diagnostics import assert_seq_parallel
+
+    with assert_seq_parallel("ring"):
         ring = one_step_loss(
             make(tmp_path / "ring", "ring", MeshConfig(data=1, fsdp=2, tensor=1, seq=2))
         )
+    with assert_seq_parallel("ulysses"):
         uly = one_step_loss(
             make(tmp_path / "uly", "ulysses", MeshConfig(data=1, fsdp=2, tensor=1, seq=2))
         )

@@ -419,10 +419,14 @@ def test_pipe_seq_parallel_attention_trains(qa_parquet, tmp_path, impl):  # noqa
         epochs=1, attention_impl=impl,
         mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=2, pipe=2),
     )
-    flat = SFTTrainer(flat_cfg)
-    flat.train()
-    pipe = SFTTrainer(pipe_cfg)
-    pipe.train()
+    from llm_fine_tune_distributed_tpu.parallel.diagnostics import assert_seq_parallel
+
+    with assert_seq_parallel(impl):
+        flat = SFTTrainer(flat_cfg)
+        flat.train()
+    with assert_seq_parallel(f"{impl}_manual"):
+        pipe = SFTTrainer(pipe_cfg)
+        pipe.train()
 
     flat_losses = [h["loss"] for h in flat.metrics.history if "loss" in h]
     pipe_losses = [h["loss"] for h in pipe.metrics.history if "loss" in h]

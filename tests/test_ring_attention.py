@@ -119,11 +119,9 @@ def test_ring_segments_via_dispatch(eight_devices):
     q, k, v = _qkv(b=2, s=32)
     seg = _segments(2, 32, pad_tail=4)
     ref = xla_attention(q, k, v, segment_ids=seg, causal=True)
-    import warnings
+    from llm_fine_tune_distributed_tpu.parallel.diagnostics import assert_seq_parallel
 
-    with warnings.catch_warnings():
-        # the old path warned before falling back; only that warning matters
-        warnings.filterwarnings("error", category=UserWarning, message=".*attention.*")
+    with assert_seq_parallel("ring"):
         out = jax.jit(
             lambda a, b_, c, s_: attention(
                 a, b_, c, impl="ring", mesh=mesh, segment_ids=s_
@@ -185,14 +183,17 @@ def test_model_forward_with_ring(eight_devices):
 
     ref, _ = forward(params, ids, config, attention_impl="xla", compute_dtype=jnp.float32)
     act = NamedSharding(mesh, P(("data", "fsdp"), "seq", None))
-    out, _ = jax.jit(
-        lambda p, i: forward(
-            p,
-            i,
-            config,
-            attention_impl="ring",
-            compute_dtype=jnp.float32,
-            activation_sharding=act,
-        )
-    )(params, ids)
+    from llm_fine_tune_distributed_tpu.parallel.diagnostics import assert_seq_parallel
+
+    with assert_seq_parallel("ring"):
+        out, _ = jax.jit(
+            lambda p, i: forward(
+                p,
+                i,
+                config,
+                attention_impl="ring",
+                compute_dtype=jnp.float32,
+                activation_sharding=act,
+            )
+        )(params, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4)

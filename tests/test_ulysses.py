@@ -157,16 +157,19 @@ def test_model_forward_with_ulysses(eight_devices):
 
     ref, _ = forward(params, ids, config, attention_impl="xla", compute_dtype=jnp.float32)
     act = NamedSharding(mesh, P(("data", "fsdp"), "seq", None))
-    out, _ = jax.jit(
-        lambda p, i: forward(
-            p,
-            i,
-            config,
-            attention_impl="ulysses",
-            compute_dtype=jnp.float32,
-            activation_sharding=act,
-        )
-    )(params, ids)
+    from llm_fine_tune_distributed_tpu.parallel.diagnostics import assert_seq_parallel
+
+    with assert_seq_parallel("ulysses"):
+        out, _ = jax.jit(
+            lambda p, i: forward(
+                p,
+                i,
+                config,
+                attention_impl="ulysses",
+                compute_dtype=jnp.float32,
+                activation_sharding=act,
+            )
+        )(params, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4)
 
 
@@ -216,8 +219,11 @@ def test_train_step_with_ulysses_matches_xla(eight_devices):
         _, metrics = step(state, batch)
         return float(metrics["loss"]), float(metrics["grad_norm"])
 
+    from llm_fine_tune_distributed_tpu.parallel.diagnostics import assert_seq_parallel
+
     mesh = _mesh(eight_devices, data=2, fsdp=2, seq=2)
     loss_ref, gn_ref = run("xla", None, None)
-    loss_uly, gn_uly = run("ulysses", mesh, P(("data", "fsdp"), "seq", None))
+    with assert_seq_parallel("ulysses"):
+        loss_uly, gn_uly = run("ulysses", mesh, P(("data", "fsdp"), "seq", None))
     np.testing.assert_allclose(loss_uly, loss_ref, rtol=1e-4)
     np.testing.assert_allclose(gn_uly, gn_ref, rtol=1e-3)
