@@ -344,6 +344,19 @@ class TrainConfig:
     # (docs/single-vs-distributed-comparison.md:571-580)
     desync_check_steps: int = 0
 
+    # checkpoint payload / overlap (VERDICT r4 #1)
+    # trainable-only: persist (step, trainable masters, optimizer state) +
+    # a fingerprint of the frozen params, re-deriving the frozen 86.4% from
+    # the base checkpoint/seed at restore — cuts the flagship checkpoint
+    # 7.4 GB -> ~2.1 GB. Incompatible with cross-mesh-layout (pipe<->flat)
+    # resume; use full checkpoints when planning an elastic layout change.
+    checkpoint_trainable_only: bool = False
+    # single-process runs: hand the device->host stream + Orbax write to a
+    # background thread after an on-device snapshot, so the next train step
+    # never blocks on checkpoint IO (transient HBM: one payload copy).
+    # Multi-process saves always use Orbax's own async path.
+    checkpoint_async_snapshot: bool = True
+
     # resume
     resume_from_checkpoint: Optional[str] = None  # "latest" or a path
 
@@ -405,18 +418,33 @@ class TrainConfig:
         "LOSS_CHUNK_SIZE": ("loss_chunk_size", int),
         "LOSS_VOCAB_CHUNK": ("loss_vocab_chunk", int),
         "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
+        "CHECKPOINT_TRAINABLE_ONLY": ("checkpoint_trainable_only", "_env_bool"),
+        "CHECKPOINT_ASYNC_SNAPSHOT": ("checkpoint_async_snapshot", "_env_bool"),
         "OBJECTIVE": ("objective", str),
         "DPO_BETA": ("dpo_beta", float),
         "LOGGING_STEPS": ("logging_steps", int),
         "EVAL_STEPS": ("eval_steps", int),
         "EVAL_BATCH_SIZE": ("eval_batch_size", int),
+        "SAVE_STEPS": ("save_steps", int),
+        "SAVE_TOTAL_LIMIT": ("save_total_limit", int),
         "EXPERIMENT_NAME": ("experiment_name", str),
     }
+
+    @staticmethod
+    def _env_bool(s: str) -> bool:
+        v = s.strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"boolean env var must be 1/0/true/false/yes/no/on/off, got {s!r}")
 
     def apply_env_overrides(self, environ=None) -> "TrainConfig":
         env = os.environ if environ is None else environ
         for var, (attr, cast) in self._ENV_MAP.items():
             if var in env and env[var] != "":
+                if cast == "_env_bool":
+                    cast = self._env_bool
                 setattr(self, attr, cast(env[var]))
         return self
 

@@ -1,5 +1,6 @@
 """Checkpointing: Orbax multi-host sharded save/restore with keep-N rotation,
-best-eval-loss tracking, and explicit resume.
+best-eval-loss tracking, explicit resume, a trainable-only payload mode, and
+a non-blocking snapshot saver.
 
 Reference parity (C9/C10 + SURVEY.md §5.4):
 - ``save_steps=500`` / ``save_total_limit=3`` rotation (``training.py:268,276``)
@@ -14,17 +15,87 @@ Reference parity (C9/C10 + SURVEY.md §5.4):
   single-file safetensors export for the inference contract
   (``best_model/``, ``training.py:310-311``) is done separately at end of
   training via models/hf_io.py.
+
+TPU-native additions beyond the reference (VERDICT r4 #1):
+- **Trainable-only payload** (``trainable_only=True``): the frozen 86.4% of a
+  last-2-layers SFT (~5.3 GB of the flagship's 7.4 GB checkpoint) is
+  byte-reconstructible from the base checkpoint / init seed, so only
+  (step, trainable masters, optimizer state) is persisted, plus a per-leaf
+  fingerprint of the frozen params verified at restore — a silent change of
+  the base weights between save and resume is a hard error, not silent
+  corruption.
+- **Non-blocking snapshot save** (``snapshot_async=True``, single-process):
+  ``save()`` takes an on-device copy of the payload (device-side, fast) and
+  hands serialization to a background thread, so the training loop resumes
+  immediately while the device->host stream drains — the r4 flagship lost
+  ~75% of wall-clock to synchronous 7.4 GB checkpoint transfers over the
+  tunneled link (BASELINE.md). The on-device copy must exist BEFORE the next
+  donated train step reuses the state buffers; transient HBM cost is one
+  copy of the (trainable-only) payload.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
 
 from llm_fine_tune_distributed_tpu.train.state import TrainState
+
+
+class FingerprintMismatch(RuntimeError):
+    """The re-derived frozen params do not match what a trainable-only
+    checkpoint was trained against. Deliberately NOT retried/fallback-ed by
+    the trainer's resume chain: the checkpoint is fine, the base weights are
+    wrong — retrying other layouts would bury the real diagnosis."""
+
+
+def frozen_fingerprint(frozen: Dict[str, Any]):
+    """Per-leaf integrity stats of the frozen params, computed ON DEVICE
+    (fetching 5.3 GB to hash bytes would cost exactly the transfer the
+    trainable-only mode avoids): [sum(|x|), sum(x*x), count] in f32 per leaf.
+    Deterministic for a fixed program, and any re-derivation drift (wrong
+    base checkpoint, wrong seed, wrong quantization knobs) moves the sums.
+    Non-float leaves (NF4 codes, int8 absmax) hash via their int sums."""
+
+    @jax.jit
+    def stats(tree):
+        out = {}
+        for k, v in tree.items():
+            x = v.astype(jnp.float32)
+            out[k] = jnp.stack(
+                [jnp.abs(x).sum(), (x * x).sum(), jnp.float32(x.size)]
+            )
+        return out
+
+    return {k: np.asarray(v) for k, v in stats(frozen).items()}
+
+
+def verify_fingerprint(saved: Dict[str, Any], current: Dict[str, Any]) -> None:
+    """Hard error when the re-derived frozen params do not match the ones the
+    checkpoint was trained against. rtol tolerates cross-platform reduction
+    order (save on TPU, restore on CPU), nothing more."""
+    saved_keys, cur_keys = set(saved), set(current)
+    if saved_keys != cur_keys:
+        raise FingerprintMismatch(
+            "trainable-only checkpoint: frozen param STRUCTURE changed since "
+            f"save (missing: {sorted(saved_keys - cur_keys)[:5]}, "
+            f"extra: {sorted(cur_keys - saved_keys)[:5]}) — resume with the "
+            "original base checkpoint/config"
+        )
+    for k in saved:
+        s, c = np.asarray(saved[k]), np.asarray(current[k])
+        if s[2] != c[2] or not np.allclose(s[:2], c[:2], rtol=1e-4, atol=1e-6):
+            raise FingerprintMismatch(
+                f"trainable-only checkpoint: frozen leaf {k!r} does not match "
+                f"the weights it was trained against (saved [|x|,x^2,n]={s}, "
+                f"re-derived={c}) — the base checkpoint or init seed changed"
+            )
 
 
 class CheckpointManager:
@@ -34,12 +105,14 @@ class CheckpointManager:
         max_to_keep: int = 3,
         metric_name: str = "eval_loss",
         greater_is_better: bool = False,
+        trainable_only: bool = False,
     ):
         directory = os.path.abspath(directory)
         if jax.process_index() == 0:
             os.makedirs(directory, exist_ok=True)
         self.metric_name = metric_name
         self.greater_is_better = greater_is_better
+        self.trainable_only = trainable_only
         # Missing metric maps to the WORST value for the configured mode so a
         # metric-less checkpoint can never rank best.
         worst = -float("inf") if greater_is_better else float("inf")
@@ -51,18 +124,127 @@ class CheckpointManager:
             create=True,
         )
         self._mgr = ocp.CheckpointManager(directory, options=options)
+        self._snapshot_thread: Optional[threading.Thread] = None
+        self._snapshot_error: Optional[BaseException] = None
+        # full-payload async mode: frozen params never change during a run,
+        # so they are fetched to host ONCE (first save) and reused — the
+        # per-save on-device snapshot then covers only step/trainable/opt,
+        # bounding transient HBM to the trainable payload in both modes
+        self._frozen_host: Optional[Dict[str, np.ndarray]] = None
 
-    def save(self, step: int, state: TrainState, metrics: Optional[Dict[str, float]] = None):
-        # metrics=None stays None (not {}) so Orbax's
-        # keep_checkpoints_without_metrics applies to metric-less saves.
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(state=ocp.args.StandardSave(state)),
-            metrics=metrics,
+    # ------------------------------------------------------------------ save
+
+    def _payload(self, state: TrainState, fingerprint=None):
+        """The pytree actually persisted. Trainable-only mode drops the
+        frozen dict (re-derived at restore) and stores the fingerprint."""
+        if not self.trainable_only:
+            return state
+        return {
+            "step": state.step,
+            "trainable": state.trainable,
+            "opt_state": state.opt_state,
+            "frozen_fp": fingerprint or {},
+        }
+
+    def save(
+        self,
+        step: int,
+        state: TrainState,
+        metrics: Optional[Dict[str, float]] = None,
+        fingerprint=None,
+        snapshot_async: bool = False,
+    ):
+        """Persist ``step``'s state.
+
+        ``snapshot_async=True`` (single-process only): on-device copy + background
+        serialization — the caller's next train step is NOT blocked on the
+        device->host stream. Any error from the background save surfaces on
+        the next save()/wait()/close().
+        """
+        self._raise_pending_snapshot_error()
+        if self.trainable_only and not fingerprint:
+            raise ValueError(
+                "trainable_only save needs the frozen-param fingerprint — a "
+                "checkpoint without one can never be restored in lean mode"
+            )
+        if not snapshot_async or jax.process_count() > 1:
+            payload = self._payload(state, fingerprint)
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(state=ocp.args.StandardSave(payload)),
+                metrics=metrics,
+            )
+            return
+        # Wait out the previous background save first: bounds transient HBM
+        # to ONE extra payload copy and serializes Orbax manager access.
+        self.join_snapshot()
+        if not self.trainable_only and self._frozen_host is None:
+            # one-time synchronous fetch; every later save reuses it (frozen
+            # leaves are never touched by the optimizer by construction)
+            self._frozen_host = {
+                k: np.asarray(v) for k, v in state.frozen.items()
+            }
+        # On-device snapshot of the MUTATING leaves only (fresh buffers): the
+        # caller's next donated train step reuses the live state buffers, so
+        # the copy must be enqueued BEFORE it — jnp.copy dispatches in stream
+        # order and costs device time only, not a host sync.
+        snap_box = [
+            jax.tree.map(
+                jnp.copy,
+                {
+                    "step": state.step,
+                    "trainable": state.trainable,
+                    "opt_state": state.opt_state,
+                },
+            )
+        ]
+
+        def _bg_save():
+            try:
+                # block on the snapshot (the copy happens on-stream while
+                # training continues), fetch to host, then FREE the device
+                # copy before the potentially slow Orbax write
+                host = jax.tree.map(lambda x: np.asarray(x), snap_box[0])
+                snap_box[0] = None
+                if self.trainable_only:
+                    host["frozen_fp"] = fingerprint
+                else:
+                    host = TrainState(
+                        step=host["step"],
+                        trainable=host["trainable"],
+                        frozen=self._frozen_host,
+                        opt_state=host["opt_state"],
+                    )
+                self._mgr.save(
+                    step,
+                    args=ocp.args.Composite(state=ocp.args.StandardSave(host)),
+                    metrics=metrics,
+                )
+                self._mgr.wait_until_finished()
+            except BaseException as e:  # surfaced on next save/wait/close
+                self._snapshot_error = e
+
+        self._snapshot_thread = threading.Thread(
+            target=_bg_save, name=f"ckpt-snapshot-{step}", daemon=True
         )
+        self._snapshot_thread.start()
+
+    def join_snapshot(self) -> None:
+        if self._snapshot_thread is not None:
+            self._snapshot_thread.join()
+            self._snapshot_thread = None
+        self._raise_pending_snapshot_error()
+
+    def _raise_pending_snapshot_error(self) -> None:
+        if self._snapshot_error is not None:
+            e, self._snapshot_error = self._snapshot_error, None
+            raise RuntimeError(f"background checkpoint save failed: {e}") from e
 
     def wait(self) -> None:
+        self.join_snapshot()
         self._mgr.wait_until_finished()
+
+    # --------------------------------------------------------------- restore
 
     @property
     def latest_step(self) -> Optional[int]:
@@ -72,15 +254,59 @@ class CheckpointManager:
     def best_step(self) -> Optional[int]:
         return self._mgr.best_step()
 
-    def restore(self, step: int, abstract_state: TrainState) -> TrainState:
+    def restore(
+        self,
+        step: int,
+        abstract_state: TrainState,
+        trainable_only: Optional[bool] = None,
+    ) -> TrainState:
         """Restore into the given abstract state (jax.eval_shape of the real
-        one, carrying shardings) so arrays land directly on the right devices."""
+        one, carrying shardings) so arrays land directly on the right devices.
+
+        ``trainable_only`` overrides the manager's payload mode for THIS
+        restore — the trainer uses it to fall back when resuming a
+        checkpoint written in the other mode (e.g. a pre-existing full
+        checkpoint resumed by a trainable-only run).
+
+        Trainable-only restore: ``abstract_state.frozen`` must be the REAL
+        (already re-derived) frozen params, not abstract — they are carried
+        into the result unchanged and verified against the saved fingerprint.
+        """
+        if trainable_only is None:
+            trainable_only = self.trainable_only
+        if not trainable_only:
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract_state)),
+            )
+            return restored["state"]
+        frozen = abstract_state.frozen
+        if any(isinstance(v, jax.ShapeDtypeStruct) for v in frozen.values()):
+            raise ValueError(
+                "trainable-only restore needs the re-derived frozen params "
+                "(real arrays) on abstract_state.frozen"
+            )
+        fp_abstract = {
+            k: jax.ShapeDtypeStruct((3,), np.float32) for k in frozen
+        }
+        abstract = {
+            "step": abstract_state.step,
+            "trainable": abstract_state.trainable,
+            "opt_state": abstract_state.opt_state,
+            "frozen_fp": fp_abstract,
+        }
         restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract_state)),
+            step, args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract))
+        )["state"]
+        verify_fingerprint(restored["frozen_fp"], frozen_fingerprint(frozen))
+        return TrainState(
+            step=restored["step"],
+            trainable=restored["trainable"],
+            frozen=frozen,
+            opt_state=restored["opt_state"],
         )
-        return restored["state"]
 
     def close(self) -> None:
+        self.join_snapshot()
         self._mgr.wait_until_finished()
         self._mgr.close()

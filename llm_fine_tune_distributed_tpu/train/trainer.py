@@ -729,6 +729,28 @@ class SFTTrainer:
 
     # ------------------------------------------------------------------ train
 
+    def _ckpt_save(self, ckpt: CheckpointManager, step: int, metrics) -> None:
+        """One save-call shape for the loop and the final save: trainable-only
+        payload + frozen fingerprint when configured, background snapshot
+        save on single-process runs (VERDICT r4 #1 — the next train step
+        must not block on the device->host checkpoint stream)."""
+        fp = None
+        if ckpt.trainable_only:
+            if not hasattr(self, "_frozen_fp"):
+                from llm_fine_tune_distributed_tpu.train.checkpoints import (
+                    frozen_fingerprint,
+                )
+
+                self._frozen_fp = frozen_fingerprint(self.state.frozen)
+            fp = self._frozen_fp
+        ckpt.save(
+            step,
+            self.state,
+            metrics=metrics,
+            fingerprint=fp,
+            snapshot_async=self.config.checkpoint_async_snapshot,
+        )
+
     def train(self) -> Dict[str, Any]:
         cfg = self.config
         ckpt_dir = os.path.join(cfg.output_dir, "checkpoints")
@@ -737,6 +759,7 @@ class SFTTrainer:
             max_to_keep=cfg.save_total_limit,
             metric_name=cfg.metric_for_best_model,
             greater_is_better=cfg.greater_is_better,
+            trainable_only=cfg.checkpoint_trainable_only,
         )
 
         resumed_step = 0
@@ -881,7 +904,7 @@ class SFTTrainer:
                         self.metrics.log(step, step / self.steps_per_epoch, logs)
 
                     if do_save:
-                        ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+                        self._ckpt_save(ckpt, step, {cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
                     if do_eval or do_save:
                         # eval sweeps / checkpoint saves must not count
                         # against the NEXT steady-state interval (the
@@ -900,7 +923,7 @@ class SFTTrainer:
             ):
                 best_eval = last_eval
                 best_trainable = None  # current state IS best
-        ckpt.save(step, self.state, metrics={cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+        self._ckpt_save(ckpt, step, {cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
         ckpt.wait()
 
         if cfg.load_best_model_at_end and best_trainable is not None:
@@ -937,8 +960,47 @@ class SFTTrainer:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             self.state,
         )
+        # Trainable-only restores re-derive the frozen params from the base
+        # checkpoint/seed: _prepare_state already built them, so hand the
+        # REAL frozen arrays through (verified against the saved fingerprint).
+        partial_abstract = abstract.replace(frozen=self.state.frozen)
+        from llm_fine_tune_distributed_tpu.train.checkpoints import (
+            FingerprintMismatch,
+        )
+
         try:
-            self.state = ckpt.restore(step, abstract)
+            if ckpt.trainable_only:
+                try:
+                    self.state = ckpt.restore(step, partial_abstract)
+                except FingerprintMismatch:
+                    # the base weights changed, NOT the payload layout —
+                    # falling back would bury the real diagnosis
+                    raise
+                except Exception:
+                    # the checkpoint on disk may predate trainable-only mode
+                    # (a full payload) — accept it
+                    self.state = ckpt.restore(step, abstract, trainable_only=False)
+                    if is_primary_host():
+                        print(
+                            f"Resumed FULL checkpoint step {step} into a "
+                            "trainable-only run (subsequent saves are lean)"
+                        )
+            else:
+                try:
+                    self.state = ckpt.restore(step, abstract)
+                except Exception:
+                    # inverse mismatch: lean checkpoint, full-mode run
+                    self.state = ckpt.restore(
+                        step, partial_abstract, trainable_only=True
+                    )
+                    if is_primary_host():
+                        print(
+                            f"Resumed trainable-only checkpoint step {step} "
+                            "into a full-checkpoint run (frozen params "
+                            "re-derived and fingerprint-verified)"
+                        )
+        except FingerprintMismatch:
+            raise
         except Exception as e:
             # Tree mismatch usually means a mesh-layout change across resume:
             # pipe>1 checkpoints store layer params stacked under

@@ -1,0 +1,212 @@
+"""Trainable-only + non-blocking checkpointing (VERDICT r4 #1).
+
+The flagship checkpoint was 7.4 GB of which ~5.3 GB were frozen bf16 leaves
+byte-reconstructible from the base checkpoint/seed; saves blocked the train
+loop 359-680 s each on the tunneled link. These tests pin the lean payload
+(frozen params NOT persisted, fingerprint-verified at restore), the
+background snapshot save, and cross-mode resume compatibility.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from llm_fine_tune_distributed_tpu.config import MeshConfig, TrainConfig
+
+from test_train_e2e import make_config, qa_parquet  # noqa: F401 (fixture)
+
+
+def _du(path):
+    total = 0
+    for root, _, files in os.walk(path):
+        for f in files:
+            total += os.path.getsize(os.path.join(root, f))
+    return total
+
+
+def _train(cfg, rng_seed=None):
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    trainer = SFTTrainer(cfg, rng_seed=rng_seed)
+    trainer.train()
+    return trainer
+
+
+def test_trainable_only_checkpoint_roundtrip_and_size(qa_parquet, tmp_path):  # noqa: F811
+    data_dir, dataset_file = qa_parquet
+
+    full_cfg = make_config(
+        tmp_path / "full", data_dir, dataset_file, epochs=1, save_steps=5,
+        use_native_loader=False, checkpoint_trainable_only=False,
+        checkpoint_async_snapshot=False,
+    )
+    full = _train(full_cfg)
+
+    lean_cfg = make_config(
+        tmp_path / "lean", data_dir, dataset_file, epochs=1, save_steps=5,
+        use_native_loader=False, checkpoint_trainable_only=True,
+        checkpoint_async_snapshot=False,
+    )
+    lean = _train(lean_cfg)
+
+    # identical training trajectory (payload mode is storage-only)
+    f_losses = [h["loss"] for h in full.metrics.history if "loss" in h]
+    l_losses = [h["loss"] for h in lean.metrics.history if "loss" in h]
+    np.testing.assert_allclose(l_losses, f_losses, rtol=1e-6)
+
+    # the lean checkpoint drops the frozen leaves: tiny's freeze policy keeps
+    # ~59% trainable, so expect a measurable (not 3.5x — that ratio is the
+    # flagship's 13.62% trainable) size cut
+    full_size = _du(tmp_path / "full" / "checkpoints")
+    lean_size = _du(tmp_path / "lean" / "checkpoints")
+    assert lean_size < full_size, (lean_size, full_size)
+
+    # resume the lean run: bit-identical trainable/opt state + step
+    resume_cfg = make_config(
+        tmp_path / "lean", data_dir, dataset_file, epochs=1, save_steps=5,
+        use_native_loader=False, checkpoint_trainable_only=True,
+        checkpoint_async_snapshot=False, resume_from_checkpoint="latest",
+    )
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+    from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+
+    resumed = SFTTrainer(resume_cfg)
+    ckpt = CheckpointManager(
+        str(tmp_path / "lean" / "checkpoints"), trainable_only=True
+    )
+    step = ckpt.latest_step
+    assert step is not None
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        resumed.state,
+    ).replace(frozen=resumed.state.frozen)
+    restored = ckpt.restore(step, abstract)
+    assert int(restored.step) == step
+    for k, v in restored.trainable.items():
+        assert np.asarray(v).dtype == np.asarray(resumed.state.trainable[k]).dtype
+    # frozen carried through unchanged (same objects)
+    for k in restored.frozen:
+        np.testing.assert_array_equal(
+            np.asarray(restored.frozen[k]), np.asarray(resumed.state.frozen[k])
+        )
+    ckpt.close()
+
+
+def test_fingerprint_rejects_changed_base_weights(qa_parquet, tmp_path):  # noqa: F811
+    """Resuming a trainable-only checkpoint against DIFFERENT frozen params
+    (wrong base checkpoint / wrong init seed) must be a hard error, not
+    silent corruption."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+    from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path / "a", data_dir, dataset_file, epochs=1, save_steps=5,
+        use_native_loader=False, checkpoint_trainable_only=True,
+        checkpoint_async_snapshot=False,
+    )
+    _train(cfg)
+
+    other = SFTTrainer(
+        make_config(
+            tmp_path / "b", data_dir, dataset_file, epochs=1,
+            use_native_loader=False, checkpoint_trainable_only=True,
+        ),
+        rng_seed=123,  # different init -> different frozen leaves
+    )
+    ckpt = CheckpointManager(str(tmp_path / "a" / "checkpoints"), trainable_only=True)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        other.state,
+    ).replace(frozen=other.state.frozen)
+    with pytest.raises(RuntimeError, match="does not match"):
+        ckpt.restore(ckpt.latest_step, abstract)
+    ckpt.close()
+
+    # the TRAINER resume path must surface the same diagnosis — not bury it
+    # under cross-mode/cross-layout fallbacks (r5 review finding)
+    from llm_fine_tune_distributed_tpu.train.checkpoints import FingerprintMismatch
+
+    bad_resume = SFTTrainer(
+        make_config(
+            tmp_path / "a", data_dir, dataset_file, epochs=2,
+            use_native_loader=False, checkpoint_trainable_only=True,
+            resume_from_checkpoint="latest",
+        ),
+        rng_seed=123,
+    )
+    with pytest.raises(FingerprintMismatch, match="does not match"):
+        bad_resume.train()
+
+
+def test_async_snapshot_save_matches_sync(qa_parquet, tmp_path):  # noqa: F811
+    """Background snapshot saves must produce the same resumable payload as
+    synchronous saves (the train loop keeps the state buffers via donation
+    while the snapshot drains — any aliasing bug shows up as corrupted
+    trainable leaves here)."""
+    from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+
+    data_dir, dataset_file = qa_parquet
+
+    trainers = {}
+    for name, async_snap in (("sync", False), ("async", True)):
+        cfg = make_config(
+            tmp_path / name, data_dir, dataset_file, epochs=1, save_steps=3,
+            use_native_loader=False, checkpoint_trainable_only=True,
+            checkpoint_async_snapshot=async_snap,
+        )
+        trainers[name] = _train(cfg)
+
+    for name in trainers:
+        ckpt = CheckpointManager(
+            str(tmp_path / name / "checkpoints"), trainable_only=True
+        )
+        tr = trainers[name]
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            tr.state,
+        ).replace(frozen=tr.state.frozen)
+        restored = ckpt.restore(ckpt.latest_step, abstract)
+        trainers[name] = (tr, restored)
+        ckpt.close()
+
+    (_, sync_restored), (_, async_restored) = trainers["sync"], trainers["async"]
+    for k in sync_restored.trainable:
+        np.testing.assert_array_equal(
+            np.asarray(sync_restored.trainable[k]),
+            np.asarray(async_restored.trainable[k]),
+            err_msg=k,
+        )
+    assert int(sync_restored.step) == int(async_restored.step)
+
+
+def test_cross_mode_resume_both_directions(qa_parquet, tmp_path):  # noqa: F811
+    """A full checkpoint resumes into a trainable-only run and vice versa —
+    flipping the config knob must never strand an existing run."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    for first, then in ((False, True), (True, False)):
+        out = tmp_path / f"mode_{int(first)}"
+        cfg = make_config(
+            out, data_dir, dataset_file, epochs=1, save_steps=5,
+            use_native_loader=False, checkpoint_trainable_only=first,
+            checkpoint_async_snapshot=False,
+        )
+        _train(cfg)
+        resume_cfg = make_config(
+            out, data_dir, dataset_file, epochs=2, save_steps=5,
+            use_native_loader=False, checkpoint_trainable_only=then,
+            checkpoint_async_snapshot=False,
+            resume_from_checkpoint="latest",
+        )
+        trainer = SFTTrainer(resume_cfg)
+        # drive the real resume path through train(): it must pick up the
+        # other-mode checkpoint and continue to epoch 2
+        summary = trainer.train()
+        assert summary["final_train_loss"] is not None
+        losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
+        assert losses, "resumed run logged no steps"
