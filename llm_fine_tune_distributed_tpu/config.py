@@ -405,7 +405,9 @@ class TrainConfig:
         "OUTPUT_DIR": ("output_dir", str),
         "AIM_REPO": ("aim_repo", str),
         "MODEL_NAME": ("model_name", str),
-        "MODEL_PRESET": ("model_preset", str),
+        # MODEL_PRESET=none: resolve the architecture from MODEL_NAME's
+        # config.json (the pre-staged local HF checkpoint contract)
+        "MODEL_PRESET": ("model_preset", lambda s: None if s.lower() == "none" else s),
         "TOKENIZER_PATH": ("tokenizer_path", str),
         "MAX_SEQ_LENGTH": ("max_seq_length", int),
         "GRAD_ACCUM_STEPS": ("gradient_accumulation_steps", int),

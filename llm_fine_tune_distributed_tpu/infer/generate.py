@@ -12,9 +12,7 @@ overwritten, hence always masked).
 
 from __future__ import annotations
 
-import json
 import os
-from types import SimpleNamespace
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -732,15 +730,10 @@ def load_model_dir(path: str, dtype=None) -> Tuple[dict, ModelConfig]:
     (bf16 for trainer-emitted ``best_model/`` — upcasting a 3B model to f32
     would not fit a 16GB chip beside its KV cache).
     """
-    from llm_fine_tune_distributed_tpu.models.configs import from_hf_config
+    from llm_fine_tune_distributed_tpu.models.configs import load_model_config
     from llm_fine_tune_distributed_tpu.models.hf_io import load_hf_checkpoint
 
-    cfg_path = os.path.join(path, "config.json")
-    if not os.path.exists(cfg_path):
-        raise FileNotFoundError(f"no config.json under {path}")
-    with open(cfg_path) as f:
-        raw = json.load(f)
-    model_config = from_hf_config(SimpleNamespace(**raw))
+    model_config = load_model_config(path)
     params = load_hf_checkpoint(path, model_config, dtype=dtype)
     return params, model_config
 

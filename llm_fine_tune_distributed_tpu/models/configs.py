@@ -347,6 +347,23 @@ def to_hf_dict(mc: ModelConfig) -> dict:
     }
 
 
+def load_model_config(path: str) -> ModelConfig:
+    """Read ``path/config.json`` (HF layout) into a ModelConfig — the ONE
+    place train-time (trainer._resolve_model_config) and inference-time
+    (infer.load_model_dir) architecture resolution share, so the two can
+    never diverge."""
+    import json
+    import os
+    from types import SimpleNamespace
+
+    cfg_path = os.path.join(path, "config.json")
+    if not os.path.isfile(cfg_path):
+        raise FileNotFoundError(f"no config.json under {path}")
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    return from_hf_config(SimpleNamespace(**raw))
+
+
 def _parse_hidden_act(act) -> str:
     """Map HF activation names to the two implemented gate activations —
     reject anything else at load time (same contract as the rope_scaling
