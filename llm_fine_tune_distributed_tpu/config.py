@@ -318,6 +318,14 @@ class TrainConfig:
     metric_for_best_model: str = "eval_loss"
     greater_is_better: bool = False
     load_best_model_at_end: bool = True
+    # how load_best_model_at_end tracks the best weights:
+    # - "per_eval": on-device snapshot at every eval improvement (finest
+    #   granularity; costs one trainable-set copy of HBM)
+    # - "checkpoint": restore the best SAVED checkpoint at end of run (HF's
+    #   actual save-aligned semantics; zero steady-state cost — the right
+    #   mode when HBM is tight, e.g. the 3B flagship on one 16 GB chip)
+    # - "auto": per_eval while the trainable set is <512 MB, else checkpoint
+    best_model_tracking: str = "auto"
 
     # data split
     validation_fraction: float = 0.1

@@ -169,6 +169,17 @@ class CheckpointManager:
             )
         if not snapshot_async or jax.process_count() > 1:
             payload = self._payload(state, fingerprint)
+            if jax.process_count() == 1:
+                # fetch through concurrent streams BEFORE handing to Orbax:
+                # its own transfer_arrays_to_host is one serial stream
+                # (~16 MB/s on the tunnel vs ~42 MB/s aggregate —
+                # utils/transfer.py; measured 162 s vs ~60 s per flagship
+                # save). Multi-process saves stay sharded device saves.
+                from llm_fine_tune_distributed_tpu.utils.transfer import (
+                    parallel_device_get_tree,
+                )
+
+                payload = parallel_device_get_tree(payload)
             self._mgr.save(
                 step,
                 args=ocp.args.Composite(state=ocp.args.StandardSave(payload)),
@@ -202,10 +213,18 @@ class CheckpointManager:
         def _bg_save():
             try:
                 # block on the snapshot (the copy happens on-stream while
-                # training continues), fetch to host, then FREE the device
-                # copy before the potentially slow Orbax write
-                host = jax.tree.map(lambda x: np.asarray(x), snap_box[0])
-                snap_box[0] = None
+                # training continues), fetch to host through concurrent
+                # streams (utils/transfer.py — ~2.6x on tunneled links),
+                # then FREE the device copy before the Orbax write (the
+                # tree helper keeps no leaf references, so clearing
+                # snap_box releases the HBM)
+                from llm_fine_tune_distributed_tpu.utils.transfer import (
+                    parallel_device_get_tree,
+                )
+
+                snap, snap_box[0] = snap_box[0], None
+                host = parallel_device_get_tree(snap)
+                del snap
                 if self.trainable_only:
                     host["frozen_fp"] = fingerprint
                 else:

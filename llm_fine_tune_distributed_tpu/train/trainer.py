@@ -778,6 +778,39 @@ class SFTTrainer:
             snapshot_async=self.config.checkpoint_async_snapshot,
         )
 
+    def _resolve_best_mode(self) -> str:
+        cfg = self.config
+        mode = cfg.best_model_tracking
+        if mode == "auto":
+            trainable_bytes = sum(v.nbytes for v in self.state.trainable.values())
+            mode = "per_eval" if trainable_bytes < 512 * 1024**2 else "checkpoint"
+        elif mode not in ("per_eval", "checkpoint"):
+            raise ValueError(f"unknown best_model_tracking {mode!r}")
+        if (
+            mode == "checkpoint"
+            and cfg.load_best_model_at_end
+            and cfg.eval_steps
+            and cfg.save_steps
+            and cfg.save_steps % cfg.eval_steps != 0
+            # only MID-RUN saves can carry a stale metric: the end-of-train
+            # save runs right after the final eval (reference save_steps=500
+            # with ~48 total steps was exactly this shape)
+            and cfg.save_steps <= self.total_steps
+        ):
+            # checkpoint-mode best selection stamps each save with the LAST
+            # eval's metric; an unaligned cadence would credit step-N weights
+            # with an older eval and restore the wrong weights (HF requires
+            # the same alignment for load_best_model_at_end). Fail at start,
+            # not after the run.
+            raise ValueError(
+                f"best_model_tracking='checkpoint' needs save_steps "
+                f"({cfg.save_steps}) to be a multiple of eval_steps "
+                f"({cfg.eval_steps}) so every saved checkpoint carries a "
+                "fresh metric — align the cadences or use "
+                "best_model_tracking='per_eval'"
+            )
+        return mode
+
     def train(self) -> Dict[str, Any]:
         cfg = self.config
         ckpt_dir = os.path.join(cfg.output_dir, "checkpoints")
@@ -800,6 +833,7 @@ class SFTTrainer:
 
         best_eval = float("inf") if not cfg.greater_is_better else -float("inf")
         best_trainable = None
+        best_mode = self._resolve_best_mode()
         last_eval: Optional[float] = None
         meter = ThroughputMeter(
             n_chips=self.mesh.size, tokens_per_sample=self._tokens_per_sample()
@@ -897,19 +931,19 @@ class SFTTrainer:
                         )
                         if improved:
                             best_eval = last_eval
-                            if cfg.load_best_model_at_end:
-                                # single-process: snapshot to host RAM (free
-                                # HBM). Multi-process: param shards are not
-                                # host-fetchable — keep an on-device copy
-                                # with the same shardings instead.
-                                if jax.process_count() == 1:
-                                    best_trainable = jax.tree.map(
-                                        lambda x: np.asarray(x), self.state.trainable
-                                    )
-                                else:
-                                    best_trainable = jax.tree.map(
-                                        jnp.copy, self.state.trainable
-                                    )
+                            if cfg.load_best_model_at_end and best_mode == "per_eval":
+                                # ON-DEVICE snapshot (device-side copy, no
+                                # host sync — a host fetch here cost 50+s of
+                                # tunnel transfer at EVERY eval improvement,
+                                # the hidden bulk of the r4 "eval pauses").
+                                # HBM cost is one trainable copy; big
+                                # trainable sets run best_mode="checkpoint"
+                                # instead (see _resolve_best_mode), which the
+                                # flagship needs: the extra 0.84 GB copy
+                                # OOM'd a 16 GB chip mid-run.
+                                best_trainable = jax.tree.map(
+                                    jnp.copy, self.state.trainable
+                                )
 
                     if do_log or do_eval:
                         final_loss = float(metrics["loss"])
@@ -942,8 +976,19 @@ class SFTTrainer:
             if detector is not None:
                 detector.stop()
 
-        # end of training: final checkpoint + optional best-model restore
-        if last_eval is None and self.n_val > 0:
+        # end of training: final checkpoint + optional best-model restore.
+        # Refresh the metric when the final step is not an eval boundary:
+        # checkpoint-mode best selection stamps the final save with
+        # last_eval, and a stale value would credit the final weights with
+        # an OLDER eval (r5 review finding) — the same staleness the
+        # mid-run cadence guard rules out.
+        final_eval_stale = (
+            cfg.load_best_model_at_end
+            and best_mode == "checkpoint"
+            and cfg.eval_steps
+            and step % cfg.eval_steps != 0
+        )
+        if (last_eval is None or final_eval_stale) and self.n_val > 0:
             last_eval = self.evaluate()
             if cfg.load_best_model_at_end and (
                 last_eval < best_eval if not cfg.greater_is_better else last_eval > best_eval
@@ -962,6 +1007,28 @@ class SFTTrainer:
                     for k, v in best_trainable.items()
                 }
             )
+        elif cfg.load_best_model_at_end and best_mode == "checkpoint":
+            # best among SAVED checkpoints (HF's save-aligned semantics): a
+            # disk restore only when the final step is not already the best,
+            # so the common descending-loss run pays nothing
+            bstep = ckpt.best_step
+            if bstep is not None and bstep != step:
+                abstract = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype, sharding=x.sharding
+                    ),
+                    self.state,
+                )
+                if ckpt.trainable_only:
+                    abstract = abstract.replace(frozen=self.state.frozen)
+                best_state = ckpt.restore(bstep, abstract)
+                self.state = self.state.replace(trainable=best_state.trainable)
+                if is_primary_host():
+                    print(
+                        f"Restored best checkpoint step {bstep} "
+                        f"({cfg.metric_for_best_model} tracking, "
+                        "best_model_tracking=checkpoint)"
+                    )
 
         if pending_samples:
             # steps since the last log boundary: the trailing steps may still
@@ -1087,18 +1154,30 @@ class SFTTrainer:
         collective — so when process_count > 1 EVERY host must call this,
         see _save_artifacts).
         """
+        from llm_fine_tune_distributed_tpu.utils.transfer import parallel_device_get
+
         if jax.process_count() == 1:
-            return {k: np.asarray(v) for k, v in flat.items()}
+            # concurrent streams: tunneled links multiplex ~2.6x over one
+            # serial fetch (utils/transfer.py) — this is the artifact-export
+            # leg that dominated the r4 end-of-run wall-clock
+            return parallel_device_get(flat)
         replicated = NamedSharding(self.mesh, P())
         out = {}
         primary = is_primary_host()
+        staged = {}
         for k, v in flat.items():
             if not v.sharding.is_fully_replicated:
                 v = jax.device_put(v, replicated)
             if primary:
-                # only the writing host pays the device->host transfer and
-                # host RAM; the others just participated in the collective
-                out[k] = np.asarray(v)
+                staged[k] = v
+        if primary:
+            # only the writing host pays the device->host transfer and host
+            # RAM; the others just participated in the collective. NO leaf
+            # splitting here: slicing a replicated-but-not-fully-addressable
+            # global array is a cross-mesh computation one process cannot
+            # issue alone — np.asarray on fully-replicated arrays is the one
+            # fetch JAX allows, so parallelism stays at leaf granularity.
+            out = parallel_device_get(staged, split_bytes=1 << 62)
         return out
 
     def _save_artifacts(

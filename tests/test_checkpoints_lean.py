@@ -210,3 +210,73 @@ def test_cross_mode_resume_both_directions(qa_parquet, tmp_path):  # noqa: F811
         assert summary["final_train_loss"] is not None
         losses = [h["loss"] for h in trainer.metrics.history if "loss" in h]
         assert losses, "resumed run logged no steps"
+
+
+def test_parallel_device_get_matches_serial():
+    """Concurrent leaf fetch (utils/transfer.py) is a pure transport
+    optimization: values identical to np.asarray, including the big-leaf
+    row-split reassembly path."""
+    import jax.numpy as jnp
+
+    from llm_fine_tune_distributed_tpu.utils.transfer import parallel_device_get
+
+    rng = np.random.RandomState(0)
+    tree = {
+        "small": jnp.asarray(rng.rand(7, 5).astype(np.float32)),
+        "scalar": jnp.asarray(np.float32(3.5)),
+        "big": jnp.asarray(rng.rand(64, 333).astype(np.float32)),
+        "ints": jnp.asarray(rng.randint(0, 100, (11,), dtype=np.int32)),
+    }
+    # force the split path for "big" with a tiny split threshold
+    got = parallel_device_get(tree, workers=3, split_bytes=8 * 333 * 4)
+    for k, v in tree.items():
+        np.testing.assert_array_equal(got[k], np.asarray(v), err_msg=k)
+        assert got[k].dtype == np.asarray(v).dtype
+
+
+def test_checkpoint_mode_best_restore_on_divergence(qa_parquet, tmp_path, capsys):  # noqa: F811
+    """best_model_tracking="checkpoint": when the run DIVERGES after a good
+    early checkpoint, the trainer restores the best saved step at end of run
+    (the save-aligned HF load_best_model_at_end semantics) — no per-eval HBM
+    snapshot involved."""
+    from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path / "div", data_dir, dataset_file, epochs=1,
+        learning_rate=2.0,           # Adam at lr 2.0 diverges immediately
+        eval_steps=3, save_steps=3,  # aligned so saves carry the metric
+        use_native_loader=False, checkpoint_trainable_only=True,
+        checkpoint_async_snapshot=False,
+        best_model_tracking="checkpoint",
+    )
+    trainer = SFTTrainer(cfg)
+    trainer.train()
+    out = capsys.readouterr().out
+    mgr = CheckpointManager(str(tmp_path / "div" / "checkpoints"), trainable_only=True)
+    best, latest = mgr.best_step, mgr.latest_step
+    mgr.close()
+    assert best is not None
+    if best != latest:
+        # divergence happened as engineered: the restore branch must have run
+        assert "Restored best checkpoint step" in out
+    evals = [h["eval_loss"] for h in trainer.metrics.history if "eval_loss" in h]
+    assert evals[-1] > evals[0] or best == latest  # sanity: it did diverge
+
+
+def test_checkpoint_mode_rejects_unaligned_save_eval_cadence(qa_parquet, tmp_path):  # noqa: F811
+    """checkpoint-mode best selection stamps saves with the LAST eval's
+    metric; unaligned cadences would restore weights credited with a stale
+    metric — rejected at train() start (r5 review finding)."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path / "bad", data_dir, dataset_file, epochs=1,
+        eval_steps=4, save_steps=6,  # 6 % 4 != 0
+        use_native_loader=False, best_model_tracking="checkpoint",
+    )
+    trainer = SFTTrainer(cfg)
+    with pytest.raises(ValueError, match="multiple of eval_steps"):
+        trainer.train()
