@@ -351,6 +351,12 @@ class TrainConfig:
     # systematic form of the reference runbook's gradient-desync diagnosis
     # (docs/single-vs-distributed-comparison.md:571-580)
     desync_check_steps: int = 0
+    # step watchdog (runtime/watchdog.py): seconds of training-loop silence
+    # before reporting a wedged device link (0 = off). The single-process
+    # analog of the multi-host heartbeat — a dead tunneled link otherwise
+    # hangs the run forever with a healthy-looking process.
+    watchdog_timeout_s: float = 0.0
+    watchdog_action: str = "warn"  # or "abort": os._exit for restart+resume
 
     # checkpoint payload / overlap (VERDICT r4 #1)
     # trainable-only: persist (step, trainable masters, optimizer state) +
@@ -430,6 +436,8 @@ class TrainConfig:
         "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
         "CHECKPOINT_TRAINABLE_ONLY": ("checkpoint_trainable_only", "_env_bool"),
         "CHECKPOINT_ASYNC_SNAPSHOT": ("checkpoint_async_snapshot", "_env_bool"),
+        "WATCHDOG_TIMEOUT_S": ("watchdog_timeout_s", float),
+        "WATCHDOG_ACTION": ("watchdog_action", str),
         "OBJECTIVE": ("objective", str),
         "DPO_BETA": ("dpo_beta", float),
         "LOGGING_STEPS": ("logging_steps", int),

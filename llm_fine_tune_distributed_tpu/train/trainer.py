@@ -869,6 +869,18 @@ class SFTTrainer:
 
         desync = DesyncMonitor(cfg.desync_check_steps)
         profiler = StepProfiler(cfg.profile_dir)
+        # wedged-link detector (runtime/watchdog.py): a dead device link
+        # under a single-process run otherwise hangs forever with a
+        # healthy-looking process (observed on the tunneled flagship)
+        watchdog = None
+        if cfg.watchdog_timeout_s > 0:
+            from llm_fine_tune_distributed_tpu.runtime.watchdog import StepWatchdog
+
+            # start_paused: the first arm happens at the first step's poke,
+            # so resume fast-forward + first-step compile can't false-trip
+            watchdog = StepWatchdog(
+                cfg.watchdog_timeout_s, cfg.watchdog_action, start_paused=True
+            )
 
         t_start = time.perf_counter()
         step = int(self.state.step)
@@ -889,6 +901,8 @@ class SFTTrainer:
                     self.state, metrics = self.train_step(self.state, dev_batch)
                     step += 1
                     pending_samples += samples_per_step
+                    if watchdog is not None:
+                        watchdog.poke(step)
 
                     do_log = (
                         (cfg.logging_first_step and step == 1)
@@ -925,6 +939,10 @@ class SFTTrainer:
                         )
 
                     if do_eval:
+                        if watchdog is not None:
+                            # an eval sweep has no loop pokes; a legitimately
+                            # slow one must not abort a healthy run
+                            watchdog.pause()
                         last_eval = self.evaluate()
                         improved = (
                             last_eval > best_eval if cfg.greater_is_better else last_eval < best_eval
@@ -965,6 +983,11 @@ class SFTTrainer:
                         self.metrics.log(step, step / self.steps_per_epoch, logs)
 
                     if do_save:
+                        if watchdog is not None:
+                            # sync saves legitimately take minutes on slow
+                            # links — IO progress, not a wedge; the NEXT
+                            # step's poke re-arms
+                            watchdog.pause()
                         self._ckpt_save(ckpt, step, {cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
                     if do_eval or do_save:
                         # eval sweeps / checkpoint saves must not count
@@ -975,6 +998,12 @@ class SFTTrainer:
             profiler.close()
             if detector is not None:
                 detector.stop()
+            if watchdog is not None:
+                # end-of-run legs (final save, export) are long host-side IO
+                # with no loop pokes — stop outright (also frees the thread;
+                # repeated train() calls in one process must not accumulate
+                # pollers)
+                watchdog.stop()
 
         # end of training: final checkpoint + optional best-model restore.
         # Refresh the metric when the final step is not an eval boundary:
