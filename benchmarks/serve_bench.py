@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Serving throughput: continuous-batching engine vs window batcher under
+concurrent mixed traffic.
+
+The window batcher (infer/batching.py) only co-batches identical-config
+greedy requests and runs each padded group to completion, so mixed traffic
+(different max_new_tokens, greedy + sampled) degrades toward serial decode
+and every request waits for its group's longest row. The continuous engine
+(infer/engine.py) keeps S decode slots full at every step and admits any
+config mid-flight. Decode is weight-bandwidth-bound, so slots-full-per-step
+is the serving-throughput lever this benchmark quantifies.
+
+Each client submits a stream of requests drawn from a mixed pool of prompt
+lengths, token budgets, and greedy/sampled configs; the sweep runs 1, 8 and
+32 clients against BOTH engines on the same model and prints one JSON line
+per (engine, clients) config, perf_ledger-style ("metric" key).
+
+Usage: python benchmarks/serve_bench.py   (CPU ok: defaults to the tiny
+preset off-accelerator). Env: SERVE_PRESET, SERVE_CLIENTS=1,8,32,
+SERVE_REQS_PER_CLIENT (default 4), SERVE_SLOTS (default 8),
+SERVE_ENGINES=continuous,window.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _workload(rng, vocab, n):
+    """Mixed pool: short/long prompts, short/long budgets, greedy + sampled.
+    Returns [(prompt_ids, GenerationConfig, seed)]."""
+    from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+
+    out = []
+    for i in range(n):
+        plen = int(rng.choice([8, 24, 48, 96]))
+        max_new = int(rng.choice([8, 16, 32]))
+        sampled = bool(rng.rand() < 0.5)
+        gen = GenerationConfig(
+            max_new_tokens=max_new,
+            do_sample=sampled,
+            temperature=1.0 if sampled else 0.0,
+        )
+        prompt = rng.randint(0, min(vocab, 256), (plen,)).tolist()
+        out.append((prompt, gen, i))
+    return out
+
+
+def _run_config(engine, clients, reqs_per_client, workload):
+    """clients threads x reqs_per_client sequential submits each."""
+    served = [0] * clients
+    errors = []
+
+    def client(ci):
+        for ri in range(reqs_per_client):
+            prompt, gen, seed = workload[(ci * reqs_per_client + ri) % len(workload)]
+            try:
+                toks = engine.submit(prompt, gen, seed=seed, timeout=600)
+                served[ci] += len(toks)
+            except Exception as e:  # pragma: no cover - surfaced in the JSON
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    return sum(served), dt, errors
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+    from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine
+    from llm_fine_tune_distributed_tpu.infer.engine import ContinuousBatchingEngine
+    from llm_fine_tune_distributed_tpu.infer.generate import Generator
+    from llm_fine_tune_distributed_tpu.models.configs import get_preset
+    from llm_fine_tune_distributed_tpu.models.transformer import init_params
+
+    on_accelerator = jax.devices()[0].platform != "cpu"
+    preset = os.environ.get(
+        "SERVE_PRESET", "smollm3_3b" if on_accelerator else "tiny"
+    )
+    client_counts = [
+        int(c) for c in os.environ.get("SERVE_CLIENTS", "1,8,32").split(",")
+    ]
+    reqs_per_client = int(os.environ.get("SERVE_REQS_PER_CLIENT", "4"))
+    slots = int(os.environ.get("SERVE_SLOTS", "8"))
+    engines = os.environ.get("SERVE_ENGINES", "continuous,window").split(",")
+
+    mc = get_preset(preset)
+    dtype = jnp.bfloat16 if on_accelerator else jnp.float32
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=dtype)
+    generator = Generator(
+        params, mc, ByteChatMLTokenizer(), compute_dtype=dtype, eos_token_ids=[]
+    )
+
+    rng = np.random.RandomState(0)
+    workload = _workload(rng, mc.vocab_size, 64)
+
+    results = {}
+    for kind in engines:
+        if kind == "continuous":
+            engine = ContinuousBatchingEngine(
+                generator, slots=slots, buf_len=256, prompt_bucket=32
+            )
+        else:
+            engine = BatchingEngine(generator, max_batch=slots)
+        # warm the jit caches so the sweep times decode, not compilation
+        _run_config(engine, 1, 2, workload)
+        for clients in client_counts:
+            total, dt, errors = _run_config(
+                engine, clients, reqs_per_client, workload
+            )
+            tps = total / dt if dt > 0 else 0.0
+            results[(kind, clients)] = tps
+            print(json.dumps({
+                "metric": f"serve_tokens_per_sec_{kind}_c{clients}",
+                "value": round(tps, 2),
+                "unit": "tokens/sec",
+                "engine": kind,
+                "clients": clients,
+                "requests": clients * reqs_per_client,
+                "tokens_served": total,
+                "wall_seconds": round(dt, 2),
+                "model": preset,
+                "platform": jax.devices()[0].platform,
+                "slots": slots,
+                "errors": errors,
+            }), flush=True)
+
+    for clients in client_counts:
+        cont = results.get(("continuous", clients))
+        win = results.get(("window", clients))
+        if cont and win:
+            print(json.dumps({
+                "metric": f"serve_continuous_speedup_c{clients}",
+                "value": round(cont / win, 2),
+                "unit": "x over window engine",
+                "clients": clients,
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
 """Inference: jitted KV-cache generation + model-directory loading
 (the TPU replacement for the reference's ``ask_*_model.py`` internals)."""
 
+from llm_fine_tune_distributed_tpu.infer.engine import ContinuousBatchingEngine
 from llm_fine_tune_distributed_tpu.infer.generate import (
     Generator,
     load_model_dir,
@@ -8,4 +9,10 @@ from llm_fine_tune_distributed_tpu.infer.generate import (
 )
 from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
 
-__all__ = ["Generator", "GenerationConfig", "load_model_dir", "load_tokenizer_dir"]
+__all__ = [
+    "ContinuousBatchingEngine",
+    "Generator",
+    "GenerationConfig",
+    "load_model_dir",
+    "load_tokenizer_dir",
+]

@@ -39,7 +39,12 @@ from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
 
 
 @dataclass
-class _Pending:
+class Request:
+    """One in-flight generate request — the record shared by BOTH serving
+    engines (this window batcher and the continuous-batching engine,
+    infer/engine.py), so submit/timeout/abandonment semantics cannot
+    drift between them."""
+
     prompt: List[int]
     gen: GenerationConfig
     seed: int
@@ -56,6 +61,14 @@ class _Pending:
     # so a later batch cannot overwrite it
     spec_acceptance: Optional[float] = None
     spec_steps: Optional[int] = None
+    # continuous engine only: when set, every decoded token is ALSO pushed
+    # here as it is emitted (None terminates the stream) — per-request SSE
+    # streaming while the request rides a shared decode batch
+    tokens_q: Optional["queue.Queue"] = None
+
+
+# historical name, kept for callers/tests that referenced the private type
+_Pending = Request
 
 
 def _pad_batch_size(n: int, max_batch: int) -> int:

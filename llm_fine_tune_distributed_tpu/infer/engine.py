@@ -1,0 +1,289 @@
+"""Continuous-batching serving engine: slot-based persistent decode loop
+with in-flight admission.
+
+The window batcher (infer/batching.py) drains a 10 ms window, pads the
+group, and runs the WHOLE batch to completion — so every request waits for
+its group's longest decode, requests arriving mid-batch wait for the batch
+to drain, and only identical-config greedy traffic co-batches at all.
+Decode is weight-bandwidth-bound (~6 GB/token for the 3B flagship,
+ops/int8.py): the decisive serving-throughput lever is keeping the decode
+batch full at EVERY step, not just at launch. This engine does that:
+
+- a persistent decode state of S slots: ONE shared KV buffer
+  ``[S, buf_len]`` plus per-slot position, repetition set, RNG key, and
+  traced sampling knobs (Generator.init_slot_state);
+- a scheduler loop that (a) runs one jitted decode step for all live slots,
+  (b) emits each slot's new token to its request — and to its per-request
+  stream queue, enabling SSE streaming under concurrency, (c) frees slots
+  whose row hit EOS or its token budget, and (d) refills free slots via a
+  jitted prefill-insert that writes a new prompt's KV into the freed row
+  without touching live rows (models/transformer.insert_cache_row);
+- admission is strict FIFO over ONE queue: a slot frees, the oldest waiter
+  takes it — no compatibility classes, no deferred lists. Sampled and
+  greedy traffic co-batch because every slot samples with its own traced
+  knobs and its own RNG chain keyed by the REQUEST seed (not the row
+  index), so a sampled response is deterministic in (request, seed) no
+  matter which slot it lands in or who its neighbors are;
+- greedy slots reproduce solo ``generate_ids`` bit-for-bit (the traced
+  sampler's greedy path is the static sampler's arithmetic, and every
+  per-row op in the forward is row-independent — tests/test_engine.py).
+
+Abandonment carries over from the window engine: a timed-out ``submit``
+marks its request abandoned; abandoned requests are dropped at admission
+(never decoded) and shed mid-flight (their slot frees at the next step).
+
+Throughput shape: per emitted token the engine pays one host sync of
+``[S]`` ints plus one dispatch — per-step overhead the window engine's
+fused ``while_loop`` avoids — but under concurrency it serves up to S
+tokens per weight read with no head-of-line blocking and no config
+serialization, which dominates (benchmarks/serve_bench.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.infer.batching import Request
+from llm_fine_tune_distributed_tpu.infer.sampling import (
+    GenerationConfig,
+    generation_config_arrays,
+)
+from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+
+
+class ContinuousBatchingEngine:
+    """S-slot persistent decode loop with in-flight FIFO admission."""
+
+    def __init__(
+        self,
+        generator,
+        slots: int = 8,
+        buf_len: int = 4096,
+        prompt_bucket: int = 64,
+        stats: Optional[ServingStats] = None,
+    ):
+        if getattr(generator, "_multihost", False):
+            raise ValueError(
+                "the continuous engine is single-host only (per-step host "
+                "scheduling would need a broadcast per token); use the "
+                "window BatchingEngine behind a MultihostCoordinator"
+            )
+        self._generator = generator
+        self._slots = max(1, int(slots))
+        self._buf_len = int(buf_len)
+        self._bucket = max(1, int(prompt_bucket))
+        self.stats = stats or ServingStats(self._slots)
+        self._q: "queue.Queue[Request]" = queue.Queue()
+        # worker-thread-only state (no lock needed)
+        self._slot_req: List[Optional[Request]] = [None] * self._slots
+        self._slot_tokens: List[List[int]] = [[] for _ in range(self._slots)]
+        self._slot_budget: List[int] = [0] * self._slots
+        self._live = np.zeros((self._slots,), bool)
+        self._cache = None
+        self._state = None
+        self._eos = set(getattr(generator, "eos_token_ids", ()) or ())
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ---------------------------------------------------------------- public
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ) -> List[int]:
+        """Blocking: enqueue one request, wait for its full token list."""
+        return self.submit_full(prompt_ids, gen, seed, timeout).result
+
+    def submit_full(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ) -> Request:
+        """``submit`` returning the whole request record (window-engine
+        parity, so the server can swap engines behind one call shape)."""
+        req = Request(list(prompt_ids), gen, seed)
+        self._q.put(req)
+        if not req.done.wait(timeout):
+            req.abandoned = True  # the worker sheds it un-decoded
+            raise TimeoutError(
+                f"generate request not served within {timeout}s "
+                f"(queue depth {self._q.qsize()})"
+            )
+        if req.error is not None:
+            raise req.error
+        return req
+
+    def stream(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ) -> Iterator[int]:
+        """Yield the request's tokens one at a time AS THEY DECODE, while the
+        request shares the slot batch with everything else in flight — the
+        streaming-under-batching the window engine cannot offer (it only
+        resolves whole batches). ``timeout`` bounds the wait for EACH next
+        token; on expiry the request is abandoned and sheds its slot."""
+        req = Request(list(prompt_ids), gen, seed, tokens_q=queue.Queue())
+        self._q.put(req)
+        while True:
+            try:
+                tok = req.tokens_q.get(timeout=timeout)
+            except queue.Empty:
+                req.abandoned = True
+                raise TimeoutError(
+                    f"stream starved for {timeout}s "
+                    f"(queue depth {self._q.qsize()})"
+                ) from None
+            if tok is None:
+                if req.error is not None:
+                    raise req.error
+                return
+            yield tok
+
+    def stats_snapshot(self) -> dict:
+        """Current counters + freshly-read gauges (``GET /v1/stats``)."""
+        self.stats.gauge("queue_depth", self._q.qsize())
+        self.stats.gauge("live_slots", int(self._live.sum()))
+        return self.stats.snapshot()
+
+    # ---------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        gen = self._generator
+        self._cache, self._state = gen.init_slot_state(self._slots, self._buf_len)
+        step = gen.slot_step(self._slots, self._buf_len)
+        while True:
+            self._admit()
+            if not self._live.any():
+                # idle: block until traffic instead of spinning
+                self._handle_new(self._q.get())
+                continue
+            self._decode_once(step)
+
+    def _admit(self) -> None:
+        """Refill free slots from the queue head — strict FIFO, any config."""
+        while self._live.sum() < self._slots:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            self._handle_new(req)
+
+    def _handle_new(self, req: Request) -> None:
+        if req.abandoned:
+            # timed-out while queued: dropped WITHOUT decoding (the waiter is
+            # gone; prefilling for nobody would starve live traffic)
+            self.stats.incr("requests_abandoned")
+            req.done.set()
+            return
+        try:
+            self._insert(req)
+        except BaseException as e:
+            req.error = e
+            if req.tokens_q is not None:
+                req.tokens_q.put(None)
+            req.done.set()
+
+    def _insert(self, req: Request) -> None:
+        gen = self._generator
+        slot = int(np.flatnonzero(~self._live)[0])
+        plen = len(req.prompt)
+        if plen == 0:
+            raise ValueError("continuous engine needs a non-empty prompt")
+        if plen >= self._buf_len:
+            raise ValueError(
+                f"prompt of {plen} tokens does not fit the engine's "
+                f"{self._buf_len}-slot KV buffer (need >= 1 decode slot)"
+            )
+        bucket = min(-(-plen // self._bucket) * self._bucket, self._buf_len)
+        prefill = gen.slot_prefill(bucket, self._buf_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = req.prompt
+        raw = generation_config_arrays(req.gen, gen.config.vocab_size)
+        knobs = {
+            "temperature": np.float32(raw["temperature"]),
+            "top_p": np.float32(raw["top_p"]),
+            "top_k": np.int32(raw["top_k"]),
+            "repetition_penalty": np.float32(raw["repetition_penalty"]),
+            "do_sample": np.bool_(raw["do_sample"]),
+        }
+        import jax
+
+        self._cache, self._state, first = prefill(
+            gen.params, self._cache, self._state, padded, np.int32(plen),
+            np.int32(slot), knobs, jax.random.PRNGKey(req.seed),
+        )
+        self._slot_req[slot] = req
+        self._slot_tokens[slot] = []
+        # the budget honors max_new_tokens but never the buffer's end: the
+        # slot == position invariant holds only inside the buffer
+        self._slot_budget[slot] = min(req.gen.max_new_tokens, self._buf_len - plen)
+        self._live[slot] = True
+        self.stats.incr("requests_admitted")
+        self._emit_token(slot, req, int(first))
+
+    def _decode_once(self, step) -> None:
+        gen = self._generator
+        try:
+            self._cache, self._state, toks = step(
+                gen.params, self._cache, self._state, self._live.copy()
+            )
+            toks = np.asarray(toks)
+        except BaseException as e:  # device failure: resolve every waiter
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                req.error = e
+                if req.tokens_q is not None:
+                    req.tokens_q.put(None)
+                req.done.set()
+                self._release(slot)
+            return
+        self.stats.incr("decode_steps")
+        for slot in range(self._slots):
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            if req.abandoned:
+                # mid-flight timeout: shed the slot so live traffic refills it
+                self.stats.incr("requests_abandoned")
+                req.done.set()
+                self._release(slot)
+                continue
+            self._emit_token(slot, req, int(toks[slot]))
+
+    def _emit_token(self, slot: int, req: Request, tok: int) -> None:
+        if tok in self._eos:
+            self._finish(slot, req)
+            return
+        self._slot_tokens[slot].append(tok)
+        self.stats.incr("tokens_served")
+        if req.tokens_q is not None:
+            req.tokens_q.put(tok)
+        if len(self._slot_tokens[slot]) >= self._slot_budget[slot]:
+            self._finish(slot, req)
+
+    def _finish(self, slot: int, req: Request) -> None:
+        req.result = self._slot_tokens[slot]
+        if req.tokens_q is not None:
+            req.tokens_q.put(None)
+        req.done.set()
+        self.stats.incr("requests_completed")
+        self._release(slot)
+
+    def _release(self, slot: int) -> None:
+        self._slot_req[slot] = None
+        self._slot_tokens[slot] = []
+        self._slot_budget[slot] = 0
+        self._live[slot] = False

@@ -20,8 +20,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from llm_fine_tune_distributed_tpu.config import ModelConfig
-from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig, sample_token
-from llm_fine_tune_distributed_tpu.models.transformer import forward, init_cache, unembed
+from llm_fine_tune_distributed_tpu.infer.sampling import (
+    GenerationConfig,
+    sample_token,
+    sample_token_traced,
+)
+from llm_fine_tune_distributed_tpu.models.transformer import (
+    forward,
+    init_cache,
+    insert_cache_row,
+    unembed,
+)
 
 _PROMPT_BUCKET = 256
 
@@ -518,6 +527,156 @@ class Generator:
             return toks, cache, last, seen, rng
 
         return prefill, decode_chunk
+
+    # --------------------------------------------------- continuous batching
+
+    # Per-slot decode state consumed by infer/engine.py. The KV cache is ONE
+    # shared [slots, buf_len] buffer; each slot additionally carries:
+    #   last [S] i32     last emitted token (next step's input)
+    #   pos  [S] i32     logical position of `last` == its cache slot
+    #   seen [S, V] bool repetition-penalty set
+    #   rng  [S, 2] u32  per-slot PRNG key chain, seeded from the REQUEST's
+    #                    seed at insert — sampling is deterministic in
+    #                    (request, seed) regardless of slot index/co-residents
+    #   + one [S] array per traced sampling knob (sample_token_traced), so
+    #     mixed-config traffic co-batches in one compiled step.
+    # Liveness stays HOST-side (the engine passes a [S] bool mask): freeing a
+    # slot costs no device op. Dead rows still run through the forward (the
+    # batch shape is static) but their pos/seen/rng are frozen and their
+    # writes land in their own row at a fixed slot — harmless, since a reused
+    # slot rewrites every cache position before any query can attend to it
+    # (slot == position invariant; see insert_cache_row).
+
+    def init_slot_state(self, slots: int, buf_len: int):
+        """Fresh (cache, state) for a ``slots``-wide persistent decode."""
+        mc = self.config
+        cache = init_cache(mc, slots, buf_len, dtype=self.compute_dtype)
+        state = {
+            "last": jnp.zeros((slots,), jnp.int32),
+            "pos": jnp.zeros((slots,), jnp.int32),
+            "seen": jnp.zeros((slots, mc.vocab_size), bool),
+            "rng": jnp.zeros((slots, 2), jnp.uint32),
+            "temperature": jnp.ones((slots,), jnp.float32),
+            "top_p": jnp.ones((slots,), jnp.float32),
+            "top_k": jnp.full((slots,), mc.vocab_size, jnp.int32),
+            "repetition_penalty": jnp.ones((slots,), jnp.float32),
+            "do_sample": jnp.zeros((slots,), bool),
+        }
+        return cache, state
+
+    def slot_step(self, slots: int, buf_len: int):
+        """Jitted one-token decode step for ALL slots (cached per shape)."""
+        key = ("slot_step", slots, buf_len)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_slot_step(slots, buf_len)
+        return self._jit_cache[key]
+
+    def slot_prefill(self, bucket: int, buf_len: int):
+        """Jitted prefill-insert (cached per prompt bucket)."""
+        key = ("slot_prefill", bucket, buf_len)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_slot_prefill(bucket, buf_len)
+        return self._jit_cache[key]
+
+    def _build_slot_step(self, slots: int, buf_len: int):
+        """One decode step over the whole slot array: feed every slot's last
+        token at its own cache position (vector cache_pos), sample every
+        slot's next token with its own traced knobs and its own RNG key.
+        Greedy slots follow exactly the static sampler's arithmetic, so a
+        greedy slot's token stream is bit-identical to a solo
+        ``generate_ids`` run of the same prompt (row-independent ops; pinned
+        by tests/test_engine.py)."""
+        mc = self.config
+        dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
+
+        @jax.jit
+        def step(params, cache, state, live):
+            last, pos = state["last"], state["pos"]
+            hidden, cache = forward(
+                params, last[:, None], mc, cache=cache, cache_pos=pos,
+                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+            )
+            logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype, mesh=mesh)
+            split = jax.vmap(jax.random.split)(state["rng"])  # [S, 2, 2]
+            tok = sample_token_traced(
+                split[:, 1], logits, state["seen"],
+                temperature=state["temperature"], top_p=state["top_p"],
+                top_k=state["top_k"],
+                repetition_penalty=state["repetition_penalty"],
+                do_sample=state["do_sample"],
+            )
+            tok = jnp.where(live, tok, last)
+            rows = jnp.arange(slots)
+            seen = jnp.where(
+                live[:, None], state["seen"].at[rows, tok].set(True), state["seen"]
+            )
+            new_state = dict(
+                state,
+                last=tok,
+                pos=jnp.where(live, jnp.minimum(pos + 1, buf_len - 1), pos),
+                seen=seen,
+                rng=jnp.where(live[:, None], split[:, 0], state["rng"]),
+            )
+            return cache, new_state, tok
+
+        return step
+
+    def _build_slot_prefill(self, bucket: int, buf_len: int):
+        """Prefill ONE prompt (padded to ``bucket``) in a private batch-1
+        cache, sample its first token, and scatter the K/V row + slot state
+        into the shared buffers at ``slot`` — live neighbors are untouched
+        (row-scoped dynamic_update_slice writes only). The first token is
+        computed exactly as ``_prompt_prefill`` computes it (pad keys sit at
+        positions above the last real query, hence masked — logits are
+        independent of the bucket size)."""
+        mc = self.config
+        dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
+
+        @jax.jit
+        def prefill(params, cache, state, prompt_ids, prompt_len, slot, knobs, seed_key):
+            small = init_cache(mc, 1, bucket, dtype=dtype)
+            hidden, small = forward(
+                params, prompt_ids, mc, cache=small, cache_pos=0,
+                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+            )
+            lens = prompt_len[None]  # [1]
+            last_h = jnp.take_along_axis(
+                hidden, (lens - 1)[:, None, None], axis=1
+            )[:, 0]
+            logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
+            valid = jnp.arange(bucket)[None, :] < lens[:, None]
+            safe_ids = jnp.where(valid, prompt_ids, prompt_ids[:, :1])
+            seen_row = jnp.zeros((1, mc.vocab_size), bool).at[0, safe_ids[0]].set(True)
+            key, sub = jax.random.split(seed_key)
+            first = sample_token_traced(
+                sub[None], logits0, seen_row,
+                temperature=knobs["temperature"][None],
+                top_p=knobs["top_p"][None],
+                top_k=knobs["top_k"][None],
+                repetition_penalty=knobs["repetition_penalty"][None],
+                do_sample=knobs["do_sample"][None],
+            )
+            seen_row = seen_row.at[0, first[0]].set(True)
+            cache = insert_cache_row(cache, small, slot)
+            state = dict(
+                state,
+                last=state["last"].at[slot].set(first[0]),
+                pos=state["pos"].at[slot].set(prompt_len),
+                seen=jax.lax.dynamic_update_slice(state["seen"], seen_row, (slot, 0)),
+                rng=jax.lax.dynamic_update_slice(state["rng"], key[None], (slot, 0)),
+                temperature=state["temperature"].at[slot].set(knobs["temperature"]),
+                top_p=state["top_p"].at[slot].set(knobs["top_p"]),
+                top_k=state["top_k"].at[slot].set(knobs["top_k"]),
+                repetition_penalty=state["repetition_penalty"].at[slot].set(
+                    knobs["repetition_penalty"]
+                ),
+                do_sample=state["do_sample"].at[slot].set(knobs["do_sample"]),
+            )
+            return cache, state, first[0]
+
+        return prefill
 
     def generate_stream(
         self,

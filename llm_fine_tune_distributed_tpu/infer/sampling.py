@@ -88,6 +88,66 @@ def sample_token(rng, logits, seen, config: GenerationConfig):
     return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
 
+def sample_token_traced(keys, logits, seen, *, temperature, top_p, top_k,
+                        repetition_penalty, do_sample):
+    """Per-row sampling with TRACED knobs — the continuous-batching decode
+    step (infer/engine.py), where every slot carries its own generation
+    config and compiling one program per config combination is off the
+    table.
+
+    Unlike ``sample_token`` (whole GenerationConfig static), each knob is a
+    ``[batch]`` array operand: slots with different temperatures/penalties
+    co-batch in ONE compiled step. The greedy path is bitwise the static
+    sampler's (same penalty arithmetic, same argmax — ``penalty == 1.0``
+    reduces to the identity exactly, since ``x/1.0`` and ``x*1.0`` are
+    exact), so a greedy slot's tokens match a solo ``generate_ids`` run.
+    Sampled rows draw from the SAME warp pipeline (penalty -> temperature ->
+    top-k -> top-p) evaluated over a full descending sort instead of
+    ``lax.top_k`` (k is per-row data here), with one categorical per row
+    keyed by that row's own key — deterministic in (request, seed) and
+    independent of slot index or co-residents, though not bit-identical to
+    the solo batch-RNG stream.
+
+    keys [batch, 2] uint32; logits/seen [batch, vocab]; knobs [batch]
+    (``top_k`` int32, vocab-sized = disabled; ``do_sample`` bool). Returns
+    token [batch] int32.
+    """
+    pen = repetition_penalty[:, None]
+    penalized = jnp.where(
+        seen, jnp.where(logits > 0, logits / pen, logits * pen), logits
+    )
+    greedy = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
+
+    scaled = penalized / jnp.maximum(temperature, 1e-6)[:, None]
+    order = jnp.argsort(-scaled, axis=-1)  # descending, stable (ties by index)
+    vals = jnp.take_along_axis(scaled, order, axis=-1)
+    vocab = logits.shape[-1]
+    rank = jnp.arange(vocab)[None, :]
+    vals = jnp.where(rank < top_k[:, None], vals, _NEG_INF)
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    keep = keep.at[:, 0].set(True)  # min_tokens_to_keep=1, as in _warp
+    vals = jnp.where(keep, vals, _NEG_INF)
+    choice = jax.vmap(jax.random.categorical)(keys, vals)  # [batch]
+    sampled = jnp.take_along_axis(order, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(do_sample, sampled, greedy).astype(jnp.int32)
+
+
+def generation_config_arrays(gen: GenerationConfig, vocab_size: int):
+    """One GenerationConfig -> the scalar knob values ``sample_token_traced``
+    consumes (dict of python scalars; the engine scatters them into its
+    per-slot arrays). ``top_k`` None/0 disables by covering the vocab."""
+    k = gen.top_k or vocab_size
+    return {
+        "temperature": float(gen.temperature),
+        "top_p": float(gen.top_p),
+        "top_k": int(min(k, vocab_size)),
+        "repetition_penalty": float(gen.repetition_penalty),
+        "do_sample": bool(gen.do_sample),
+    }
+
+
 def rejection_sample_step(rng, logits, seen, draft, config: GenerationConfig, *, bonus=False):
     """One speculative-verify position: accept ``draft`` with probability
     q(draft), else draw from the renormalized residual (q with the draft

@@ -6,15 +6,23 @@ The reference has NO serving server — inference is CLI-only, and
 closes that gap with a dependency-free stdlib server exposing:
 
   GET  /healthz                      -> 200 "ok" (readiness probe target)
+  GET  /v1/stats                     -> serving counters/gauges (JSON)
   POST /v1/generate {"question": .., -> {"answer": ..}
         optional: "max_new_tokens", "temperature", "top_p", "top_k",
                   "repetition_penalty", "greedy", "seed", "system_prompt"}
 
-Handlers run on threads; a single worker (infer/batching.BatchingEngine)
-owns the TPU and groups concurrent same-config requests into one device
-batch (batch-1 decode is weight-bandwidth-bound, so a batch of B serves ~B
-requests for one request's HBM traffic). ``--max-batch 1`` restores strict
-serialization.
+Handlers run on threads; a single worker owns the TPU. Two engines
+(``--engine``):
+
+- ``continuous`` (default, single-host): slot-based persistent decode loop
+  (infer/engine.py) — mixed greedy/sampled traffic co-batches, freed slots
+  refill mid-flight, and /v1/stream rides the shared batch. Speculative
+  requests still run through the window engine (speculation needs the
+  fused verify program).
+- ``window``: the drain-a-window batcher (infer/batching.py) — the
+  multi-host path, and the fallback when per-step host scheduling is
+  unwanted. ``--max-batch 1`` restores strict serialization.
+
 Run: ``python -m llm_fine_tune_distributed_tpu.infer.server --model-dir ...``
 or ``ask_tuned_model.py --serve``.
 """
@@ -39,6 +47,9 @@ def serve(
     request_timeout_s: Optional[float] = 600.0,
     tp: int = 1,
     draft_dir: Optional[str] = None,
+    engine_kind: str = "continuous",
+    slots: int = 8,
+    kv_buf_len: int = 4096,
 ) -> None:
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
@@ -94,8 +105,30 @@ def serve(
         coordinator = MultihostCoordinator(generator)
         engine_target = coordinator
         print(f"[serve] coordinating {jax.process_count()} hosts")
+    if engine_kind not in ("continuous", "window"):
+        raise ValueError(
+            f"unknown engine {engine_kind!r} (expected 'continuous' or 'window')"
+        )
+    # The window engine always exists: it is the multi-host path AND the
+    # carrier for speculative requests (speculation needs the fused
+    # draft+verify while_loop program, which has no slot-step form).
     engine = BatchingEngine(engine_target, max_batch=max_batch, window_ms=batch_window_ms)
-    print(f"Model ready (max_batch={max_batch}, quantize={quantize}).")
+    cont_engine = None
+    if engine_kind == "continuous":
+        if coordinator is not None:
+            print("[serve] multi-host: continuous engine unavailable, using window")
+        else:
+            from llm_fine_tune_distributed_tpu.infer.engine import (
+                ContinuousBatchingEngine,
+            )
+
+            cont_engine = ContinuousBatchingEngine(
+                generator, slots=slots, buf_len=kv_buf_len
+            )
+    print(
+        f"Model ready (engine={'continuous' if cont_engine else 'window'}, "
+        f"slots={slots}, max_batch={max_batch}, quantize={quantize})."
+    )
 
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 so /v1/stream may use chunked transfer encoding (every
@@ -124,6 +157,20 @@ def serve(
                     self._send(503, {"error": "follower hosts wedged; restart fleet"})
                 else:
                     self._send(200, "ok")
+            elif self.path == "/v1/stats":
+                # serving-side observability: queue depth, live slots, slot
+                # occupancy, cumulative tokens — the continuous engine's
+                # counters (observe/metrics.ServingStats). Window mode
+                # reports the little it tracks (its queue).
+                if cont_engine is not None:
+                    stats = {"engine": "continuous", **cont_engine.stats_snapshot()}
+                else:
+                    stats = {
+                        "engine": "window",
+                        "queue_depth": engine._q.qsize(),
+                        "max_batch": max_batch,
+                    }
+                self._send(200, stats)
             else:
                 self._send(404, {"error": "not found"})
 
@@ -134,25 +181,28 @@ def serve(
             (``max_new_tokens=3768``) otherwise leaves a client staring at
             nothing for the whole generation.
 
-            Streams run on the handler thread against the same Generator the
-            batching engine uses — concurrent dispatches serialize in the
-            device queue, so batched traffic keeps flowing. Multi-host
+            With the continuous engine, the stream RIDES the shared slot
+            batch (engine.stream): tokens surface as the slot decodes them,
+            concurrently with every other in-flight request. Window mode
+            streams on the handler thread against the Generator directly —
+            concurrent dispatches serialize in the device queue. Multi-host
             serving does not stream (the per-chunk host round-trip would
             need a broadcast each chunk); clients get a 501 there."""
-            if coordinator is not None:
-                self._send(501, {"error": "streaming unavailable in multi-host serving"})
-                return
             # everything fallible happens BEFORE headers go out, so clients
             # get a 400 instead of a hung keep-alive connection
             try:
                 if int(req.get("speculative", 0)):
-                    # /v1/generate honors this knob; streaming decodes in
-                    # fixed chunks with no speculative path — reject rather
-                    # than silently serve plain decode (ADVICE r3).
-                    # speculative=0 (the documented off value) passes through.
+                    # streaming has no speculative decode path in ANY serving
+                    # mode — reject consistently (same code, same message)
+                    # rather than silently serving plain decode, and name
+                    # what IS supported. speculative=0 (the documented off
+                    # value) passes through.
                     raise ValueError(
-                        "'speculative' is not supported on /v1/stream; use "
-                        "/v1/generate for speculative decoding"
+                        "'speculative' is not supported on /v1/stream; "
+                        "supported alternatives: POST /v1/generate with "
+                        "'speculative': K (non-streaming speculative "
+                        "decode), or /v1/stream without 'speculative' "
+                        "(plain streaming)"
                     )
                 gen_kwargs = {
                     k: cast(req[k])
@@ -177,6 +227,9 @@ def serve(
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"bad request: {e}"})
                 return
+            if coordinator is not None:
+                self._send(501, {"error": "streaming unavailable in multi-host serving"})
+                return
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -186,11 +239,22 @@ def serve(
             def chunk_out(data: bytes) -> None:
                 self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
 
+            if cont_engine is not None:
+                # ride the shared slot batch: one token per piece, emitted
+                # as the engine's scheduler loop decodes it
+                source = (
+                    [t]
+                    for t in cont_engine.stream(
+                        prompt_ids, gen, seed=seed, timeout=request_timeout_s
+                    )
+                )
+            else:
+                source = generator.generate_stream(
+                    prompt_ids, gen, seed=seed, chunk=stream_chunk
+                )
             ids_all, prev_text = [], ""
             try:
-                for piece in generator.generate_stream(
-                    prompt_ids, gen, seed=seed, chunk=stream_chunk,
-                ):
+                for piece in source:
                     ids_all.extend(piece)
                     text = generator.tokenizer.decode(
                         ids_all, skip_special_tokens=True
@@ -269,9 +333,17 @@ def serve(
                 # chat helpers, so CLI and server cannot diverge); only the
                 # device work goes through the batching engine's worker
                 prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
-                pending = engine.submit_full(
-                    prompt_ids, gen, seed=seed, timeout=request_timeout_s
-                )
+                # speculative requests need the fused draft+verify program —
+                # they keep riding the window engine; everything else takes
+                # the continuous engine when it is on
+                if cont_engine is not None and gen.speculative_lookup == 0:
+                    pending = cont_engine.submit_full(
+                        prompt_ids, gen, seed=seed, timeout=request_timeout_s
+                    )
+                else:
+                    pending = engine.submit_full(
+                        prompt_ids, gen, seed=seed, timeout=request_timeout_s
+                    )
                 answer = generator.decode_reply(pending.result)
             except TimeoutError as e:  # wedged device: shed load, don't pile up
                 self._send(503, {"error": str(e)})
@@ -312,8 +384,24 @@ def main(argv: Optional[list] = None) -> int:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument(
+        "--engine", choices=["continuous", "window"], default="continuous",
+        help="continuous: slot-based persistent decode loop (mixed traffic "
+             "co-batches, mid-flight admission); window: drain-a-window "
+             "batching (multi-host falls back to this automatically)",
+    )
+    parser.add_argument(
+        "--slots", type=int, default=8,
+        help="continuous engine: persistent decode slots (the max live batch)",
+    )
+    parser.add_argument(
+        "--kv-buf-len", type=int, default=4096,
+        help="continuous engine: per-slot KV buffer length "
+             "(prompt + generated tokens must fit)",
+    )
+    parser.add_argument(
         "--max-batch", type=int, default=8,
-        help="max concurrent requests grouped into one device batch (1 = serialize)",
+        help="window engine: max concurrent requests grouped into one device "
+             "batch (1 = serialize)",
     )
     parser.add_argument(
         "--batch-window-ms", type=float, default=10.0,
@@ -338,7 +426,9 @@ def main(argv: Optional[list] = None) -> int:
         return 1
     serve(args.model_dir, args.host, args.port, args.max_batch,
           args.batch_window_ms, args.quantize,
-          request_timeout_s=args.request_timeout_s or None, tp=args.tp)
+          request_timeout_s=args.request_timeout_s or None, tp=args.tp,
+          engine_kind=args.engine, slots=args.slots,
+          kv_buf_len=args.kv_buf_len)
     return 0
 
 

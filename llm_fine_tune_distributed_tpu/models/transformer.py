@@ -612,6 +612,34 @@ def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfl
     }
 
 
+def insert_cache_row(cache, row_cache, slot):
+    """Write a batch-1 cache's K/V into row ``slot`` of a multi-row cache
+    without touching the other rows — the continuous-batching prefill-insert
+    (infer/engine.py): a freed slot adopts a freshly prefilled prompt while
+    its neighbors keep decoding.
+
+    ``row_cache`` buffers may be SHORTER than ``cache``'s (prompt-bucket vs
+    full decode buffer): only the leading ``row_cache`` slots of the row are
+    overwritten. Stale K/V beyond them is harmless under the slot == position
+    invariant — every cache slot ``j`` is rewritten (by prompt prefill or by
+    decode token ``j - prompt_len``) before any query position ``>= j`` can
+    attend to it, and slots above the current position are always masked.
+
+    ``slot`` may be a traced int32 scalar (one compiled insert program serves
+    every slot index).
+    """
+    new_layers = {}
+    for i, entry in cache["layers"].items():
+        row = row_cache["layers"][i]
+        new_layers[i] = {
+            n: jax.lax.dynamic_update_slice(
+                entry[n], row[n].astype(entry[n].dtype), (slot, 0, 0, 0)
+            )
+            for n in ("k", "v")
+        }
+    return {"layers": new_layers}
+
+
 class TransformerLM:
     """Thin OO facade over the functional API (convenience for scripts)."""
 

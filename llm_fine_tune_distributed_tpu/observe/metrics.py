@@ -19,9 +19,57 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 from typing import Dict, List, Optional
 
 from llm_fine_tune_distributed_tpu.runtime.distributed import is_primary_host
+
+
+class ServingStats:
+    """Thread-safe serving-side counters and gauges (`GET /v1/stats`).
+
+    The continuous-batching engine (infer/engine.py) updates these from its
+    scheduler thread; HTTP handler threads read snapshots concurrently. All
+    mutation goes through one lock — the quantities are tiny (a handful of
+    ints per token batch), so contention is irrelevant next to a decode step.
+
+    Counters (monotonic): ``tokens_served``, ``requests_admitted``,
+    ``requests_completed``, ``requests_abandoned``, ``decode_steps``.
+    Gauges (instantaneous): ``queue_depth``, ``live_slots``; ``slots`` is the
+    engine's capacity, and the snapshot derives ``slot_occupancy`` =
+    live_slots / slots — the "is the decode batch actually full?" number that
+    continuous batching exists to maximize.
+    """
+
+    COUNTERS = (
+        "tokens_served", "requests_admitted", "requests_completed",
+        "requests_abandoned", "decode_steps",
+    )
+    GAUGES = ("queue_depth", "live_slots")
+
+    def __init__(self, slots: int = 0):
+        self._lock = threading.Lock()
+        self.slots = int(slots)
+        self._values: Dict[str, int] = {
+            k: 0 for k in self.COUNTERS + self.GAUGES
+        }
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._values[name] += n
+
+    def gauge(self, name: str, value: int) -> None:
+        with self._lock:
+            self._values[name] = int(value)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = dict(self._values)
+        out["slots"] = self.slots
+        out["slot_occupancy"] = (
+            out["live_slots"] / self.slots if self.slots else 0.0
+        )
+        return out
 
 
 def inject_perplexity(logs: Dict[str, float]) -> Dict[str, float]:

@@ -64,7 +64,7 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from llm_fine_tune_distributed_tpu.utils.compat import shard_map
 
 import optax
 

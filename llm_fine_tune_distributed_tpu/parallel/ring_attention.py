@@ -126,7 +126,9 @@ def shard_map_seq_attention(local, mesh: Mesh, axis_name: str, q, k, v,
         s_ = rest.pop(0) if has_seg else None
         return local(q_, k_, v_, p_, s_)
 
-    fn = jax.shard_map(
+    from llm_fine_tune_distributed_tpu.utils.compat import shard_map
+
+    fn = shard_map(
         run,
         mesh=mesh,
         in_specs=(qkv_spec,) * 3

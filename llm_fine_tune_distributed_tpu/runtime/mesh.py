@@ -26,6 +26,10 @@ import numpy as np
 from jax.sharding import Mesh
 
 from llm_fine_tune_distributed_tpu.config import MeshConfig
+from llm_fine_tune_distributed_tpu.utils.compat import (
+    mesh_auto_axis_types,
+    mesh_kwargs,
+)
 
 MESH_AXES = ("data", "pipe", "fsdp", "tensor", "seq", "expert")
 
@@ -64,18 +68,20 @@ def make_mesh(
     # Auto axis types: sharding propagates GSPMD/Shardy-style from the
     # annotations on params/batch plus with_sharding_constraint points.
     # (jax.make_mesh defaults to Explicit axis types as of jax 0.9, which
-    # instead type-checks every intermediate — not what we want here.)
-    auto = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+    # instead type-checks every intermediate — not what we want here. On
+    # jax 0.4.x AxisType does not exist and auto is the only semantics:
+    # mesh_auto_axis_types returns None and the kwarg is omitted.)
+    auto = mesh_auto_axis_types(len(MESH_AXES))
     n_slices = len({getattr(d, "slice_index", 0) or 0 for d in devices})
     if n_slices > 1:
         return _make_hybrid_mesh(sizes, devices, n_slices, auto)
     if devices is jax.devices() or list(devices) == list(jax.devices()):
         try:
-            return jax.make_mesh(shape, MESH_AXES, axis_types=auto)
+            return jax.make_mesh(shape, MESH_AXES, **mesh_kwargs(auto))
         except Exception:
             pass
     dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, MESH_AXES, axis_types=auto)
+    return Mesh(dev_array, MESH_AXES, **mesh_kwargs(auto))
 
 
 def _make_hybrid_mesh(sizes: dict, devices, n_slices: int, axis_types) -> Mesh:
@@ -111,7 +117,7 @@ def _make_hybrid_mesh(sizes: dict, devices, n_slices: int, axis_types) -> Mesh:
         tuple(dcn[a] for a in MESH_AXES),
         devices=list(devices),
     )
-    return Mesh(dev_array, MESH_AXES, axis_types=axis_types)
+    return Mesh(dev_array, MESH_AXES, **mesh_kwargs(axis_types))
 
 
 def data_parallel_size(mesh: Mesh) -> int:

@@ -1,0 +1,70 @@
+"""Version compatibility shims for the pinned jax.
+
+``jax.shard_map`` became a top-level export (with ``check_vma`` /
+``axis_names`` keywords) after the 0.4 series; the pinned jax 0.4.37 only
+ships ``jax.experimental.shard_map.shard_map`` (``check_rep`` /
+``auto``). Every call site in this repo routes through this module so the
+codebase is written against the MODERN surface and the translation to the
+experimental one lives in exactly one place:
+
+- ``check_vma`` (new name) -> ``check_rep`` (old name): both toggle the
+  replication/varying-manual-axes check.
+- ``axis_names`` (the axes the body is MANUAL over) -> ``auto`` (the
+  complement: mesh axes left automatic/GSPMD-partitioned).
+
+``jax.sharding.AxisType`` (Auto/Explicit mesh axis typing) is likewise
+newer than 0.4.37. On 0.4.x GSPMD auto-propagation is the ONLY mesh
+semantics, so "Auto axis types" degrades to constructing the mesh without
+the kwarg — ``mesh_auto_axis_types`` / ``make_mesh`` encode that.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, *,
+              check_vma: bool = True, axis_names: Optional[Set] = None):
+    """``jax.shard_map`` when available, else the experimental fallback."""
+    if hasattr(jax, "shard_map"):
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, auto=auto,
+    )
+
+
+def mesh_auto_axis_types(n: int):
+    """``(AxisType.Auto,) * n`` where AxisType exists, else None (0.4.x,
+    where every mesh axis is implicitly auto and Mesh/make_mesh take no
+    ``axis_types``)."""
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if axis_type is None:
+        return None
+    return (axis_type.Auto,) * n
+
+
+def mesh_kwargs(axis_types) -> dict:
+    """kwargs for Mesh()/jax.make_mesh(): {} when axis_types is None."""
+    return {} if axis_types is None else {"axis_types": axis_types}
+
+
+def make_mesh(axis_shapes, axis_names, *, auto_axis_types: bool = True):
+    """``jax.make_mesh`` with Auto axis types where the pinned jax supports
+    typed mesh axes, plain ``jax.make_mesh`` otherwise."""
+    kwargs = {}
+    if auto_axis_types:
+        kwargs = mesh_kwargs(mesh_auto_axis_types(len(axis_names)))
+    return jax.make_mesh(axis_shapes, axis_names, **kwargs)

@@ -45,6 +45,9 @@ from llm_fine_tune_distributed_tpu.observe.comm_accounting import (
     account_text,
 )
 from llm_fine_tune_distributed_tpu.observe.scaling import abstract_train_setup
+from llm_fine_tune_distributed_tpu.utils.compat import (
+    make_mesh as compat_make_mesh,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -77,9 +80,7 @@ def _ar(bytes_, g):
 def test_parser_exact_on_known_program(eight_devices):
     """A hand-built FSDP matmul step with a 3-trip scan: the parser must
     recover the exact collective set, axis attribution, and trip counts."""
-    mesh = jax.make_mesh(
-        (2, 4), ("data", "fsdp"), axis_types=(jax.sharding.AxisType.Auto,) * 2
-    )
+    mesh = compat_make_mesh((2, 4), ("data", "fsdp"))
     W = jax.ShapeDtypeStruct(
         (512, 512), jnp.float32, sharding=NamedSharding(mesh, P("fsdp", None))
     )

@@ -1,0 +1,231 @@
+"""Continuous-batching engine (infer/engine.py): slot-based persistent
+decode with in-flight admission. Pins the contracts the window batcher
+could not offer: greedy bit-parity with solo decode WHILE other slots are
+live, FIFO admission across mixed greedy/sampled traffic, slot reuse after
+EOS, and abandoned requests shed without decoding."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
+from llm_fine_tune_distributed_tpu.infer.engine import ContinuousBatchingEngine
+from llm_fine_tune_distributed_tpu.infer.sampling import (
+    sample_token,
+    sample_token_traced,
+)
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+
+GREEDY = GenerationConfig(max_new_tokens=6, do_sample=False)
+SAMPLED = GenerationConfig(max_new_tokens=6, do_sample=True, temperature=1.0)
+
+
+@pytest.fixture(scope="module")
+def generator():
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    return Generator(
+        params, mc, ByteChatMLTokenizer(), compute_dtype=jnp.float32, eos_token_ids=[]
+    )
+
+
+@pytest.fixture()
+def engine(generator):
+    return ContinuousBatchingEngine(generator, slots=4, buf_len=96, prompt_bucket=16)
+
+
+def _prompts():
+    tok = ByteChatMLTokenizer()
+    return [tok.encode(t) for t in ("alpha", "beta bravo", "the quick brown fox")]
+
+
+def test_greedy_bit_identical_to_solo_with_live_neighbors(generator, engine):
+    """The headline guarantee: a greedy request decoded in a slot whose
+    neighbors are live (including SAMPLED ones — impossible to co-batch in
+    the window engine) produces exactly solo generate_ids' tokens."""
+    prompts = _prompts()
+    solo = [generator.generate_ids(p, GREEDY) for p in prompts]
+
+    long_cfg = GenerationConfig(max_new_tokens=48, do_sample=True, temperature=1.0)
+    results = [None] * len(prompts)
+
+    def occupy():
+        engine.submit(prompts[0], long_cfg, seed=11, timeout=240)
+
+    def ask(i):
+        results[i] = engine.submit(prompts[i], GREEDY, timeout=240)
+
+    occupier = threading.Thread(target=occupy)
+    occupier.start()
+    time.sleep(0.05)  # let the sampled occupant take its slot first
+    threads = [threading.Thread(target=ask, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads + [occupier]:
+        t.join(timeout=240)
+    assert results == solo
+
+
+def test_sampled_deterministic_in_request_seed(engine):
+    """Per-slot RNG is keyed by the REQUEST seed, not the row index: the
+    same (prompt, config, seed) reproduces regardless of slot placement or
+    co-residents — the property that lifts the window engine's
+    greedy-only co-batching restriction."""
+    prompts = _prompts()
+    runs = []
+    for round_ in range(2):
+        results = [None] * 3
+        seeds = [5, 5, 9]
+
+        def ask(i):
+            results[i] = engine.submit(prompts[0], SAMPLED, seed=seeds[i], timeout=240)
+
+        # different co-resident mixes each round (a greedy neighbor in round
+        # two) must not change any sampled row's tokens
+        extra = None
+        if round_ == 1:
+            extra = threading.Thread(
+                target=lambda: engine.submit(prompts[2], GREEDY, timeout=240)
+            )
+            extra.start()
+        threads = [threading.Thread(target=ask, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads + ([extra] if extra else []):
+            t.join(timeout=240)
+        runs.append(results)
+    assert runs[0][0] == runs[0][1]  # same seed -> same tokens
+    assert runs[0][0] != runs[0][2]  # different seed -> different draw
+    assert runs[0] == runs[1]  # co-resident mix is irrelevant
+
+
+def test_fifo_admission_mixed_traffic(generator):
+    """With one slot occupied, a SAMPLED waiter that arrived before a
+    greedy waiter is admitted first — the continuous engine has no
+    compatibility classes to jump the queue with."""
+    engine = ContinuousBatchingEngine(generator, slots=1, buf_len=96, prompt_bucket=16)
+    prompts = _prompts()
+    done_at = {}
+
+    def ask(name, delay, prompt, cfg):
+        def run():
+            time.sleep(delay)
+            engine.submit(prompt, cfg, timeout=240)
+            done_at[name] = time.monotonic()
+
+        t = threading.Thread(target=run)
+        t.start()
+        return t
+
+    threads = [
+        ask("occupant", 0.0, prompts[0], GenerationConfig(max_new_tokens=24, do_sample=False)),
+        ask("sampled", 0.10, prompts[1], SAMPLED),
+        ask("greedy", 0.20, prompts[2], GREEDY),
+    ]
+    for t in threads:
+        t.join(timeout=240)
+    assert done_at["sampled"] < done_at["greedy"], done_at
+
+
+def test_slot_reuse_after_eos(generator):
+    """A slot whose row hits EOS frees immediately and is re-prefilled for
+    the next waiter; the EOS-truncated result matches solo decode with the
+    same EOS set."""
+    prompts = _prompts()
+    solo_open = generator.generate_ids(prompts[0], GREEDY)
+    # promote one emitted token to EOS; truncation happens at its FIRST
+    # occurrence (the tiny random-init model may repeat tokens, so derive
+    # the expectation rather than assuming distinct greedy tokens)
+    eos = solo_open[-1]
+    gen_eos = Generator(
+        generator.params, generator.config, ByteChatMLTokenizer(),
+        compute_dtype=jnp.float32, eos_token_ids=[eos],
+    )
+    solo = gen_eos.generate_ids(prompts[0], GREEDY)
+    assert solo == solo_open[: solo_open.index(eos)]  # sanity: EOS truncates
+
+    engine = ContinuousBatchingEngine(gen_eos, slots=2, buf_len=96, prompt_bucket=16)
+    # 5 requests through 2 slots: slots MUST be reused (incl. after EOS)
+    results = [engine.submit(prompts[0], GREEDY, timeout=240) for _ in range(5)]
+    assert all(r == solo for r in results)
+    snap = engine.stats_snapshot()
+    assert snap["requests_completed"] == 5
+    assert snap["live_slots"] == 0 and snap["queue_depth"] == 0
+
+
+def test_abandoned_request_dropped_without_decoding(generator):
+    """A submit that times out while QUEUED is never admitted (no prefill,
+    no decode for a waiter that's gone) — the window engine's abandonment
+    semantics, carried over."""
+    # buf_len=128 is unique to this test: the occupier's prefill/step jits
+    # compile fresh INSIDE its admission, so the short-timeout waiter below
+    # reliably expires while still queued (no warm-cache race)
+    engine = ContinuousBatchingEngine(generator, slots=1, buf_len=128, prompt_bucket=16)
+    prompts = _prompts()
+    long_cfg = GenerationConfig(max_new_tokens=48, do_sample=False)
+    occupier = threading.Thread(
+        target=lambda: engine.submit(prompts[0], long_cfg, timeout=240)
+    )
+    occupier.start()
+    time.sleep(0.05)
+    with pytest.raises(TimeoutError):
+        engine.submit(prompts[1], GREEDY, timeout=0.2)
+    occupier.join(timeout=240)
+    # drain: one more request proves the engine is healthy afterwards
+    assert engine.submit(prompts[2], GREEDY, timeout=240) is not None
+    snap = engine.stats_snapshot()
+    assert snap["requests_abandoned"] == 1
+    # the abandoned request was never admitted, so exactly two were
+    assert snap["requests_admitted"] == 2
+    assert snap["tokens_served"] == 48 + 6
+
+
+def test_streaming_rides_the_batch(generator, engine):
+    """stream() yields the same greedy tokens solo decode produces, one at
+    a time, while a neighbor slot decodes concurrently."""
+    prompts = _prompts()
+    solo = generator.generate_ids(prompts[1], GREEDY)
+    neighbor = threading.Thread(
+        target=lambda: engine.submit(
+            prompts[0], GenerationConfig(max_new_tokens=24, do_sample=True), timeout=240
+        )
+    )
+    neighbor.start()
+    got = list(engine.stream(prompts[1], GREEDY, timeout=120))
+    neighbor.join(timeout=240)
+    assert got == solo
+
+
+def test_error_propagates_to_waiter(generator):
+    engine = ContinuousBatchingEngine(generator, slots=2, buf_len=96, prompt_bucket=16)
+    with pytest.raises(ValueError):
+        engine.submit([], GREEDY, timeout=30)  # empty prompt
+    with pytest.raises(ValueError):
+        engine.submit(list(range(200)), GREEDY, timeout=30)  # exceeds buf_len
+
+
+def test_traced_sampler_greedy_matches_static():
+    """sample_token_traced's greedy path is bitwise the static sampler's
+    (the engine's parity guarantee reduces to this plus row-independence
+    of the forward)."""
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(3, 97), jnp.float32)
+    seen = jnp.asarray(rng.rand(3, 97) < 0.3)
+    cfg = GenerationConfig(do_sample=False, repetition_penalty=1.3)
+    want = sample_token(None, logits, seen, cfg)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(3)])
+    got = sample_token_traced(
+        keys, logits, seen,
+        temperature=jnp.full((3,), 0.7),
+        top_p=jnp.full((3,), 0.9),
+        top_k=jnp.full((3,), 40, jnp.int32),
+        repetition_penalty=jnp.full((3,), 1.3),
+        do_sample=jnp.zeros((3,), bool),
+    )
+    assert np.array_equal(np.asarray(want), np.asarray(got))

@@ -221,3 +221,47 @@ def test_stream_bad_request(server):
         assert False, "expected 400"
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_stream_speculative_400_names_alternatives(server):
+    """"speculative" on /v1/stream is a 400 (it stays on the window engine's
+    fused draft+verify program) and the error names the supported routes."""
+    body = {"question": "q?", "max_new_tokens": 4, "greedy": True, "speculative": 4}
+    req = urllib.request.Request(
+        f"{server}/v1/stream", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        msg = json.loads(e.read())["error"]
+        assert "POST /v1/generate" in msg and "/v1/stream" in msg
+
+
+def test_stats_endpoint(server):
+    """GET /v1/stats: live engine counters after serving one request."""
+    req = urllib.request.Request(
+        f"{server}/v1/generate",
+        data=json.dumps({"question": "q?", "max_new_tokens": 4, "greedy": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        r.read()
+    with urllib.request.urlopen(f"{server}/v1/stats", timeout=30) as r:
+        stats = json.loads(r.read())
+    assert stats["engine"] == "continuous"
+    assert stats["tokens_served"] >= 1
+    assert stats["requests_completed"] >= 1
+    assert stats["queue_depth"] == 0
+    assert 0.0 <= stats["slot_occupancy"] <= 1.0
+
+
+def test_stats_endpoint_window_engine(model_dir):
+    """--engine window still serves /v1/stats (reduced: queue depth only)."""
+    base = _start_server(model_dir, engine_kind="window")
+    with urllib.request.urlopen(f"{base}/v1/stats", timeout=30) as r:
+        stats = json.loads(r.read())
+    assert stats["engine"] == "window"
+    assert "queue_depth" in stats
