@@ -10,15 +10,24 @@ and every request waits for its group's longest row. The continuous engine
 config mid-flight. Decode is weight-bandwidth-bound, so slots-full-per-step
 is the serving-throughput lever this benchmark quantifies.
 
-Each client submits a stream of requests drawn from a mixed pool of prompt
-lengths, token budgets, and greedy/sampled configs; the sweep runs 1, 8 and
-32 clients against BOTH engines on the same model and prints one JSON line
-per (engine, clients) config, perf_ledger-style ("metric" key).
+The paged engine (PagedContinuousBatchingEngine) adds block-paged KV with
+shared-prefix reuse and chunked prefill on top of the continuous loop; its
+lever is a SECOND workload here — prefix-heavy traffic where every prompt
+opens with the same long system prefix (the production shape this repo
+serves: one wilderness system prompt, many short questions). The dense
+engines re-prefill that prefix per request; the paged engine prefills it
+once and maps the blocks, so its JSON lines also carry prefix-hit-rate and
+block-pool occupancy.
+
+Each client submits a stream of requests drawn from the workload pool; the
+sweep runs 1, 8 and 32 clients against every engine on the same model and
+prints one JSON line per (engine, workload, clients) config,
+perf_ledger-style ("metric" key).
 
 Usage: python benchmarks/serve_bench.py   (CPU ok: defaults to the tiny
 preset off-accelerator). Env: SERVE_PRESET, SERVE_CLIENTS=1,8,32,
 SERVE_REQS_PER_CLIENT (default 4), SERVE_SLOTS (default 8),
-SERVE_ENGINES=continuous,window.
+SERVE_ENGINES=continuous,paged,window.
 """
 
 import json
@@ -47,6 +56,29 @@ def _workload(rng, vocab, n):
         )
         prompt = rng.randint(0, min(vocab, 256), (plen,)).tolist()
         out.append((prompt, gen, i))
+    return out
+
+
+def _prefix_workload(rng, vocab, n, prefix_len=192):
+    """Prefix-heavy pool: every prompt opens with the SAME long system
+    prefix followed by a short random question suffix — the shape the
+    paged engine's prefix cache exists for. Mixed greedy/sampled budgets
+    as in the general pool."""
+    from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+
+    system = rng.randint(0, min(vocab, 256), (prefix_len,)).tolist()
+    out = []
+    for i in range(n):
+        slen = int(rng.choice([8, 16, 32]))
+        max_new = int(rng.choice([8, 16, 32]))
+        sampled = bool(rng.rand() < 0.5)
+        gen = GenerationConfig(
+            max_new_tokens=max_new,
+            do_sample=sampled,
+            temperature=1.0 if sampled else 0.0,
+        )
+        suffix = rng.randint(0, min(vocab, 256), (slen,)).tolist()
+        out.append((system + suffix, gen, i))
     return out
 
 
@@ -81,7 +113,10 @@ def main():
 
     from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
     from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine
-    from llm_fine_tune_distributed_tpu.infer.engine import ContinuousBatchingEngine
+    from llm_fine_tune_distributed_tpu.infer.engine import (
+        ContinuousBatchingEngine,
+        PagedContinuousBatchingEngine,
+    )
     from llm_fine_tune_distributed_tpu.infer.generate import Generator
     from llm_fine_tune_distributed_tpu.models.configs import get_preset
     from llm_fine_tune_distributed_tpu.models.transformer import init_params
@@ -95,7 +130,9 @@ def main():
     ]
     reqs_per_client = int(os.environ.get("SERVE_REQS_PER_CLIENT", "4"))
     slots = int(os.environ.get("SERVE_SLOTS", "8"))
-    engines = os.environ.get("SERVE_ENGINES", "continuous,window").split(",")
+    engines = os.environ.get(
+        "SERVE_ENGINES", "continuous,paged,window"
+    ).split(",")
 
     mc = get_preset(preset)
     dtype = jnp.bfloat16 if on_accelerator else jnp.float32
@@ -106,46 +143,80 @@ def main():
 
     rng = np.random.RandomState(0)
     workload = _workload(rng, mc.vocab_size, 64)
+    prefix_load = _prefix_workload(np.random.RandomState(1), mc.vocab_size, 64)
+
+    def make_engine(kind):
+        if kind == "continuous":
+            return ContinuousBatchingEngine(
+                generator, slots=slots, buf_len=256, prompt_bucket=32
+            )
+        if kind == "paged":
+            return PagedContinuousBatchingEngine(
+                generator, slots=slots, buf_len=256, prompt_bucket=32,
+                block_len=32, prefill_chunk=64,
+            )
+        return BatchingEngine(generator, max_batch=slots)
 
     results = {}
     for kind in engines:
-        if kind == "continuous":
-            engine = ContinuousBatchingEngine(
-                generator, slots=slots, buf_len=256, prompt_bucket=32
-            )
-        else:
-            engine = BatchingEngine(generator, max_batch=slots)
-        # warm the jit caches so the sweep times decode, not compilation
-        _run_config(engine, 1, 2, workload)
-        for clients in client_counts:
-            total, dt, errors = _run_config(
-                engine, clients, reqs_per_client, workload
-            )
-            tps = total / dt if dt > 0 else 0.0
-            results[(kind, clients)] = tps
-            print(json.dumps({
-                "metric": f"serve_tokens_per_sec_{kind}_c{clients}",
-                "value": round(tps, 2),
-                "unit": "tokens/sec",
-                "engine": kind,
-                "clients": clients,
-                "requests": clients * reqs_per_client,
-                "tokens_served": total,
-                "wall_seconds": round(dt, 2),
-                "model": preset,
-                "platform": jax.devices()[0].platform,
-                "slots": slots,
-                "errors": errors,
-            }), flush=True)
+        # the window batcher sits out the prefix-heavy sweep: it has no
+        # prefix cache and the mixed sweep already locates it
+        sweeps = [("", workload)] if kind == "window" else [
+            ("", workload), ("prefix_", prefix_load)
+        ]
+        for tag, load in sweeps:
+            engine = make_engine(kind)  # fresh caches per (engine, workload)
+            # warm the jit caches so the sweep times decode, not compilation
+            _run_config(engine, 1, 2, load)
+            for clients in client_counts:
+                total, dt, errors = _run_config(
+                    engine, clients, reqs_per_client, load
+                )
+                tps = total / dt if dt > 0 else 0.0
+                results[(kind, tag, clients)] = tps
+                line = {
+                    "metric": f"serve_tokens_per_sec_{kind}_{tag}c{clients}",
+                    "value": round(tps, 2),
+                    "unit": "tokens/sec",
+                    "engine": kind,
+                    "workload": "prefix_heavy" if tag else "mixed",
+                    "clients": clients,
+                    "requests": clients * reqs_per_client,
+                    "tokens_served": total,
+                    "wall_seconds": round(dt, 2),
+                    "model": preset,
+                    "platform": jax.devices()[0].platform,
+                    "slots": slots,
+                    "errors": errors,
+                }
+                if kind == "paged":
+                    snap = engine.stats_snapshot()
+                    line["prefix_hit_rate"] = round(snap["prefix_hit_rate"], 4)
+                    line["block_pool_occupancy"] = round(
+                        snap["block_pool_occupancy"], 4
+                    )
+                    line["peak_block_pool_occupancy"] = round(
+                        snap["peak_block_pool_occupancy"], 4
+                    )
+                print(json.dumps(line), flush=True)
 
     for clients in client_counts:
-        cont = results.get(("continuous", clients))
-        win = results.get(("window", clients))
+        cont = results.get(("continuous", "", clients))
+        win = results.get(("window", "", clients))
         if cont and win:
             print(json.dumps({
                 "metric": f"serve_continuous_speedup_c{clients}",
                 "value": round(cont / win, 2),
                 "unit": "x over window engine",
+                "clients": clients,
+            }), flush=True)
+        paged = results.get(("paged", "prefix_", clients))
+        dense = results.get(("continuous", "prefix_", clients))
+        if paged and dense:
+            print(json.dumps({
+                "metric": f"serve_paged_speedup_c{clients}",
+                "value": round(paged / dense, 2),
+                "unit": "x over dense continuous engine (prefix-heavy)",
                 "clients": clients,
             }), flush=True)
 

@@ -1,7 +1,10 @@
 """Inference: jitted KV-cache generation + model-directory loading
 (the TPU replacement for the reference's ``ask_*_model.py`` internals)."""
 
-from llm_fine_tune_distributed_tpu.infer.engine import ContinuousBatchingEngine
+from llm_fine_tune_distributed_tpu.infer.engine import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
 from llm_fine_tune_distributed_tpu.infer.generate import (
     Generator,
     load_model_dir,
@@ -11,6 +14,7 @@ from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "PagedContinuousBatchingEngine",
     "Generator",
     "GenerationConfig",
     "load_model_dir",

@@ -43,11 +43,17 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from llm_fine_tune_distributed_tpu.infer.batching import Request
+from llm_fine_tune_distributed_tpu.infer.paged import (
+    NULL_BLOCK,
+    BlockAllocator,
+    PrefixCache,
+)
 from llm_fine_tune_distributed_tpu.infer.sampling import (
     GenerationConfig,
     generation_config_arrays,
@@ -195,6 +201,17 @@ class ContinuousBatchingEngine:
                 req.tokens_q.put(None)
             req.done.set()
 
+    def _knob_arrays(self, req: Request) -> dict:
+        """Per-request traced sampling knobs as scalar arrays (prefill args)."""
+        raw = generation_config_arrays(req.gen, self._generator.config.vocab_size)
+        return {
+            "temperature": np.float32(raw["temperature"]),
+            "top_p": np.float32(raw["top_p"]),
+            "top_k": np.int32(raw["top_k"]),
+            "repetition_penalty": np.float32(raw["repetition_penalty"]),
+            "do_sample": np.bool_(raw["do_sample"]),
+        }
+
     def _insert(self, req: Request) -> None:
         gen = self._generator
         slot = int(np.flatnonzero(~self._live)[0])
@@ -210,14 +227,7 @@ class ContinuousBatchingEngine:
         prefill = gen.slot_prefill(bucket, self._buf_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :plen] = req.prompt
-        raw = generation_config_arrays(req.gen, gen.config.vocab_size)
-        knobs = {
-            "temperature": np.float32(raw["temperature"]),
-            "top_p": np.float32(raw["top_p"]),
-            "top_k": np.int32(raw["top_k"]),
-            "repetition_penalty": np.float32(raw["repetition_penalty"]),
-            "do_sample": np.bool_(raw["do_sample"]),
-        }
+        knobs = self._knob_arrays(req)
         import jax
 
         self._cache, self._state, first = prefill(
@@ -287,3 +297,362 @@ class ContinuousBatchingEngine:
         self._slot_tokens[slot] = []
         self._slot_budget[slot] = 0
         self._live[slot] = False
+
+
+class _PrefillTask:
+    """One admitted-but-not-yet-live request's remaining prefill work."""
+
+    __slots__ = ("req", "slot", "keys", "plen", "next")
+
+    def __init__(self, req: Request, slot: int, keys, plen: int, next_: int):
+        self.req = req
+        self.slot = slot
+        self.keys = keys  # full-block prefix keys (PrefixCache.block_keys)
+        self.plen = plen
+        self.next = next_  # first logical position not yet prefilled
+
+
+class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
+    """Continuous engine over a block-paged KV pool instead of dense rows.
+
+    Three changes over the dense parent, one mechanism: KV lives in ONE
+    global pool of ``block_len``-token blocks (models/transformer.
+    init_paged_cache) addressed through per-slot block tables, so
+
+    - decode attention gathers ``nb * block_len`` positions where ``nb`` is
+      the live-occupancy bucket (next power of two over the widest live
+      slot's blocks-in-use), not ``buf_len``: decode cost tracks what's
+      actually resident. The jit cache holds one step per (slots, nb) —
+      a handful of programs, since nb is log-bucketed;
+    - admission maps blocks instead of copying rows: a prompt's leading
+      FULL blocks are looked up in a refcounted prefix cache (infer/paged.
+      PrefixCache) and shared copy-on-write — matched blocks enter the
+      slot's table with a reference, prefill resumes at the divergence
+      point, and COW is free because a consumer's writes are provably
+      outside shared blocks (suffix writes start block-aligned at
+      ``shared_len``; decode writes at ``pos >= plen``). The whole-prompt
+      system-prompt case prefills once, ever;
+    - prompts prefill in ``prefill_chunk``-token chunks INTERLEAVED with
+      decode steps (one chunk or one decode step per scheduler tick), so a
+      4k-token prompt no longer stalls every live slot for its full
+      prefill. Chunk queries attend through the table to all earlier
+      logical positions, so chunking changes no real token's logits.
+
+    Contracts inherited bit-for-bit from the parent (pinned by
+    tests/test_paged.py): greedy == solo ``generate_ids``, sampled output
+    deterministic in (request, seed), strict FIFO — a request that cannot
+    get blocks yet BLOCKS the queue head until a retirement frees some
+    (never overtaken), after LRU eviction of the prefix cache fails to
+    make room. Dead rows get all-null tables each step so their frozen
+    positions write into null-block garbage, never into reassigned blocks.
+    """
+
+    def __init__(
+        self,
+        generator,
+        slots: int = 8,
+        buf_len: int = 4096,
+        prompt_bucket: int = 64,
+        block_len: int = 256,
+        prefill_chunk: int = 512,
+        num_blocks: Optional[int] = None,
+        stats: Optional[ServingStats] = None,
+    ):
+        slots = max(1, int(slots))
+        self._block_len = max(1, int(block_len))
+        bucket = max(1, int(prompt_bucket))
+        # table width: enough blocks to cover buf_len PLUS the final prefill
+        # chunk's pad bucket (write_end <= plen - 1 + bucket <= buf_len + Г)
+        self._table_blocks = -(-(int(buf_len) + bucket) // self._block_len)
+        self._prefill_chunk = max(1, int(prefill_chunk))
+        if num_blocks is None:
+            # full tables for every slot + one table's worth of prefix-cache
+            # headroom + the null block: generous default, same order as the
+            # dense engine's slots * buf_len footprint
+            num_blocks = 1 + (slots + 1) * self._table_blocks
+        self._allocator = BlockAllocator(int(num_blocks))
+        self._prefix = PrefixCache(self._allocator, self._block_len)
+        self._table = np.zeros((slots, self._table_blocks), np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+        self._slot_plen = [0] * slots
+        self._prefills: List[_PrefillTask] = []  # FIFO, head in progress
+        self._waiting: "deque[Request]" = deque()  # FIFO admission buffer
+        stats = stats or ServingStats(slots, total_blocks=int(num_blocks) - 1)
+        # parent starts the worker thread LAST, so every paged field above
+        # must exist before this call
+        super().__init__(
+            generator, slots=slots, buf_len=buf_len,
+            prompt_bucket=prompt_bucket, stats=stats,
+        )
+
+    # ---------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        gen = self._generator
+        self._cache, self._state = gen.init_paged_state(
+            self._slots, self._allocator.num_blocks, self._block_len
+        )
+        while True:
+            self._admit()
+            busy = False
+            if self._prefills:
+                self._prefill_tick()
+                busy = True
+            if self._live.any():
+                self._decode_tick()
+                busy = True
+            if not busy:
+                # idle: block until traffic instead of spinning (_admit
+                # guarantees a queued head either admits or errors when
+                # nothing is running, so waiting-but-idle cannot happen)
+                self._waiting.append(self._q.get())
+
+    def _admit(self) -> None:
+        """Admit from the FIFO head while a slot AND blocks are available.
+
+        Unlike the dense parent, occupancy is ``_slot_req`` (a prefilling
+        slot is occupied but not yet live) and admission can fail for lack
+        of BLOCKS with free slots remaining — in that case the head waits
+        (strict FIFO: nothing overtakes it) for retirements to free blocks.
+        """
+        while True:
+            try:
+                self._waiting.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        while self._waiting:
+            req = self._waiting[0]
+            if req.abandoned:
+                self._waiting.popleft()
+                self.stats.incr("requests_abandoned")
+                req.done.set()
+                continue
+            free = [s for s in range(self._slots) if self._slot_req[s] is None]
+            if not free:
+                return
+            try:
+                plan = self._plan(req)
+            except BaseException as e:
+                self._waiting.popleft()
+                req.error = e
+                if req.tokens_q is not None:
+                    req.tokens_q.put(None)
+                req.done.set()
+                continue
+            if plan is None:
+                return  # head waits for blocks; FIFO holds
+            self._waiting.popleft()
+            self._insert_paged(req, free[0], plan)
+
+    def _chunk_plan(self, plen: int, shared_len: int):
+        """(nchunks, last_len, last_bucket, write_end) for a prompt whose
+        first ``shared_len`` positions come from the prefix cache. The same
+        arithmetic runs at admission (to size the allocation) and in
+        ``_prefill_tick`` (to pick the compiled program), so the final
+        chunk's pad writes are always inside allocated blocks."""
+        suffix = plen - shared_len
+        nchunks = -(-suffix // self._prefill_chunk)
+        last = suffix - (nchunks - 1) * self._prefill_chunk
+        last_bucket = -(-last // self._bucket) * self._bucket
+        write_end = shared_len + (nchunks - 1) * self._prefill_chunk + last_bucket
+        return nchunks, last, last_bucket, write_end
+
+    def _plan(self, req: Request) -> Optional[dict]:
+        """Match the prefix cache and reserve every block the request can
+        ever touch (prefill pads included — all-or-nothing, so a live slot
+        can never run out of blocks mid-decode). Returns None to make the
+        FIFO head wait, raises to reject, otherwise the admission plan."""
+        plen = len(req.prompt)
+        if plen == 0:
+            raise ValueError("continuous engine needs a non-empty prompt")
+        if plen >= self._buf_len:
+            raise ValueError(
+                f"prompt of {plen} tokens does not fit the engine's "
+                f"{self._buf_len}-position block budget (need >= 1 decode slot)"
+            )
+        L = self._block_len
+        budget_end = min(plen + req.gen.max_new_tokens, self._buf_len)
+        keys = self._prefix.block_keys(req.prompt)
+        # cap: >= 1 suffix token must prefill (the first sampled token needs
+        # the last prompt token's logits)
+        shared = self._prefix.match(keys, (plen - 1) // L)
+        shared_len = len(shared) * L
+        _, _, _, write_end = self._chunk_plan(plen, shared_len)
+        total = -(-max(budget_end, write_end) // L)
+        usable = self._allocator.num_blocks - 1
+        if total > usable:
+            for bid in shared:
+                self._allocator.free(bid)
+            raise ValueError(
+                f"request needs {total} KV blocks ({plen} prompt + "
+                f"{req.gen.max_new_tokens} new @ block_len={L}) but the pool "
+                f"only has {usable}"
+            )
+        nprivate = total - len(shared)
+        private = self._allocator.alloc(nprivate)
+        if private is None:
+            self._prefix.evict(nprivate)
+            private = self._allocator.alloc(nprivate)
+        if private is None:
+            for bid in shared:
+                self._allocator.free(bid)
+            if self._prefills or self._live.any():
+                return None  # blocks free as slots retire; head waits
+            # nothing running and the cache is drained: alloc can only fail
+            # if total > usable, which was rejected above
+            raise RuntimeError(
+                f"block pool exhausted with no traffic in flight "
+                f"({self._allocator.free_count}/{usable} free, "
+                f"need {nprivate})"
+            )
+        return {
+            "keys": keys,
+            "shared": shared,
+            "private": private,
+            "plen": plen,
+            "budget": budget_end - plen,
+        }
+
+    def _insert_paged(self, req: Request, slot: int, plan: dict) -> None:
+        """Map the reserved blocks into the slot's table and queue the
+        prefill work; the slot goes LIVE only when its final chunk lands."""
+        ids = plan["shared"] + plan["private"]
+        self._table[slot, : len(ids)] = ids
+        self._table[slot, len(ids):] = NULL_BLOCK
+        self._slot_blocks[slot] = ids
+        self._slot_plen[slot] = plan["plen"]
+        self._slot_req[slot] = req
+        self._slot_tokens[slot] = []
+        self._slot_budget[slot] = plan["budget"]
+        shared_len = len(plan["shared"]) * self._block_len
+        self.stats.incr("requests_admitted")
+        self.stats.incr("prompt_tokens", plan["plen"])
+        self.stats.incr("prefix_tokens_reused", shared_len)
+        self._prefills.append(
+            _PrefillTask(req, slot, plan["keys"], plan["plen"], shared_len)
+        )
+
+    def _prefill_tick(self) -> None:
+        """Run ONE bounded prefill chunk of the oldest pending prompt (FIFO
+        among prefills too), so long prompts interleave with decode steps
+        instead of stalling every live slot."""
+        gen = self._generator
+        task = self._prefills[0]
+        req = task.req
+        if req.abandoned:
+            self._prefills.pop(0)
+            self.stats.incr("requests_abandoned")
+            req.done.set()
+            self._release(task.slot)
+            return
+        import jax
+
+        C = self._prefill_chunk
+        remaining = task.plen - task.next
+        table = np.ascontiguousarray(self._table[task.slot : task.slot + 1])
+        try:
+            if remaining > C:
+                ingest = gen.paged_prefill_chunk(
+                    C, self._table_blocks, self._block_len
+                )
+                chunk = np.asarray(
+                    req.prompt[task.next : task.next + C], np.int32
+                )[None, :]
+                self._cache = ingest(
+                    gen.params, self._cache, table, chunk, np.int32(task.next)
+                )
+                task.next += C
+                self.stats.incr("prefill_chunks")
+                return
+            bucket = -(-remaining // self._bucket) * self._bucket
+            final = gen.paged_prefill_final(
+                bucket, self._table_blocks, self._block_len
+            )
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :remaining] = req.prompt[task.next :]
+            seen_row = np.zeros((1, gen.config.vocab_size), bool)
+            seen_row[0, np.asarray(req.prompt, np.intp)] = True
+            self._cache, self._state, first = final(
+                gen.params, self._cache, self._state, table, padded,
+                np.int32(task.next), np.int32(task.plen), seen_row,
+                np.int32(task.slot), self._knob_arrays(req),
+                jax.random.PRNGKey(req.seed),
+            )
+        except BaseException as e:
+            self._prefills.pop(0)
+            req.error = e
+            if req.tokens_q is not None:
+                req.tokens_q.put(None)
+            req.done.set()
+            self._release(task.slot)
+            return
+        self._prefills.pop(0)
+        self.stats.incr("prefill_chunks")
+        # register the prompt's FULL blocks for reuse BEFORE emitting (the
+        # first token may already finish the request and free the slot)
+        full = task.plen // self._block_len
+        self._prefix.insert(task.keys[:full], self._slot_blocks[task.slot][:full])
+        self._live[task.slot] = True
+        self._emit_token(task.slot, req, int(first))
+
+    def _decode_tick(self) -> None:
+        gen = self._generator
+        L = self._block_len
+        in_use = 1
+        for slot in range(self._slots):
+            if self._live[slot]:
+                pos = self._slot_plen[slot] + len(self._slot_tokens[slot]) - 1
+                in_use = max(in_use, pos // L + 1)
+        nb = 1
+        while nb < in_use:
+            nb *= 2
+        nb = min(nb, self._table_blocks)
+        # dead rows decode with all-null tables: their frozen-position
+        # writes land in null-block garbage, never in a reassigned block
+        tables = np.ascontiguousarray(
+            np.where(self._live[:, None], self._table, NULL_BLOCK)[:, :nb]
+        )
+        step = gen.paged_step(self._slots, nb, L)
+        try:
+            self._cache, self._state, toks = step(
+                gen.params, self._cache, self._state, self._live.copy(), tables
+            )
+            toks = np.asarray(toks)
+        except BaseException as e:  # device failure: resolve every waiter
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                req.error = e
+                if req.tokens_q is not None:
+                    req.tokens_q.put(None)
+                req.done.set()
+                self._release(slot)
+            self._prefills.clear()
+            return
+        self.stats.incr("decode_steps")
+        self.stats.gauge_max("peak_blocks_in_use", self._allocator.used_count)
+        for slot in range(self._slots):
+            req = self._slot_req[slot]
+            if req is None or not self._live[slot]:
+                continue  # free, or admitted but still prefilling
+            if req.abandoned:
+                self.stats.incr("requests_abandoned")
+                req.done.set()
+                self._release(slot)
+                continue
+            self._emit_token(slot, req, int(toks[slot]))
+
+    # ------------------------------------------------------------- plumbing
+
+    def _release(self, slot: int) -> None:
+        for bid in self._slot_blocks[slot]:
+            self._allocator.free(bid)
+        self._slot_blocks[slot] = []
+        self._slot_plen[slot] = 0
+        self._table[slot, :] = NULL_BLOCK
+        super()._release(slot)
+
+    def stats_snapshot(self) -> dict:
+        self.stats.gauge("blocks_in_use", self._allocator.used_count)
+        self.stats.gauge("prefix_cache_blocks", len(self._prefix))
+        return super().stats_snapshot()

@@ -28,6 +28,7 @@ from llm_fine_tune_distributed_tpu.infer.sampling import (
 from llm_fine_tune_distributed_tpu.models.transformer import (
     forward,
     init_cache,
+    init_paged_cache,
     insert_cache_row,
     unembed,
 )
@@ -547,11 +548,9 @@ class Generator:
     # slot rewrites every cache position before any query can attend to it
     # (slot == position invariant; see insert_cache_row).
 
-    def init_slot_state(self, slots: int, buf_len: int):
-        """Fresh (cache, state) for a ``slots``-wide persistent decode."""
+    def _fresh_slot_state(self, slots: int):
         mc = self.config
-        cache = init_cache(mc, slots, buf_len, dtype=self.compute_dtype)
-        state = {
+        return {
             "last": jnp.zeros((slots,), jnp.int32),
             "pos": jnp.zeros((slots,), jnp.int32),
             "seen": jnp.zeros((slots, mc.vocab_size), bool),
@@ -562,7 +561,11 @@ class Generator:
             "repetition_penalty": jnp.ones((slots,), jnp.float32),
             "do_sample": jnp.zeros((slots,), bool),
         }
-        return cache, state
+
+    def init_slot_state(self, slots: int, buf_len: int):
+        """Fresh (cache, state) for a ``slots``-wide persistent decode."""
+        cache = init_cache(self.config, slots, buf_len, dtype=self.compute_dtype)
+        return cache, self._fresh_slot_state(slots)
 
     def slot_step(self, slots: int, buf_len: int):
         """Jitted one-token decode step for ALL slots (cached per shape)."""
@@ -677,6 +680,175 @@ class Generator:
             return cache, state, first[0]
 
         return prefill
+
+    # ------------------------------------------------- paged continuous decode
+
+    # Block-paged variants of the slot programs (PagedContinuousBatchingEngine,
+    # infer/engine.py). The per-slot state dict is IDENTICAL to
+    # init_slot_state's; only the KV layout changes: one global block pool
+    # (models/transformer.init_paged_cache) addressed through per-slot block
+    # tables, so (a) a decode step's attention gathers nb*block_len positions
+    # — the engine slices tables to the live occupancy bucket, so cost tracks
+    # occupancy, not the buffer ceiling — and (b) prompts prefill in bounded
+    # chunks (cache_pos = chunk start) interleaved with decode, writing
+    # straight into the slot's blocks instead of a private buffer + row copy.
+
+    def init_paged_state(self, slots: int, num_blocks: int, block_len: int):
+        """Fresh (pool, state) for a paged ``slots``-wide persistent decode."""
+        pool = init_paged_cache(
+            self.config, num_blocks, block_len, dtype=self.compute_dtype
+        )
+        return pool, self._fresh_slot_state(slots)
+
+    def paged_step(self, slots: int, nb: int, block_len: int):
+        """Jitted one-token paged decode step (cached per table width)."""
+        key = ("paged_step", slots, nb, block_len)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_paged_step(slots, nb, block_len)
+        return self._jit_cache[key]
+
+    def paged_prefill_chunk(self, chunk: int, nb: int, block_len: int):
+        """Jitted ingest-only prefill chunk (all but a prompt's last chunk)."""
+        key = ("paged_chunk", chunk, nb, block_len)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_paged_prefill(
+                chunk, nb, block_len, final=False
+            )
+        return self._jit_cache[key]
+
+    def paged_prefill_final(self, bucket: int, nb: int, block_len: int):
+        """Jitted final prefill chunk: ingest + first-token sample + slot
+        state scatter (cached per pad bucket)."""
+        key = ("paged_final", bucket, nb, block_len)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_paged_prefill(
+                bucket, nb, block_len, final=True
+            )
+        return self._jit_cache[key]
+
+    def _build_paged_step(self, slots: int, nb: int, block_len: int):
+        """One decode step over the slot array against the block pool. Same
+        sampling semantics as ``_build_slot_step`` bit for bit — only the KV
+        addressing differs: each slot's last token's K/V scatters to pool
+        cell (table[pos // L], pos % L) and attention runs over the slot's
+        gathered nb*L-position view (gathered index == logical position, so
+        the mask rule is the dense one). Dead rows carry all-null tables
+        (engine-side) so their frozen-position writes land in null-block
+        garbage, never in a block reassigned to a live slot."""
+        mc = self.config
+        dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
+
+        @jax.jit
+        def step(params, pool, state, live, tables):
+            last, pos = state["last"], state["pos"]
+            hidden, pool = forward(
+                params, last[:, None], mc, cache=pool, cache_pos=pos,
+                block_tables=tables, compute_dtype=dtype, output_hidden=True,
+                activation_sharding=act,
+            )
+            logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype, mesh=mesh)
+            split = jax.vmap(jax.random.split)(state["rng"])  # [S, 2, 2]
+            tok = sample_token_traced(
+                split[:, 1], logits, state["seen"],
+                temperature=state["temperature"], top_p=state["top_p"],
+                top_k=state["top_k"],
+                repetition_penalty=state["repetition_penalty"],
+                do_sample=state["do_sample"],
+            )
+            tok = jnp.where(live, tok, last)
+            rows = jnp.arange(slots)
+            seen = jnp.where(
+                live[:, None], state["seen"].at[rows, tok].set(True), state["seen"]
+            )
+            new_state = dict(
+                state,
+                last=tok,
+                # no ceiling clamp: the engine's per-request budget keeps a
+                # live row's positions inside its allocated blocks
+                pos=jnp.where(live, pos + 1, pos),
+                seen=seen,
+                rng=jnp.where(live[:, None], split[:, 0], state["rng"]),
+            )
+            return pool, new_state, tok
+
+        return step
+
+    def _build_paged_prefill(
+        self, bucket: int, nb: int, block_len: int, final: bool
+    ):
+        """One prefill chunk of one prompt, written THROUGH the slot's block
+        table (batch 1, ``cache_pos`` = the chunk's first logical position).
+        Chunk queries attend to every logical position <= their own — shared
+        prefix blocks and earlier chunks included — so chunking (and prefix
+        reuse) does not change any real token's logits vs. a monolithic
+        prefill; pad keys of the last chunk sit at positions above every real
+        query, hence masked (``_prompt_prefill``'s argument, paged).
+
+        ``final=False``: ingest only (returns the pool). ``final=True``:
+        additionally samples the first token from the last PROMPT position's
+        logits with the request's traced knobs + seed-keyed RNG, and scatters
+        the slot's state — ``seen`` arrives precomputed from the FULL prompt
+        (host-side), since this program only sees the prompt's tail."""
+        mc = self.config
+        dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
+
+        if not final:
+
+            @jax.jit
+            def ingest(params, pool, table, chunk_ids, chunk_start):
+                _, pool = forward(
+                    params, chunk_ids, mc, cache=pool, cache_pos=chunk_start,
+                    block_tables=table, compute_dtype=dtype, output_hidden=True,
+                    activation_sharding=act,
+                )
+                return pool
+
+            return ingest
+
+        @jax.jit
+        def final_chunk(
+            params, pool, state, table, chunk_ids, chunk_start, prompt_len,
+            seen_row, slot, knobs, seed_key,
+        ):
+            hidden, pool = forward(
+                params, chunk_ids, mc, cache=pool, cache_pos=chunk_start,
+                block_tables=table, compute_dtype=dtype, output_hidden=True,
+                activation_sharding=act,
+            )
+            idx = prompt_len - 1 - chunk_start  # last prompt token, in-chunk
+            last_h = jnp.take_along_axis(
+                hidden, jnp.reshape(idx, (1, 1, 1)), axis=1
+            )[:, 0]
+            logits0 = unembed(params, last_h, mc, compute_dtype=dtype, mesh=mesh)
+            key, sub = jax.random.split(seed_key)
+            first = sample_token_traced(
+                sub[None], logits0, seen_row,
+                temperature=knobs["temperature"][None],
+                top_p=knobs["top_p"][None],
+                top_k=knobs["top_k"][None],
+                repetition_penalty=knobs["repetition_penalty"][None],
+                do_sample=knobs["do_sample"][None],
+            )
+            seen_row = seen_row.at[0, first[0]].set(True)
+            state = dict(
+                state,
+                last=state["last"].at[slot].set(first[0]),
+                pos=state["pos"].at[slot].set(prompt_len),
+                seen=jax.lax.dynamic_update_slice(state["seen"], seen_row, (slot, 0)),
+                rng=jax.lax.dynamic_update_slice(state["rng"], key[None], (slot, 0)),
+                temperature=state["temperature"].at[slot].set(knobs["temperature"]),
+                top_p=state["top_p"].at[slot].set(knobs["top_p"]),
+                top_k=state["top_k"].at[slot].set(knobs["top_k"]),
+                repetition_penalty=state["repetition_penalty"].at[slot].set(
+                    knobs["repetition_penalty"]
+                ),
+                do_sample=state["do_sample"].at[slot].set(knobs["do_sample"]),
+            )
+            return pool, state, first[0]
+
+        return final_chunk
 
     def generate_stream(
         self,

@@ -11,7 +11,7 @@ closes that gap with a dependency-free stdlib server exposing:
         optional: "max_new_tokens", "temperature", "top_p", "top_k",
                   "repetition_penalty", "greedy", "seed", "system_prompt"}
 
-Handlers run on threads; a single worker owns the TPU. Two engines
+Handlers run on threads; a single worker owns the TPU. Three engines
 (``--engine``):
 
 - ``continuous`` (default, single-host): slot-based persistent decode loop
@@ -19,6 +19,10 @@ Handlers run on threads; a single worker owns the TPU. Two engines
   refill mid-flight, and /v1/stream rides the shared batch. Speculative
   requests still run through the window engine (speculation needs the
   fused verify program).
+- ``paged`` (single-host): the continuous engine over a block-paged KV
+  pool (``--kv-block-len``) — decode cost tracks live occupancy, shared
+  prompt prefixes prefill once (refcounted block reuse), and long prompts
+  prefill in ``--prefill-chunk`` pieces interleaved with decode.
 - ``window``: the drain-a-window batcher (infer/batching.py) — the
   multi-host path, and the fallback when per-step host scheduling is
   unwanted. ``--max-batch 1`` restores strict serialization.
@@ -50,6 +54,8 @@ def serve(
     engine_kind: str = "continuous",
     slots: int = 8,
     kv_buf_len: int = 4096,
+    kv_block_len: int = 256,
+    prefill_chunk: int = 512,
 ) -> None:
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
@@ -105,18 +111,30 @@ def serve(
         coordinator = MultihostCoordinator(generator)
         engine_target = coordinator
         print(f"[serve] coordinating {jax.process_count()} hosts")
-    if engine_kind not in ("continuous", "window"):
+    if engine_kind not in ("continuous", "paged", "window"):
         raise ValueError(
-            f"unknown engine {engine_kind!r} (expected 'continuous' or 'window')"
+            f"unknown engine {engine_kind!r} (expected 'continuous', 'paged' "
+            "or 'window')"
         )
     # The window engine always exists: it is the multi-host path AND the
     # carrier for speculative requests (speculation needs the fused
     # draft+verify while_loop program, which has no slot-step form).
     engine = BatchingEngine(engine_target, max_batch=max_batch, window_ms=batch_window_ms)
     cont_engine = None
-    if engine_kind == "continuous":
+    cont_kind = "window"
+    if engine_kind in ("continuous", "paged"):
         if coordinator is not None:
-            print("[serve] multi-host: continuous engine unavailable, using window")
+            print(f"[serve] multi-host: {engine_kind} engine unavailable, using window")
+        elif engine_kind == "paged":
+            from llm_fine_tune_distributed_tpu.infer.engine import (
+                PagedContinuousBatchingEngine,
+            )
+
+            cont_engine = PagedContinuousBatchingEngine(
+                generator, slots=slots, buf_len=kv_buf_len,
+                block_len=kv_block_len, prefill_chunk=prefill_chunk,
+            )
+            cont_kind = "paged"
         else:
             from llm_fine_tune_distributed_tpu.infer.engine import (
                 ContinuousBatchingEngine,
@@ -125,8 +143,9 @@ def serve(
             cont_engine = ContinuousBatchingEngine(
                 generator, slots=slots, buf_len=kv_buf_len
             )
+            cont_kind = "continuous"
     print(
-        f"Model ready (engine={'continuous' if cont_engine else 'window'}, "
+        f"Model ready (engine={cont_kind}, "
         f"slots={slots}, max_batch={max_batch}, quantize={quantize})."
     )
 
@@ -163,7 +182,7 @@ def serve(
                 # counters (observe/metrics.ServingStats). Window mode
                 # reports the little it tracks (its queue).
                 if cont_engine is not None:
-                    stats = {"engine": "continuous", **cont_engine.stats_snapshot()}
+                    stats = {"engine": cont_kind, **cont_engine.stats_snapshot()}
                 else:
                     stats = {
                         "engine": "window",
@@ -384,10 +403,13 @@ def main(argv: Optional[list] = None) -> int:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument(
-        "--engine", choices=["continuous", "window"], default="continuous",
+        "--engine", choices=["continuous", "paged", "window"],
+        default="continuous",
         help="continuous: slot-based persistent decode loop (mixed traffic "
-             "co-batches, mid-flight admission); window: drain-a-window "
-             "batching (multi-host falls back to this automatically)",
+             "co-batches, mid-flight admission); paged: continuous plus "
+             "block-paged KV with shared-prefix reuse and chunked prefill; "
+             "window: drain-a-window batching (multi-host falls back to "
+             "this automatically)",
     )
     parser.add_argument(
         "--slots", type=int, default=8,
@@ -397,6 +419,15 @@ def main(argv: Optional[list] = None) -> int:
         "--kv-buf-len", type=int, default=4096,
         help="continuous engine: per-slot KV buffer length "
              "(prompt + generated tokens must fit)",
+    )
+    parser.add_argument(
+        "--kv-block-len", type=int, default=256,
+        help="paged engine: tokens per KV block (prefix sharing granularity)",
+    )
+    parser.add_argument(
+        "--prefill-chunk", type=int, default=512,
+        help="paged engine: max prompt tokens prefilled per scheduler tick "
+             "(longer prompts interleave with decode)",
     )
     parser.add_argument(
         "--max-batch", type=int, default=8,
@@ -428,7 +459,8 @@ def main(argv: Optional[list] = None) -> int:
           args.batch_window_ms, args.quantize,
           request_timeout_s=args.request_timeout_s or None, tp=args.tp,
           engine_kind=args.engine, slots=args.slots,
-          kv_buf_len=args.kv_buf_len)
+          kv_buf_len=args.kv_buf_len, kv_block_len=args.kv_block_len,
+          prefill_chunk=args.prefill_chunk)
     return 0
 
 

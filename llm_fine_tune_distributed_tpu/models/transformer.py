@@ -184,6 +184,7 @@ def _block(
     quant_impl: str = "auto",
     rope_flag=None,
     windowed_mask=None,
+    block_tables=None,
 ):
     """One transformer block. Returns (x, new_cache_entry, moe_aux).
 
@@ -192,6 +193,9 @@ def _block(
     layer-scan, where the absolute layer index is data, not Python.
     ``moe_aux`` is the layer's load-balancing loss (f32 scalar; 0.0 for
     dense models — ``config.num_experts == 0``).
+    ``block_tables`` ([batch, nb] int32) switches the cache entry to the
+    PAGED layout: a global block pool instead of per-row buffers (see the
+    cache-write branch below and ``init_paged_cache``).
     """
     b, s, h = x.shape
     d = config.resolved_head_dim
@@ -217,7 +221,34 @@ def _block(
         q, k = apply_rope(q, k, cos, sin)
 
     new_entry = None
-    if cache_entry is not None:
+    if cache_entry is not None and block_tables is not None:
+        # Paged cache: the entry is the GLOBAL pool [num_blocks, L, kv_heads,
+        # d] and the row's block table maps logical position p to pool cell
+        # (table[p // L], p % L). Writes scatter each chunk token at its
+        # logical position through the table; reads gather the table's blocks
+        # back into one [b, nb*L] view whose index IS the logical position —
+        # so the caller's position mask applies to the view unchanged, and a
+        # row's decode cost tracks the blocks its table exposes (nb), not a
+        # global buffer ceiling. Unused table entries hold the null block
+        # (id 0): their view positions sit above every live query, hence
+        # always masked; dead rows get an all-null table from the engine so
+        # their (frozen-position) writes land in null-block garbage instead
+        # of a block since reassigned to a live row.
+        L = cache_entry["k"].shape[1]
+        nb = block_tables.shape[1]
+        offset = (
+            cache_pos[:, None] if getattr(cache_pos, "ndim", 0) == 1 else cache_pos
+        )
+        pos = jnp.broadcast_to(offset + jnp.arange(s)[None, :], (b, s))
+        blk = jnp.take_along_axis(block_tables, jnp.clip(pos // L, 0, nb - 1), axis=1)
+        off = pos % L
+        ck = cache_entry["k"].at[blk, off].set(k.astype(cache_entry["k"].dtype))
+        cv = cache_entry["v"].at[blk, off].set(v.astype(cache_entry["v"].dtype))
+        new_entry = {"k": ck, "v": cv}
+        flat = block_tables.reshape(-1)
+        k = ck[flat].reshape(b, nb * L, ck.shape[2], ck.shape[3])
+        v = cv[flat].reshape(b, nb * L, cv.shape[2], cv.shape[3])
+    elif cache_entry is not None:
         # Decode/prefill with a fixed-size KV buffer: write k,v at cache_pos.
         # A scalar cache_pos writes the same slots for every row (single
         # prompt / aligned batch); a [batch] vector writes per-row slots —
@@ -340,6 +371,7 @@ def forward(
     segment_ids=None,
     cache: Optional[Dict[str, Any]] = None,
     cache_pos: int | jax.Array = 0,
+    block_tables=None,
     attention_impl: str = "xla",
     compute_dtype=jnp.bfloat16,
     remat: bool = False,
@@ -365,6 +397,11 @@ def forward(
       cache_pos: where this chunk starts in the cache — a scalar (all rows
         aligned) or a [batch] vector for per-row starts (ragged batched
         decode: row i's slots stay equal to its logical positions).
+      block_tables: optional [batch, nb] int32 — switches ``cache`` to the
+        PAGED layout (``init_paged_cache``): one global block pool shared by
+        all rows, each row's table mapping logical position p to pool cell
+        (table[p // block_len], p % block_len). The attention view per row is
+        the gathered nb*block_len positions its table exposes.
       remat: rematerialize each block on backward
         (analog of reference ``gradient_checkpointing=True``, training.py:280).
       output_hidden: return the final-norm hidden states [batch, seq, hidden]
@@ -454,7 +491,12 @@ def forward(
     elif cache is not None:
         # Mask over the fixed-size buffer: key j visible to query i iff
         # j <= position(i), and within the sliding window if configured.
-        kv_len = cache["layers"]["0"]["k"].shape[1]
+        # Paged caches mask the gathered [nb * block_len] view — gathered
+        # index IS logical position, so the same rule applies verbatim.
+        if block_tables is not None:
+            kv_len = block_tables.shape[1] * cache["layers"]["0"]["k"].shape[1]
+        else:
+            kv_len = cache["layers"]["0"]["k"].shape[1]
         k_pos = jnp.arange(kv_len, dtype=jnp.int32)[None, None, :]
         q_pos = positions[:, :, None]
         explicit_mask = k_pos <= q_pos
@@ -485,6 +527,7 @@ def forward(
             mesh=mesh,
             quant_impl=quant_impl,
             windowed_mask=windowed_mask,
+            block_tables=block_tables,
         )
         if remat and cache is None:
             if remat_policy in (None, "full"):
@@ -604,6 +647,23 @@ def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfl
     """Fixed-size KV cache buffers for autoregressive decoding."""
     d = config.resolved_head_dim
     shape = (batch_size, max_len, config.num_kv_heads, d)
+    return {
+        "layers": {
+            str(i): {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for i in range(config.num_layers)
+        }
+    }
+
+
+def init_paged_cache(config: ModelConfig, num_blocks: int, block_len: int, dtype=jnp.bfloat16):
+    """Global paged KV pool for the block-paged continuous engine: per layer
+    one [num_blocks, block_len, kv_heads, head_dim] buffer shared by every
+    decode slot, addressed through per-slot block tables (``forward``'s
+    ``block_tables``). Block 0 is the NULL block (infer/paged.py): never
+    allocated, mapped into unused table entries and dead rows so stray writes
+    and gathers hit garbage that the position mask always hides."""
+    d = config.resolved_head_dim
+    shape = (num_blocks, block_len, config.num_kv_heads, d)
     return {
         "layers": {
             str(i): {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

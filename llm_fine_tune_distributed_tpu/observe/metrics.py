@@ -34,22 +34,34 @@ class ServingStats:
     ints per token batch), so contention is irrelevant next to a decode step.
 
     Counters (monotonic): ``tokens_served``, ``requests_admitted``,
-    ``requests_completed``, ``requests_abandoned``, ``decode_steps``.
-    Gauges (instantaneous): ``queue_depth``, ``live_slots``; ``slots`` is the
-    engine's capacity, and the snapshot derives ``slot_occupancy`` =
-    live_slots / slots — the "is the decode batch actually full?" number that
-    continuous batching exists to maximize.
+    ``requests_completed``, ``requests_abandoned``, ``decode_steps``; the
+    paged engine adds ``prompt_tokens`` (prompt tokens admitted),
+    ``prefix_tokens_reused`` (of those, served from the prefix cache
+    without a forward pass) and ``prefill_chunks``.
+    Gauges (instantaneous): ``queue_depth``, ``live_slots``, plus paged
+    ``blocks_in_use`` / ``peak_blocks_in_use`` / ``prefix_cache_blocks``.
+    ``slots`` is the engine's capacity and ``total_blocks`` the usable pool
+    size; the snapshot derives ``slot_occupancy`` = live_slots / slots —
+    the "is the decode batch actually full?" number continuous batching
+    exists to maximize — and, when a pool exists, ``block_pool_occupancy``,
+    ``peak_block_pool_occupancy`` and ``prefix_hit_rate`` =
+    prefix_tokens_reused / prompt_tokens.
     """
 
     COUNTERS = (
         "tokens_served", "requests_admitted", "requests_completed",
         "requests_abandoned", "decode_steps",
+        "prompt_tokens", "prefix_tokens_reused", "prefill_chunks",
     )
-    GAUGES = ("queue_depth", "live_slots")
+    GAUGES = (
+        "queue_depth", "live_slots",
+        "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
+    )
 
-    def __init__(self, slots: int = 0):
+    def __init__(self, slots: int = 0, total_blocks: int = 0):
         self._lock = threading.Lock()
         self.slots = int(slots)
+        self.total_blocks = int(total_blocks)
         self._values: Dict[str, int] = {
             k: 0 for k in self.COUNTERS + self.GAUGES
         }
@@ -62,12 +74,28 @@ class ServingStats:
         with self._lock:
             self._values[name] = int(value)
 
+    def gauge_max(self, name: str, value: int) -> None:
+        """Ratcheting gauge: keep the high-water mark (peak pool pressure)."""
+        with self._lock:
+            self._values[name] = max(self._values[name], int(value))
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out: Dict[str, float] = dict(self._values)
         out["slots"] = self.slots
         out["slot_occupancy"] = (
             out["live_slots"] / self.slots if self.slots else 0.0
+        )
+        if self.total_blocks:
+            out["total_blocks"] = self.total_blocks
+            out["block_pool_occupancy"] = out["blocks_in_use"] / self.total_blocks
+            out["peak_block_pool_occupancy"] = (
+                out["peak_blocks_in_use"] / self.total_blocks
+            )
+        out["prefix_hit_rate"] = (
+            out["prefix_tokens_reused"] / out["prompt_tokens"]
+            if out["prompt_tokens"]
+            else 0.0
         )
         return out
 

@@ -299,7 +299,15 @@ class SFTTrainer:
             masked = build_sft_arrays(
                 val_rows, self.tokenizer, cfg.max_seq_length, True, **prompt_kw
             )
-        assert masked["input_ids"].shape == self.val_arrays["input_ids"].shape
+        if masked["input_ids"].shape != self.val_arrays["input_ids"].shape:
+            # explicit (not assert): the layout invariant guards eval-metric
+            # correctness and must survive `python -O`
+            raise ValueError(
+                "completion-mask build produced a different layout than the "
+                f"validation arrays (mask {masked['input_ids'].shape} vs val "
+                f"{self.val_arrays['input_ids'].shape}) — the mask pass must "
+                "tokenize/pack identically to the eval pass"
+            )
         self.val_arrays["completion_mask"] = masked["loss_mask"]
         if masked["loss_mask"].sum() == 0 and is_primary_host():
             # This is a DATA bug worth shouting about: with the byte-level
@@ -808,6 +816,24 @@ class SFTTrainer:
                 f"({cfg.eval_steps}) so every saved checkpoint carries a "
                 "fresh metric — align the cadences or use "
                 "best_model_tracking='per_eval'"
+            )
+        if (
+            mode == "checkpoint"
+            and cfg.load_best_model_at_end
+            and cfg.save_steps
+            and cfg.save_steps > self.total_steps
+            and is_primary_host()
+        ):
+            # no mid-run checkpoint ever happens, so the only candidate for
+            # "best" is the end-of-train save: selection silently degrades to
+            # final weights. Legal, but say so up front.
+            print(
+                f"WARNING: best_model_tracking='checkpoint' with save_steps="
+                f"{cfg.save_steps} > total_steps={self.total_steps}: only the "
+                "end-of-training checkpoint will exist, so "
+                "load_best_model_at_end degrades to final-weights-only — "
+                "lower save_steps (or use best_model_tracking='per_eval') to "
+                "track a real best"
             )
         return mode
 

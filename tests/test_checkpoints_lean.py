@@ -280,3 +280,122 @@ def test_checkpoint_mode_rejects_unaligned_save_eval_cadence(qa_parquet, tmp_pat
     trainer = SFTTrainer(cfg)
     with pytest.raises(ValueError, match="multiple of eval_steps"):
         trainer.train()
+
+
+def test_fingerprint_rejects_permuted_base_weights():
+    """Order-insensitive sums were blind to a permuted/transposed base
+    checkpoint (r5 advisor): same elements, same |x| and x^2 sums, but
+    shuffled weights. The position-weighted component must catch it."""
+    import jax.numpy as jnp
+
+    from llm_fine_tune_distributed_tpu.train.checkpoints import (
+        FingerprintMismatch,
+        frozen_fingerprint,
+        verify_fingerprint,
+    )
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 16)).astype(np.float32)
+    good = {"w": jnp.asarray(w)}
+    saved = frozen_fingerprint(good)
+
+    # identical weights pass
+    verify_fingerprint(saved, frozen_fingerprint({"w": jnp.asarray(w.copy())}))
+
+    # reversed element order: every order-insensitive sum is EXACTLY equal
+    reversed_fp = frozen_fingerprint({"w": jnp.asarray(w[::-1, ::-1].copy())})
+    np.testing.assert_allclose(saved["w"][:2], reversed_fp["w"][:2], rtol=1e-6)
+    with pytest.raises(FingerprintMismatch, match="does not match"):
+        verify_fingerprint(saved, reversed_fp)
+
+    # transposed layout (same shape via reshape) fails too
+    transposed = {"w": jnp.asarray(np.ascontiguousarray(w.T).reshape(w.shape))}
+    with pytest.raises(FingerprintMismatch, match="does not match"):
+        verify_fingerprint(saved, frozen_fingerprint(transposed))
+
+
+def test_fingerprint_tolerance_scales_with_leaf_count():
+    """Cross-platform reduction-order drift grows ~sqrt(n)*eps: a relative
+    drift that is legitimate noise on a 100M-element leaf must pass, while
+    the SAME relative drift on a tiny leaf (where it can only mean changed
+    weights) must fail."""
+    from llm_fine_tune_distributed_tpu.train.checkpoints import (
+        FingerprintMismatch,
+        verify_fingerprint,
+    )
+
+    drift = 1 + 3e-4
+    big_n = 1e8  # rtol = 2e-7 * sqrt(1e8) = 2e-3 > drift
+    saved_big = {"w": np.array([5.0e7, 1.0e8, 2.5e7, big_n], np.float32)}
+    drifted_big = {
+        "w": np.array(
+            [5.0e7 * drift, 1.0e8 * drift, 2.5e7 * drift, big_n], np.float32
+        )
+    }
+    verify_fingerprint(saved_big, drifted_big)  # no raise
+
+    small_n = 100.0  # rtol floor 1e-4 < drift
+    saved_small = {"w": np.array([50.0, 100.0, 25.0, small_n], np.float32)}
+    drifted_small = {
+        "w": np.array(
+            [50.0 * drift, 100.0 * drift, 25.0 * drift, small_n], np.float32
+        )
+    }
+    with pytest.raises(FingerprintMismatch, match="does not match"):
+        verify_fingerprint(saved_small, drifted_small)
+
+    # changed element COUNT is exact, never tolerance-absorbed
+    with pytest.raises(FingerprintMismatch, match="changed size"):
+        verify_fingerprint(
+            saved_small,
+            {"w": np.array([50.0, 100.0, 25.0, 101.0], np.float32)},
+        )
+
+
+def test_sync_save_and_restore_join_pending_background_snapshot(tmp_path):
+    """A sync save (or restore) issued while a background snapshot is still
+    serializing must JOIN it first — two concurrent ocp.CheckpointManager.save
+    calls on one manager race (r5 advisor). Pinned with a slow fake snapshot
+    thread: the manager operation must not start until it finishes."""
+    import threading
+    import time
+
+    import jax.numpy as jnp
+
+    from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+    from llm_fine_tune_distributed_tpu.train.state import TrainState
+
+    state = TrainState(
+        step=jnp.int32(1),
+        trainable={"w": jnp.ones((4,), jnp.float32)},
+        frozen={"f": jnp.zeros((4,), jnp.float32)},
+        opt_state={"m": jnp.zeros((4,), jnp.float32)},
+    )
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2, metric_name="")
+
+    finished = threading.Event()
+
+    def slow_snapshot():
+        time.sleep(0.5)
+        finished.set()
+
+    for op in ("save", "restore"):
+        t = threading.Thread(target=slow_snapshot)
+        mgr._snapshot_thread = t
+        t.start()
+        if op == "save":
+            mgr.save(1, state)
+        else:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+            )
+            mgr.restore(1, abstract)
+        assert finished.is_set(), f"{op}() ran without joining the snapshot"
+        assert mgr._snapshot_thread is None
+        finished.clear()
+
+    # a pending background ERROR surfaces on the next save, not silently
+    mgr._snapshot_error = RuntimeError("disk full")
+    with pytest.raises(RuntimeError, match="disk full"):
+        mgr.save(2, state)
+    mgr.close()

@@ -1,0 +1,261 @@
+"""Block-paged KV engine (infer/engine.py PagedContinuousBatchingEngine +
+infer/paged.py): allocator/refcount mechanics, paged-vs-solo greedy
+bit-parity under live sampled neighbors, shared-prefix reuse, chunked
+prefill equivalence, and block-pool admission control. Same contracts as
+the dense engine (tests/test_engine.py) — only the KV layout changed."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
+from llm_fine_tune_distributed_tpu.infer.engine import PagedContinuousBatchingEngine
+from llm_fine_tune_distributed_tpu.infer.paged import (
+    NULL_BLOCK,
+    BlockAllocator,
+    PrefixCache,
+)
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+
+GREEDY = GenerationConfig(max_new_tokens=6, do_sample=False)
+SAMPLED = GenerationConfig(max_new_tokens=6, do_sample=True, temperature=1.0)
+
+
+@pytest.fixture(scope="module")
+def generator():
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    return Generator(
+        params, mc, ByteChatMLTokenizer(), compute_dtype=jnp.float32, eos_token_ids=[]
+    )
+
+
+@pytest.fixture()
+def engine(generator):
+    return PagedContinuousBatchingEngine(
+        generator, slots=4, buf_len=96, prompt_bucket=16,
+        block_len=16, prefill_chunk=32,
+    )
+
+
+def _prompts():
+    tok = ByteChatMLTokenizer()
+    return [tok.encode(t) for t in ("alpha", "beta bravo", "the quick brown fox")]
+
+
+# ------------------------------------------------------------- allocator unit
+
+
+def test_allocator_alloc_free_refcount():
+    a = BlockAllocator(8)  # 1 null + 7 usable
+    assert a.free_count == 7 and a.used_count == 0
+    blocks = a.alloc(3)
+    assert len(blocks) == 3 and NULL_BLOCK not in blocks
+    assert a.used_count == 3
+    assert all(a.refcount(b) == 1 for b in blocks)
+    a.ref(blocks[0])
+    assert a.refcount(blocks[0]) == 2
+    a.free(blocks[0])
+    assert a.refcount(blocks[0]) == 1 and a.used_count == 3  # still held
+    for b in blocks:
+        a.free(b)
+    assert a.used_count == 0 and a.free_count == 7
+    # all-or-nothing: asking for more than free leaves the pool untouched
+    assert a.alloc(8) is None
+    assert a.free_count == 7
+
+
+def test_allocator_guards_null_and_unallocated():
+    a = BlockAllocator(4)
+    with pytest.raises(ValueError):
+        a.ref(NULL_BLOCK)
+    with pytest.raises(ValueError):
+        a.free(NULL_BLOCK)
+    with pytest.raises(ValueError):
+        a.ref(2)  # never allocated
+    with pytest.raises(ValueError):
+        BlockAllocator(1)  # no usable block
+
+
+def test_prefix_cache_match_insert_evict_refcounts():
+    a = BlockAllocator(8)
+    cache = PrefixCache(a, block_len=4)
+    prompt = list(range(11))  # 2 full blocks + a 3-token tail
+    keys = cache.block_keys(prompt)
+    assert len(keys) == 2
+    blocks = a.alloc(2)
+    cache.insert(keys, blocks)  # cache takes its own refs
+    assert all(a.refcount(b) == 2 for b in blocks)
+    # a prompt agreeing on block 0 but not block 1 matches exactly one block
+    other = prompt[:4] + [99] * 7
+    hit = cache.match(cache.block_keys(other), limit=2)
+    assert hit == blocks[:1]
+    assert a.refcount(blocks[0]) == 3  # caller now holds one too
+    a.free(blocks[0])
+    # limit caps the run even on a full match
+    assert cache.match(keys, limit=1) == blocks[:1]
+    a.free(blocks[0])
+    # owner retires: blocks survive on the cache's refs alone
+    for b in blocks:
+        a.free(b)
+    assert a.used_count == 2
+    # eviction drops LRU entries until enough blocks are free
+    dropped = cache.evict(want_free=a.free_count + 2)
+    assert dropped == 2 and a.used_count == 0 and len(cache) == 0
+
+
+# ----------------------------------------------------------- decode contracts
+
+
+def test_paged_greedy_bit_identical_to_solo_with_live_neighbors(generator, engine):
+    """The headline guarantee carried over from the dense engine: a greedy
+    request decoding against the BLOCK POOL, with live sampled neighbors
+    mutating that same pool, produces exactly solo generate_ids' tokens."""
+    prompts = _prompts()
+    solo = [generator.generate_ids(p, GREEDY) for p in prompts]
+
+    long_cfg = GenerationConfig(max_new_tokens=48, do_sample=True, temperature=1.0)
+    results = [None] * len(prompts)
+
+    def occupy():
+        engine.submit(prompts[0], long_cfg, seed=11, timeout=240)
+
+    def run(i):
+        results[i] = engine.submit(prompts[i], GREEDY, seed=0, timeout=240)
+
+    occ = threading.Thread(target=occupy)
+    occ.start()
+    workers = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    occ.join()
+    for i, r in enumerate(results):
+        assert r == solo[i], f"prompt {i}: {r} != solo {solo[i]}"
+
+
+def test_paged_sampled_deterministic_in_request_seed(generator):
+    """Sampled output depends only on (request, seed) — not on slot index,
+    co-residents, or block placement (fresh engine per run so the second
+    submission lands in different blocks via the prefix cache)."""
+    prompt = _prompts()[2]
+    runs = []
+    for _ in range(2):
+        eng = PagedContinuousBatchingEngine(
+            generator, slots=4, buf_len=96, prompt_bucket=16,
+            block_len=16, prefill_chunk=32,
+        )
+        runs.append(eng.submit(prompt, SAMPLED, seed=7, timeout=240))
+    assert runs[0] == runs[1]
+
+
+def test_prefix_cache_reuses_shared_prompt_blocks(generator, engine):
+    """Second request with the same long prompt prefills only the suffix:
+    the leading full blocks come from the prefix cache, output unchanged."""
+    tok = ByteChatMLTokenizer()
+    prompt = tok.encode("wilderness system prompt " * 3)  # > 2 full blocks
+    solo = generator.generate_ids(prompt, GREEDY)
+
+    first = engine.submit(prompt, GREEDY, timeout=240)
+    before = engine.stats_snapshot()
+    second = engine.submit(prompt, GREEDY, timeout=240)
+    after = engine.stats_snapshot()
+
+    assert first == solo and second == solo
+    full_blocks = len(prompt) // 16
+    assert full_blocks >= 2
+    reused = after["prefix_tokens_reused"] - before["prefix_tokens_reused"]
+    # every full block reuses, except the last when the prompt length is an
+    # exact block multiple (>= 1 suffix token must prefill for the logits)
+    assert reused >= (full_blocks - 1) * 16 and reused > 0
+    assert after["prefix_hit_rate"] > 0
+    assert after["prefix_cache_blocks"] >= full_blocks - 1
+
+
+def test_chunked_prefill_matches_solo(generator, engine):
+    """A prompt longer than prefill_chunk ingests in several bounded chunks
+    (interleaved with neighbors' decode) yet yields solo's exact tokens."""
+    tok = ByteChatMLTokenizer()
+    prompt = tok.encode(
+        "a long prompt spanning several bounded prefill chunks for decode steps"
+    )
+    # 3 chunks at prefill_chunk=32, with room for max_new_tokens in buf_len=96
+    assert 2 * 32 < len(prompt) <= 96 - GREEDY.max_new_tokens
+    solo = generator.generate_ids(prompt, GREEDY)
+
+    results = [None, None]
+
+    def neighbor():
+        results[1] = engine.submit(
+            _prompts()[0],
+            GenerationConfig(max_new_tokens=24, do_sample=True, temperature=1.0),
+            seed=3, timeout=240,
+        )
+
+    t = threading.Thread(target=neighbor)
+    t.start()
+    results[0] = engine.submit(prompt, GREEDY, timeout=240)
+    t.join()
+    assert results[0] == solo
+    snap = engine.stats_snapshot()
+    assert snap["prefill_chunks"] >= 3
+
+
+# ----------------------------------------------------------- admission control
+
+
+def test_pool_oom_request_rejected_when_it_can_never_fit(generator):
+    """A request whose block need exceeds the whole pool errors immediately
+    (waiting would deadlock the FIFO head forever)."""
+    eng = PagedContinuousBatchingEngine(
+        generator, slots=2, buf_len=96, prompt_bucket=16,
+        block_len=16, prefill_chunk=32, num_blocks=3,  # 2 usable blocks
+    )
+    big = GenerationConfig(max_new_tokens=80, do_sample=False)  # needs 6 blocks
+    with pytest.raises(ValueError, match="blocks"):
+        eng.submit(_prompts()[0], big, timeout=240)
+    # pool untouched after the rejection
+    assert eng._allocator.used_count == 0
+
+
+def test_pool_contention_head_waits_then_completes(generator):
+    """When blocks run out the FIFO head WAITS (nothing overtakes it) and
+    admits once the running request retires — both outputs exact."""
+    tok = ByteChatMLTokenizer()
+    prompt = tok.encode("the quick brown fox")  # ~2 blocks at L=16
+    cfg = GenerationConfig(max_new_tokens=10, do_sample=False)
+    eng = PagedContinuousBatchingEngine(
+        generator, slots=2, buf_len=96, prompt_bucket=16,
+        block_len=16, prefill_chunk=64, num_blocks=4,  # 3 usable: one req's worth
+    )
+    solo = eng._generator.generate_ids(prompt, cfg)
+    results = [None, None]
+
+    def run(i):
+        results[i] = eng.submit(prompt, cfg, timeout=240)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results[0] == solo and results[1] == solo
+
+
+def test_release_returns_blocks_and_stats_report_pool(generator, engine):
+    """After traffic drains, the only blocks still held belong to the prefix
+    cache; the stats snapshot carries the pool gauges the bench emits."""
+    for p in _prompts():
+        engine.submit(p, GREEDY, timeout=240)
+    snap = engine.stats_snapshot()
+    assert snap["total_blocks"] == engine._allocator.num_blocks - 1
+    assert snap["blocks_in_use"] == snap["prefix_cache_blocks"]
+    assert 0 <= snap["block_pool_occupancy"] <= 1
+    assert snap["peak_blocks_in_use"] >= snap["blocks_in_use"]
+    assert snap["requests_completed"] == 3

@@ -223,3 +223,37 @@ def test_answer_only_eval_metric_and_eval_batch_size(qa_parquet, tmp_path):
     tr2, _ = one_eval(tmp_path / "d", system_prompt=None)
     assert tr2.val_arrays["completion_mask"].sum() == 0
     assert tr2._last_eval_answer is None
+
+
+def test_checkpoint_best_mode_warns_when_no_midrun_save_possible(
+    qa_parquet, tmp_path, capsys
+):
+    """save_steps beyond total_steps in checkpoint-mode best tracking means
+    only the end-of-train save ever exists: load_best_model_at_end silently
+    degrades to final-weights-only. The trainer must say so up front."""
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    data_dir, dataset_file = qa_parquet
+    cfg = make_config(
+        tmp_path, data_dir, dataset_file, epochs=1, save_steps=500,
+        use_native_loader=False, best_model_tracking="checkpoint",
+        load_best_model_at_end=True,
+    )
+    trainer = SFTTrainer(cfg)
+    assert cfg.save_steps > trainer.total_steps  # the degenerate shape
+    capsys.readouterr()
+    assert trainer._resolve_best_mode() == "checkpoint"
+    out = capsys.readouterr().out
+    assert "final-weights-only" in out
+
+    # aligned cadence below total_steps: no warning
+    cfg2 = make_config(
+        tmp_path / "ok", data_dir, dataset_file, epochs=1, save_steps=5,
+        eval_steps=5, use_native_loader=False,
+        best_model_tracking="checkpoint", load_best_model_at_end=True,
+    )
+    trainer2 = SFTTrainer(cfg2)
+    assert cfg2.save_steps <= trainer2.total_steps
+    capsys.readouterr()
+    trainer2._resolve_best_mode()
+    assert "final-weights-only" not in capsys.readouterr().out
