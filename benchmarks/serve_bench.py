@@ -27,7 +27,9 @@ perf_ledger-style ("metric" key).
 Usage: python benchmarks/serve_bench.py   (CPU ok: defaults to the tiny
 preset off-accelerator). Env: SERVE_PRESET, SERVE_CLIENTS=1,8,32,
 SERVE_REQS_PER_CLIENT (default 4), SERVE_SLOTS (default 8),
-SERVE_ENGINES=continuous,paged,window.
+SERVE_ENGINES=continuous,paged,window, SERVE_CHAOS=1 (chaos arm: inject one
+retryable decode failure mid-workload and report recovery wall time plus
+TTFT after recovery; SERVE_CHAOS_CLIENTS=8).
 """
 
 import json
@@ -104,6 +106,95 @@ def _run_config(engine, clients, reqs_per_client, workload):
         t.join()
     dt = time.perf_counter() - t0
     return sum(served), dt, errors
+
+
+def _chaos_sweep(make_engine, workload, clients, reqs_per_client, base_line):
+    """Inject ONE retryable decode failure mid-workload and report how long
+    the supervised engine takes to come back: recovery wall time (fault
+    armed -> engine_restarts counter ticks) and time-to-first-token of the
+    first request issued AFTER recovery. Clients see 503s for the in-flight
+    casualties (counted below), never hangs."""
+    for kind in ("continuous", "paged"):
+        engine = make_engine(kind)
+        _run_config(engine, 1, 2, workload)  # warm jit caches
+
+        served = [0]
+        errors = []
+
+        def client(ci):
+            for ri in range(reqs_per_client):
+                prompt, gen, seed = workload[
+                    (ci * reqs_per_client + ri) % len(workload)
+                ]
+                try:
+                    toks = engine.submit(prompt, gen, seed=seed, timeout=600)
+                    served[0] += len(toks)
+                except Exception as e:
+                    errors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        # let the decode loop reach steady state, then pull the rug once
+        time.sleep(0.2)
+        engine.faults.fail_decode_next(1)
+        t_fault = time.perf_counter()
+        recovery_s = None
+        while any(t.is_alive() for t in threads):
+            if engine.stats_snapshot()["engine_restarts"] >= 1:
+                recovery_s = time.perf_counter() - t_fault
+                break
+            time.sleep(0.005)
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+
+        # TTFT of a fresh stream against the recovered engine: the number an
+        # operator actually feels after an in-process restart. If the
+        # workload drained before the armed fault fired, the first probe
+        # consumes it — retry until one survives post-recovery.
+        prompt, _, seed = workload[0]
+        from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+
+        ttft_after = None
+        for _ in range(4):
+            t1 = time.perf_counter()
+            try:
+                it = engine.stream(
+                    prompt, GenerationConfig(max_new_tokens=4, do_sample=False),
+                    seed=seed, timeout=600,
+                )
+                next(it)
+                ttft_after = time.perf_counter() - t1
+                for _ in it:
+                    pass
+                break
+            except Exception:
+                continue
+        if recovery_s is None and (
+            engine.stats_snapshot()["engine_restarts"] >= 1
+        ):
+            recovery_s = time.perf_counter() - t_fault
+
+        snap = engine.stats_snapshot()
+        print(json.dumps({
+            "metric": f"serve_chaos_recovery_s_{kind}",
+            "value": round(recovery_s, 4) if recovery_s is not None else None,
+            "unit": "seconds fault->restart",
+            "engine": kind,
+            "ttft_after_recovery_s": (
+                round(ttft_after, 4) if ttft_after is not None else None
+            ),
+            "tokens_served": served[0],
+            "wall_seconds": round(dt, 2),
+            "requests_failed": snap["requests_failed"],
+            "engine_restarts": snap["engine_restarts"],
+            "errors_seen_by_clients": len(errors),
+            **base_line,
+        }), flush=True)
 
 
 def main():
@@ -219,6 +310,20 @@ def main():
                 "unit": "x over dense continuous engine (prefix-heavy)",
                 "clients": clients,
             }), flush=True)
+
+    # chaos arm: one injected decode failure mid-workload; reports recovery
+    # wall time and post-recovery TTFT per supervised engine
+    if os.environ.get("SERVE_CHAOS", "1") == "1":
+        chaos_clients = int(os.environ.get("SERVE_CHAOS_CLIENTS", "8"))
+        _chaos_sweep(
+            make_engine, workload, chaos_clients, reqs_per_client,
+            {
+                "model": preset,
+                "platform": jax.devices()[0].platform,
+                "slots": slots,
+                "clients": chaos_clients,
+            },
+        )
 
 
 if __name__ == "__main__":

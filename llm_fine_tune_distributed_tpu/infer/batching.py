@@ -65,6 +65,11 @@ class Request:
     # here as it is emitted (None terminates the stream) — per-request SSE
     # streaming while the request rides a shared decode batch
     tokens_q: Optional["queue.Queue"] = None
+    # admission bookkeeping (infer/engine.py): when the request entered the
+    # queue (monotonic; feeds the service-time EWMA behind Retry-After
+    # hints) and the absolute deadline past which it is shed un-prefilled
+    enqueued_at: float = 0.0
+    queue_deadline: Optional[float] = None
 
 
 # historical name, kept for callers/tests that referenced the private type
@@ -89,6 +94,12 @@ class BatchingEngine:
         # incompatible requests parked by the worker between cycles; worker-
         # thread-only state (no lock needed)
         self._deferred: List[_Pending] = []
+        # graceful-drain support (engine-parity with infer/engine.py): a
+        # pending ledger so SIGTERM can wait for in-flight work, plus an
+        # admission flag that fails new submits fast during drain
+        self._draining = False
+        self._pending = 0
+        self._plock = threading.Lock()
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -117,7 +128,17 @@ class BatchingEngine:
     ) -> _Pending:
         """``submit`` returning the whole request record (result + the
         speculative-decoding telemetry the server reports)."""
+        if self._draining:
+            from llm_fine_tune_distributed_tpu.infer.errors import DrainingError
+
+            raise DrainingError(
+                "engine draining; admission closed — retry against another "
+                "replica",
+                retry_after_s=5.0,
+            )
         p = _Pending(list(prompt_ids), gen, seed)
+        with self._plock:
+            self._pending += 1
         self._q.put(p)
         if not p.done.wait(timeout):
             p.abandoned = True
@@ -128,6 +149,32 @@ class BatchingEngine:
         if p.error is not None:
             raise p.error
         return p
+
+    def begin_drain(self) -> None:
+        """Close admission; queued and in-flight batches run to completion."""
+        self._draining = True
+
+    def wait_drained(self, timeout_s: float, poll_s: float = 0.05) -> bool:
+        """Block until every submitted request has resolved (True) or the
+        timeout expires with work still pending (False)."""
+        import time
+
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while True:
+            with self._plock:
+                pending = self._pending
+            if pending <= 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def _settle(self, p: _Pending) -> None:
+        """The one place a request leaves the pending ledger and wakes its
+        waiter (exactly one settle per submit)."""
+        with self._plock:
+            self._pending -= 1
+        p.done.set()
 
     # ---------------------------------------------------------------- worker
 
@@ -149,7 +196,7 @@ class BatchingEngine:
                 p = self._deferred.pop(0) if self._deferred else self._q.get()
                 if not p.abandoned:
                     return p
-                p.done.set()
+                self._settle(p)
 
         while True:
             first = next_live()
@@ -158,7 +205,7 @@ class BatchingEngine:
             still_deferred: List[_Pending] = []
             for p in self._deferred:
                 if p.abandoned:
-                    p.done.set()
+                    self._settle(p)
                 elif len(batch) < self._max_batch and self._compatible(first, p):
                     batch.append(p)
                 else:
@@ -174,7 +221,7 @@ class BatchingEngine:
                 except queue.Empty:
                     break
                 if nxt.abandoned:
-                    nxt.done.set()
+                    self._settle(nxt)
                 elif self._compatible(first, nxt):
                     batch.append(nxt)
                 else:
@@ -187,7 +234,7 @@ class BatchingEngine:
             live = [p for p in batch if not p.abandoned]
             for p in batch:
                 if p.abandoned:
-                    p.done.set()
+                    self._settle(p)
             if not live:
                 continue
             batch = live
@@ -213,4 +260,4 @@ class BatchingEngine:
                     p.error = e
             finally:
                 for p in batch:
-                    p.done.set()
+                    self._settle(p)

@@ -1,5 +1,5 @@
 """Continuous-batching serving engine: slot-based persistent decode loop
-with in-flight admission.
+with in-flight admission, supervised for in-process crash recovery.
 
 The window batcher (infer/batching.py) drains a 10 ms window, pads the
 group, and runs the WHOLE batch to completion — so every request waits for
@@ -32,6 +32,36 @@ Abandonment carries over from the window engine: a timed-out ``submit``
 marks its request abandoned; abandoned requests are dropped at admission
 (never decoded) and shed mid-flight (their slot frees at the next step).
 
+**Self-healing (infer/supervisor.py + infer/errors.py).** A worker-loop
+exception no longer kills the engine for good. The worker runs under a
+supervision loop: a failed tick is classified retryable vs fatal
+(errors.is_retryable_failure); on retryable the worker fails every
+IN-FLIGHT request fast with a RetryableEngineError (their KV state is
+lost), sleeps an exponentially backed-off delay, rebuilds the device state
+from the still-resident params (the jit caches survive on the Generator,
+so a restart costs milliseconds — no recompilation, no HBM reload), bumps
+the supervisor's generation counter, and resumes; QUEUED not-yet-prefilled
+requests survive untouched and admit into the new generation. N retryable
+failures inside a sliding window open the circuit breaker: the worker
+stops restarting, resolves everything with CircuitOpenError, and
+``healthy`` goes False so ``/healthz`` asks the orchestrator for a pod
+recycle. The recovery invariant is decode-exactness: a post-recovery
+greedy request is bit-identical to solo ``generate_ids``
+(tests/test_supervisor.py).
+
+**Admission control.** ``max_queue_depth`` bounds the FIFO: overflow is
+shed AT SUBMIT with QueueOverflowError (HTTP 429) carrying a finite
+Retry-After derived from an EWMA of observed request service time.
+``queue_deadline_s`` sheds requests that waited too long BEFORE prefill
+(QueueDeadlineError) — decoding for a client that has likely given up
+starves live traffic. ``begin_drain()`` closes admission (DrainingError)
+while queued + in-flight work runs to completion; ``wait_drained`` is the
+SIGTERM path's barrier (infer/server.py).
+
+Every submitted request resolves — result or error — under every failure
+mode: that no-hung-waiter guarantee is what the per-request ``_settle``
+bookkeeping exists to enforce.
+
 Throughput shape: per emitted token the engine pays one host sync of
 ``[S]`` ints plus one dispatch — per-step overhead the window engine's
 fused ``while_loop`` avoids — but under concurrency it serves up to S
@@ -43,12 +73,23 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from llm_fine_tune_distributed_tpu.infer.batching import Request
+from llm_fine_tune_distributed_tpu.infer.errors import (
+    CircuitOpenError,
+    DrainingError,
+    FatalEngineError,
+    QueueDeadlineError,
+    QueueOverflowError,
+    RetryableEngineError,
+    ServingError,
+    is_retryable_failure,
+)
 from llm_fine_tune_distributed_tpu.infer.paged import (
     NULL_BLOCK,
     BlockAllocator,
@@ -58,7 +99,12 @@ from llm_fine_tune_distributed_tpu.infer.sampling import (
     GenerationConfig,
     generation_config_arrays,
 )
+from llm_fine_tune_distributed_tpu.infer.supervisor import (
+    EngineSupervisor,
+    FaultInjector,
+)
 from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+from llm_fine_tune_distributed_tpu.runtime.watchdog import StepWatchdog
 
 
 class ContinuousBatchingEngine:
@@ -71,6 +117,15 @@ class ContinuousBatchingEngine:
         buf_len: int = 4096,
         prompt_bucket: int = 64,
         stats: Optional[ServingStats] = None,
+        max_queue_depth: int = 0,
+        queue_deadline_s: Optional[float] = None,
+        restart_backoff_s: float = 0.5,
+        restart_backoff_max_s: float = 30.0,
+        circuit_threshold: int = 5,
+        circuit_window_s: float = 60.0,
+        watchdog_timeout_s: float = 0.0,
+        watchdog: Optional[StepWatchdog] = None,
+        faults: Optional[FaultInjector] = None,
     ):
         if getattr(generator, "_multihost", False):
             raise ValueError(
@@ -84,6 +139,38 @@ class ContinuousBatchingEngine:
         self._bucket = max(1, int(prompt_bucket))
         self.stats = stats or ServingStats(self._slots)
         self._q: "queue.Queue[Request]" = queue.Queue()
+        # admission policy (read on submit threads, set once here)
+        self._max_queue_depth = max(0, int(max_queue_depth))  # 0 = unbounded
+        self._queue_deadline_s = (
+            float(queue_deadline_s) if queue_deadline_s else None
+        )
+        self._draining = False
+        self._terminal: Optional[ServingError] = None  # worker dead when set
+        # no-hung-waiter ledger: +1 at submit, -1 at every terminal _settle
+        self._pending = 0
+        self._plock = threading.Lock()
+        # EWMA of queue-entry -> completion seconds; seeds the Retry-After
+        # hints before any request has completed (worker-thread-only writes)
+        self._avg_service_s = 1.0
+        # supervision: restart policy + deterministic fault hooks
+        self.supervisor = EngineSupervisor(
+            restart_backoff_s=restart_backoff_s,
+            restart_backoff_max_s=restart_backoff_max_s,
+            circuit_threshold=circuit_threshold,
+            circuit_window_s=circuit_window_s,
+        )
+        self.faults = faults if faults is not None else FaultInjector()
+        # wedged-device escape hatch (runtime/watchdog.py): poked per decode
+        # tick, paused while legitimately idle or in restart backoff.
+        # start_paused so the first request's compile cannot false-trip.
+        if watchdog is not None:
+            self._watchdog: Optional[StepWatchdog] = watchdog
+        elif watchdog_timeout_s and watchdog_timeout_s > 0:
+            self._watchdog = StepWatchdog(
+                watchdog_timeout_s, action="abort", start_paused=True
+            )
+        else:
+            self._watchdog = None
         # worker-thread-only state (no lock needed)
         self._slot_req: List[Optional[Request]] = [None] * self._slots
         self._slot_tokens: List[List[int]] = [[] for _ in range(self._slots)]
@@ -91,6 +178,7 @@ class ContinuousBatchingEngine:
         self._live = np.zeros((self._slots,), bool)
         self._cache = None
         self._state = None
+        self._decode_index = 0  # absolute decode-tick count, engine lifetime
         self._eos = set(getattr(generator, "eos_token_ids", ()) or ())
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -116,13 +204,13 @@ class ContinuousBatchingEngine:
     ) -> Request:
         """``submit`` returning the whole request record (window-engine
         parity, so the server can swap engines behind one call shape)."""
-        req = Request(list(prompt_ids), gen, seed)
+        req = self._make_request(prompt_ids, gen, seed)
         self._q.put(req)
         if not req.done.wait(timeout):
             req.abandoned = True  # the worker sheds it un-decoded
             raise TimeoutError(
                 f"generate request not served within {timeout}s "
-                f"(queue depth {self._q.qsize()})"
+                f"(queue depth {self._queue_len()})"
             )
         if req.error is not None:
             raise req.error
@@ -139,43 +227,281 @@ class ContinuousBatchingEngine:
         request shares the slot batch with everything else in flight — the
         streaming-under-batching the window engine cannot offer (it only
         resolves whole batches). ``timeout`` bounds the wait for EACH next
-        token; on expiry the request is abandoned and sheds its slot."""
-        req = Request(list(prompt_ids), gen, seed, tokens_q=queue.Queue())
+        token; on expiry the request is abandoned and sheds its slot.
+
+        Admission (overflow/drain/circuit) is checked HERE, not at first
+        iteration, so the server can return a real status code before
+        committing to an SSE response."""
+        req = self._make_request(prompt_ids, gen, seed, tokens_q=queue.Queue())
         self._q.put(req)
+
+        def _tokens() -> Iterator[int]:
+            while True:
+                try:
+                    tok = req.tokens_q.get(timeout=timeout)
+                except queue.Empty:
+                    req.abandoned = True
+                    raise TimeoutError(
+                        f"stream starved for {timeout}s "
+                        f"(queue depth {self._queue_len()})"
+                    ) from None
+                if tok is None:
+                    if req.error is not None:
+                        raise req.error
+                    return
+                yield tok
+
+        return _tokens()
+
+    def begin_drain(self) -> None:
+        """Close admission (new submits get DrainingError); queued and
+        in-flight requests keep decoding to completion. The SIGTERM path
+        (infer/server.py) follows with ``wait_drained``."""
+        self._draining = True
+
+    def wait_drained(self, timeout_s: float, poll_s: float = 0.05) -> bool:
+        """Block until every submitted request has resolved (True) or the
+        timeout expires with work still pending (False)."""
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
         while True:
-            try:
-                tok = req.tokens_q.get(timeout=timeout)
-            except queue.Empty:
-                req.abandoned = True
-                raise TimeoutError(
-                    f"stream starved for {timeout}s "
-                    f"(queue depth {self._q.qsize()})"
-                ) from None
-            if tok is None:
-                if req.error is not None:
-                    raise req.error
-                return
-            yield tok
+            with self._plock:
+                pending = self._pending
+            if pending <= 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    @property
+    def healthy(self) -> bool:
+        """False once the worker is terminally dead (fatal or circuit-open):
+        the ``/healthz`` signal asking the orchestrator for a pod recycle."""
+        return self._terminal is None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def terminal_error(self) -> Optional[ServingError]:
+        return self._terminal
+
+    @property
+    def circuit_state(self) -> str:
+        if isinstance(self._terminal, CircuitOpenError):
+            return "open"
+        return "closed" if self._terminal is None else "fatal"
 
     def stats_snapshot(self) -> dict:
         """Current counters + freshly-read gauges (``GET /v1/stats``)."""
-        self.stats.gauge("queue_depth", self._q.qsize())
+        self.stats.gauge("queue_depth", self._queue_len())
         self.stats.gauge("live_slots", int(self._live.sum()))
-        return self.stats.snapshot()
+        self.stats.gauge("engine_generation", self.supervisor.generation)
+        snap = self.stats.snapshot()
+        snap["circuit_state"] = self.circuit_state
+        snap["draining"] = self._draining
+        return snap
+
+    # ------------------------------------------------------------- admission
+
+    def _queue_len(self) -> int:
+        return self._q.qsize()
+
+    def _retry_after(self) -> float:
+        """Finite Retry-After hint: roughly how long until the backlog ahead
+        of a retry drains through the slots, from the service-time EWMA.
+        Clamped to [0.5s, 600s] so a cold EWMA can never emit 0 or inf."""
+        backlog = self._queue_len() + max(1, int(self._live.sum()))
+        est = self._avg_service_s * backlog / self._slots
+        return float(min(max(est, 0.5), 600.0))
+
+    def _make_request(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int,
+        tokens_q: Optional["queue.Queue"] = None,
+    ) -> Request:
+        """Admission gate, shared by submit and stream: reject terminal /
+        draining / overflow states BEFORE the request enters the queue, and
+        stamp the queue-wait deadline. Registers the request in the pending
+        ledger — from here on, exactly one ``_settle`` resolves it."""
+        if self._terminal is not None:
+            raise self._terminal
+        if self._draining:
+            raise DrainingError(
+                "engine draining; admission closed — retry against another "
+                "replica",
+                retry_after_s=self._retry_after(),
+            )
+        if self._max_queue_depth and self._queue_len() >= self._max_queue_depth:
+            self.stats.incr("requests_shed_overflow")
+            raise QueueOverflowError(
+                f"admission queue full ({self._queue_len()} waiting >= "
+                f"max_queue_depth {self._max_queue_depth})",
+                retry_after_s=self._retry_after(),
+            )
+        req = Request(list(prompt_ids), gen, seed, tokens_q=tokens_q)
+        req.enqueued_at = time.monotonic()
+        if self._queue_deadline_s is not None:
+            req.queue_deadline = req.enqueued_at + self._queue_deadline_s
+        with self._plock:
+            self._pending += 1
+        return req
+
+    def _expired(self, req: Request) -> bool:
+        return (
+            req.queue_deadline is not None
+            and time.monotonic() > req.queue_deadline
+        )
+
+    # ------------------------------------------------------------ resolution
+
+    def _settle(self, req: Request) -> None:
+        """The one place a request leaves the pending ledger and wakes its
+        waiter. Every admission has exactly one settle — the no-hung-waiter
+        invariant wait_drained and the tests lean on."""
+        with self._plock:
+            self._pending -= 1
+        req.done.set()
+
+    def _resolve_error(self, req: Request, err: BaseException) -> None:
+        """Fail one request (idempotent: recovery may race a request that
+        already finished its final token)."""
+        if req.done.is_set():
+            return
+        req.error = err
+        if req.tokens_q is not None:
+            req.tokens_q.put(None)
+        self.stats.incr("requests_failed")
+        self._settle(req)
+
+    def _settle_abandoned(self, req: Request) -> None:
+        self.stats.incr("requests_abandoned")
+        self._settle(req)
+
+    def _shed_deadline(self, req: Request) -> None:
+        waited = time.monotonic() - req.enqueued_at if req.enqueued_at else 0.0
+        self.stats.incr("requests_shed_deadline")
+        self._resolve_error(
+            req,
+            QueueDeadlineError(
+                f"request waited {waited:.2f}s queued, over the "
+                f"{self._queue_deadline_s}s deadline; shed before prefill",
+                retry_after_s=self._retry_after(),
+            ),
+        )
 
     # ---------------------------------------------------------------- worker
 
     def _run(self) -> None:
+        """Supervised worker: serve until a tick fails, then classify and
+        either rebuild in-process (retryable, circuit closed) or die — and
+        once dead, keep resolving stragglers so nothing ever hangs."""
+        while True:
+            try:
+                self._startup()
+                self._serve_loop()
+            except BaseException as e:  # noqa: BLE001 — supervision boundary
+                if not self._recover(e):
+                    break
+        # terminal: a submit may have passed the admission gate just before
+        # _terminal was set and enqueued afterwards — resolve those too
+        while True:
+            self._fail_queued(self._terminal)
+            req = self._q.get()
+            self._resolve_error(req, self._terminal)
+
+    def _startup(self) -> None:
+        """(Re)build the device-side decode state. Params are still resident
+        on the Generator and the jitted programs are cached there, so this
+        is an allocation + a couple of dispatches — not a recompilation."""
         gen = self._generator
         self._cache, self._state = gen.init_slot_state(self._slots, self._buf_len)
-        step = gen.slot_step(self._slots, self._buf_len)
+
+    def _serve_loop(self) -> None:
+        step = self._generator.slot_step(self._slots, self._buf_len)
         while True:
             self._admit()
             if not self._live.any():
                 # idle: block until traffic instead of spinning
-                self._handle_new(self._q.get())
+                self._handle_new(self._idle_get())
                 continue
             self._decode_once(step)
+
+    def _idle_get(self) -> Request:
+        """Blocking queue read with the watchdog disarmed: an empty queue is
+        legitimate silence, not a wedged device. The next poke re-arms."""
+        if self._watchdog is not None:
+            self._watchdog.pause()
+        return self._q.get()
+
+    def _recover(self, cause: BaseException) -> bool:
+        """Classify a worker failure; True = state rebuilt, serve again."""
+        if self._watchdog is not None:
+            self._watchdog.pause()  # backoff sleep is not a wedge
+        sup = self.supervisor
+        if is_retryable_failure(cause) and sup.record_failure() == "restart":
+            err = RetryableEngineError(
+                f"engine worker failed mid-flight "
+                f"({type(cause).__name__}: {cause}); in-flight state lost, "
+                "engine restarting — safe to retry",
+                retry_after_s=self._retry_after(),
+                generation=sup.generation,
+            )
+            err.__cause__ = cause
+            self._fail_inflight(err)
+            delay = sup.backoff_delay()
+            if delay > 0:
+                time.sleep(delay)
+            sup.restarted()
+            self.stats.incr("engine_restarts")
+            print(
+                f"[engine] recovered from {type(cause).__name__} — "
+                f"generation {sup.generation} "
+                f"({sup.failure_count} failure(s) in window, "
+                f"backoff {delay:.2f}s)",
+                flush=True,
+            )
+            return True
+        if sup.circuit_open:
+            err: ServingError = CircuitOpenError(
+                f"{sup.failure_count} engine failures within "
+                f"{sup.circuit_window_s:.0f}s — circuit open, not "
+                f"restarting (last: {type(cause).__name__}: {cause}); "
+                "the pod needs a recycle"
+            )
+        else:
+            err = FatalEngineError(
+                f"fatal engine failure: {type(cause).__name__}: {cause}"
+            )
+        err.__cause__ = cause
+        self._terminal = err  # set BEFORE resolving, so waiters see it
+        self._fail_inflight(err)
+        self._fail_queued(err)
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        print(f"[engine] worker terminal: {err}", flush=True)
+        return False
+
+    def _fail_inflight(self, err: ServingError) -> None:
+        """Resolve every admitted request and free its slot (their KV state
+        does not survive the rebuild)."""
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self._resolve_error(req, err)
+            self._release(slot)
+
+    def _fail_queued(self, err: ServingError) -> None:
+        """Resolve everything still queued (terminal shutdown only — on a
+        restart, queued requests survive and admit into the new generation)."""
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            self._resolve_error(req, err)
 
     def _admit(self) -> None:
         """Refill free slots from the queue head — strict FIFO, any config."""
@@ -190,16 +516,23 @@ class ContinuousBatchingEngine:
         if req.abandoned:
             # timed-out while queued: dropped WITHOUT decoding (the waiter is
             # gone; prefilling for nobody would starve live traffic)
-            self.stats.incr("requests_abandoned")
-            req.done.set()
+            self._settle_abandoned(req)
+            return
+        if self._expired(req):
+            self._shed_deadline(req)
             return
         try:
             self._insert(req)
-        except BaseException as e:
-            req.error = e
-            if req.tokens_q is not None:
-                req.tokens_q.put(None)
-            req.done.set()
+        except (ValueError, TypeError) as e:
+            # request-level rejection (bad prompt/config): fail just this one
+            self._resolve_error(req, e)
+        except BaseException:
+            # device-level failure mid-prefill: nothing host-side committed
+            # yet (bookkeeping happens after the device call), so requeue the
+            # request to retry against the rebuilt state, then let the
+            # supervision loop classify the failure
+            self._q.put(req)
+            raise
 
     def _knob_arrays(self, req: Request) -> dict:
         """Per-request traced sampling knobs as scalar arrays (prefill args)."""
@@ -223,6 +556,7 @@ class ContinuousBatchingEngine:
                 f"prompt of {plen} tokens does not fit the engine's "
                 f"{self._buf_len}-slot KV buffer (need >= 1 decode slot)"
             )
+        self.faults.maybe_fail_prefill()
         bucket = min(-(-plen // self._bucket) * self._bucket, self._buf_len)
         prefill = gen.slot_prefill(bucket, self._buf_len)
         padded = np.zeros((1, bucket), np.int32)
@@ -234,6 +568,8 @@ class ContinuousBatchingEngine:
             gen.params, self._cache, self._state, padded, np.int32(plen),
             np.int32(slot), knobs, jax.random.PRNGKey(req.seed),
         )
+        if self._watchdog is not None:
+            self._watchdog.poke(self._decode_index)
         self._slot_req[slot] = req
         self._slot_tokens[slot] = []
         # the budget honors max_new_tokens but never the buffer's end: the
@@ -245,21 +581,14 @@ class ContinuousBatchingEngine:
 
     def _decode_once(self, step) -> None:
         gen = self._generator
-        try:
-            self._cache, self._state, toks = step(
-                gen.params, self._cache, self._state, self._live.copy()
-            )
-            toks = np.asarray(toks)
-        except BaseException as e:  # device failure: resolve every waiter
-            for slot, req in enumerate(self._slot_req):
-                if req is None:
-                    continue
-                req.error = e
-                if req.tokens_q is not None:
-                    req.tokens_q.put(None)
-                req.done.set()
-                self._release(slot)
-            return
+        self._decode_index += 1
+        self.faults.maybe_fail_decode(self._decode_index)
+        self._cache, self._state, toks = step(
+            gen.params, self._cache, self._state, self._live.copy()
+        )
+        toks = np.asarray(toks)  # the host sync a wedged link would hang
+        if self._watchdog is not None:
+            self._watchdog.poke(self._decode_index)
         self.stats.incr("decode_steps")
         for slot in range(self._slots):
             req = self._slot_req[slot]
@@ -267,8 +596,7 @@ class ContinuousBatchingEngine:
                 continue
             if req.abandoned:
                 # mid-flight timeout: shed the slot so live traffic refills it
-                self.stats.incr("requests_abandoned")
-                req.done.set()
+                self._settle_abandoned(req)
                 self._release(slot)
                 continue
             self._emit_token(slot, req, int(toks[slot]))
@@ -288,8 +616,12 @@ class ContinuousBatchingEngine:
         req.result = self._slot_tokens[slot]
         if req.tokens_q is not None:
             req.tokens_q.put(None)
-        req.done.set()
+        if req.enqueued_at:
+            # service-time EWMA feeding the Retry-After hints
+            dt = time.monotonic() - req.enqueued_at
+            self._avg_service_s += 0.2 * (dt - self._avg_service_s)
         self.stats.incr("requests_completed")
+        self._settle(req)
         self._release(slot)
 
     def _release(self, slot: int) -> None:
@@ -345,6 +677,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     (never overtaken), after LRU eviction of the prefix cache fails to
     make room. Dead rows get all-null tables each step so their frozen
     positions write into null-block garbage, never into reassigned blocks.
+
+    Supervision carries over: on a retryable worker failure the rebuild
+    replaces the block pool AND the prefix cache wholesale (a block's
+    content does not survive the KV-pool rebuild, so cached prefixes must
+    not either) along with the slot tables, then requeued/waiting requests
+    admit into the fresh pool.
     """
 
     def __init__(
@@ -357,6 +695,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         prefill_chunk: int = 512,
         num_blocks: Optional[int] = None,
         stats: Optional[ServingStats] = None,
+        **kwargs,
     ):
         slots = max(1, int(slots))
         self._block_len = max(1, int(block_len))
@@ -370,28 +709,40 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             # headroom + the null block: generous default, same order as the
             # dense engine's slots * buf_len footprint
             num_blocks = 1 + (slots + 1) * self._table_blocks
-        self._allocator = BlockAllocator(int(num_blocks))
+        self._num_blocks = int(num_blocks)
+        self._allocator = BlockAllocator(self._num_blocks)
         self._prefix = PrefixCache(self._allocator, self._block_len)
         self._table = np.zeros((slots, self._table_blocks), np.int32)
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
         self._slot_plen = [0] * slots
         self._prefills: List[_PrefillTask] = []  # FIFO, head in progress
         self._waiting: "deque[Request]" = deque()  # FIFO admission buffer
-        stats = stats or ServingStats(slots, total_blocks=int(num_blocks) - 1)
+        stats = stats or ServingStats(slots, total_blocks=self._num_blocks - 1)
         # parent starts the worker thread LAST, so every paged field above
-        # must exist before this call
+        # must exist before this call (kwargs: supervision/admission knobs)
         super().__init__(
             generator, slots=slots, buf_len=buf_len,
-            prompt_bucket=prompt_bucket, stats=stats,
+            prompt_bucket=prompt_bucket, stats=stats, **kwargs,
         )
 
     # ---------------------------------------------------------------- worker
 
-    def _run(self) -> None:
+    def _startup(self) -> None:
+        """Rebuild pool-backed state wholesale: fresh allocator, EMPTY prefix
+        cache (its blocks' contents died with the old KV pool), all-null
+        tables, and a new device-side paged cache. Queued/waiting requests
+        are untouched — they re-plan against the fresh pool at admission."""
         gen = self._generator
+        self._allocator = BlockAllocator(self._num_blocks)
+        self._prefix = PrefixCache(self._allocator, self._block_len)
+        self._table[:, :] = NULL_BLOCK
+        self._slot_blocks = [[] for _ in range(self._slots)]
+        self._slot_plen = [0] * self._slots
         self._cache, self._state = gen.init_paged_state(
-            self._slots, self._allocator.num_blocks, self._block_len
+            self._slots, self._num_blocks, self._block_len
         )
+
+    def _serve_loop(self) -> None:
         while True:
             self._admit()
             busy = False
@@ -405,7 +756,19 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 # idle: block until traffic instead of spinning (_admit
                 # guarantees a queued head either admits or errors when
                 # nothing is running, so waiting-but-idle cannot happen)
-                self._waiting.append(self._q.get())
+                self._waiting.append(self._idle_get())
+
+    def _fail_inflight(self, err: ServingError) -> None:
+        self._prefills.clear()  # their requests resolve via _slot_req below
+        super()._fail_inflight(err)
+
+    def _fail_queued(self, err: ServingError) -> None:
+        while self._waiting:
+            self._resolve_error(self._waiting.popleft(), err)
+        super()._fail_queued(err)
+
+    def _queue_len(self) -> int:
+        return self._q.qsize() + len(self._waiting)
 
     def _admit(self) -> None:
         """Admit from the FIFO head while a slot AND blocks are available.
@@ -424,20 +787,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             req = self._waiting[0]
             if req.abandoned:
                 self._waiting.popleft()
-                self.stats.incr("requests_abandoned")
-                req.done.set()
+                self._settle_abandoned(req)
+                continue
+            if self._expired(req):
+                self._waiting.popleft()
+                self._shed_deadline(req)
                 continue
             free = [s for s in range(self._slots) if self._slot_req[s] is None]
             if not free:
                 return
             try:
                 plan = self._plan(req)
-            except BaseException as e:
+            except (ValueError, RuntimeError) as e:
+                # host-side rejection (can-never-fit, drained-pool paradox):
+                # request-level, the worker is fine
                 self._waiting.popleft()
-                req.error = e
-                if req.tokens_q is not None:
-                    req.tokens_q.put(None)
-                req.done.set()
+                self._resolve_error(req, e)
                 continue
             if plan is None:
                 return  # head waits for blocks; FIFO holds
@@ -535,59 +900,56 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _prefill_tick(self) -> None:
         """Run ONE bounded prefill chunk of the oldest pending prompt (FIFO
         among prefills too), so long prompts interleave with decode steps
-        instead of stalling every live slot."""
+        instead of stalling every live slot. A device failure here takes
+        the supervision path (the slot's blocks are already mapped, so the
+        request resolves via _fail_inflight)."""
         gen = self._generator
         task = self._prefills[0]
         req = task.req
         if req.abandoned:
             self._prefills.pop(0)
-            self.stats.incr("requests_abandoned")
-            req.done.set()
+            self._settle_abandoned(req)
             self._release(task.slot)
             return
+        self.faults.maybe_fail_prefill()
         import jax
 
         C = self._prefill_chunk
         remaining = task.plen - task.next
         table = np.ascontiguousarray(self._table[task.slot : task.slot + 1])
-        try:
-            if remaining > C:
-                ingest = gen.paged_prefill_chunk(
-                    C, self._table_blocks, self._block_len
-                )
-                chunk = np.asarray(
-                    req.prompt[task.next : task.next + C], np.int32
-                )[None, :]
-                self._cache = ingest(
-                    gen.params, self._cache, table, chunk, np.int32(task.next)
-                )
-                task.next += C
-                self.stats.incr("prefill_chunks")
-                return
-            bucket = -(-remaining // self._bucket) * self._bucket
-            final = gen.paged_prefill_final(
-                bucket, self._table_blocks, self._block_len
+        if remaining > C:
+            ingest = gen.paged_prefill_chunk(
+                C, self._table_blocks, self._block_len
             )
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :remaining] = req.prompt[task.next :]
-            seen_row = np.zeros((1, gen.config.vocab_size), bool)
-            seen_row[0, np.asarray(req.prompt, np.intp)] = True
-            self._cache, self._state, first = final(
-                gen.params, self._cache, self._state, table, padded,
-                np.int32(task.next), np.int32(task.plen), seen_row,
-                np.int32(task.slot), self._knob_arrays(req),
-                jax.random.PRNGKey(req.seed),
+            chunk = np.asarray(
+                req.prompt[task.next : task.next + C], np.int32
+            )[None, :]
+            self._cache = ingest(
+                gen.params, self._cache, table, chunk, np.int32(task.next)
             )
-        except BaseException as e:
-            self._prefills.pop(0)
-            req.error = e
-            if req.tokens_q is not None:
-                req.tokens_q.put(None)
-            req.done.set()
-            self._release(task.slot)
+            task.next += C
+            self.stats.incr("prefill_chunks")
+            if self._watchdog is not None:
+                self._watchdog.poke(self._decode_index)
             return
+        bucket = -(-remaining // self._bucket) * self._bucket
+        final = gen.paged_prefill_final(
+            bucket, self._table_blocks, self._block_len
+        )
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :remaining] = req.prompt[task.next :]
+        seen_row = np.zeros((1, gen.config.vocab_size), bool)
+        seen_row[0, np.asarray(req.prompt, np.intp)] = True
+        self._cache, self._state, first = final(
+            gen.params, self._cache, self._state, table, padded,
+            np.int32(task.next), np.int32(task.plen), seen_row,
+            np.int32(task.slot), self._knob_arrays(req),
+            jax.random.PRNGKey(req.seed),
+        )
         self._prefills.pop(0)
         self.stats.incr("prefill_chunks")
+        if self._watchdog is not None:
+            self._watchdog.poke(self._decode_index)
         # register the prompt's FULL blocks for reuse BEFORE emitting (the
         # first token may already finish the request and free the slot)
         full = task.plen // self._block_len
@@ -613,22 +975,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             np.where(self._live[:, None], self._table, NULL_BLOCK)[:, :nb]
         )
         step = gen.paged_step(self._slots, nb, L)
-        try:
-            self._cache, self._state, toks = step(
-                gen.params, self._cache, self._state, self._live.copy(), tables
-            )
-            toks = np.asarray(toks)
-        except BaseException as e:  # device failure: resolve every waiter
-            for slot, req in enumerate(self._slot_req):
-                if req is None:
-                    continue
-                req.error = e
-                if req.tokens_q is not None:
-                    req.tokens_q.put(None)
-                req.done.set()
-                self._release(slot)
-            self._prefills.clear()
-            return
+        self._decode_index += 1
+        self.faults.maybe_fail_decode(self._decode_index)
+        self._cache, self._state, toks = step(
+            gen.params, self._cache, self._state, self._live.copy(), tables
+        )
+        toks = np.asarray(toks)
+        if self._watchdog is not None:
+            self._watchdog.poke(self._decode_index)
         self.stats.incr("decode_steps")
         self.stats.gauge_max("peak_blocks_in_use", self._allocator.used_count)
         for slot in range(self._slots):
@@ -636,8 +990,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             if req is None or not self._live[slot]:
                 continue  # free, or admitted but still prefilling
             if req.abandoned:
-                self.stats.incr("requests_abandoned")
-                req.done.set()
+                self._settle_abandoned(req)
                 self._release(slot)
                 continue
             self._emit_token(slot, req, int(toks[slot]))

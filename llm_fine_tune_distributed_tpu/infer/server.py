@@ -5,11 +5,22 @@ The reference has NO serving server — inference is CLI-only, and
 "for a future endpoint" (SURVEY.md §2.1 C21, "not present" list). This
 closes that gap with a dependency-free stdlib server exposing:
 
-  GET  /healthz                      -> 200 "ok" (readiness probe target)
+  GET  /healthz                      -> 200 "ok" (readiness probe target);
+                                        503 while draining, circuit-open,
+                                        or multi-host-wedged
   GET  /v1/stats                     -> serving counters/gauges (JSON)
   POST /v1/generate {"question": .., -> {"answer": ..}
         optional: "max_new_tokens", "temperature", "top_p", "top_k",
                   "repetition_penalty", "greedy", "seed", "system_prompt"}
+
+Failures surface through the taxonomy in infer/errors.py: queue overflow
+is a 429 with a finite ``Retry-After`` derived from observed service time,
+engine restarts / drain / queue-deadline sheds are 503s (retryable), and
+fatal engine states are 500s — all with a structured ``{"error": {kind,
+message, retryable, ...}}`` body. SIGTERM starts a graceful drain:
+admission closes (503 + Retry-After), ``/healthz`` reports ``draining``,
+in-flight requests finish up to ``--drain-timeout-s``, then the process
+exits 0.
 
 Handlers run on threads; a single worker owns the TPU. Three engines
 (``--engine``):
@@ -36,6 +47,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
+import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -56,7 +70,20 @@ def serve(
     kv_buf_len: int = 4096,
     kv_block_len: int = 256,
     prefill_chunk: int = 512,
+    max_queue_depth: int = 256,
+    queue_deadline_s: Optional[float] = None,
+    drain_timeout_s: float = 30.0,
+    restart_backoff_s: float = 0.5,
+    restart_backoff_max_s: float = 30.0,
+    circuit_threshold: int = 5,
+    circuit_window_s: float = 60.0,
+    watchdog_timeout_s: float = 0.0,
+    control: Optional[dict] = None,
 ) -> None:
+    """``control``, when given, is populated with the drain entry points
+    (``begin_drain``, ``httpd``, the engines) so in-process tests can drive
+    the SIGTERM path without owning the main thread (signal handlers can
+    only be installed there)."""
     from llm_fine_tune_distributed_tpu.data.prompts import WILDERNESS_EXPERT_SYSTEM_PROMPT
     from llm_fine_tune_distributed_tpu.infer import (
         GenerationConfig,
@@ -66,6 +93,11 @@ def serve(
     )
 
     from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine
+    from llm_fine_tune_distributed_tpu.infer.errors import (
+        DrainingError,
+        ServingError,
+        error_payload,
+    )
 
     from llm_fine_tune_distributed_tpu.ops.int8 import QUANTIZE_MODES, maybe_quantize
 
@@ -122,6 +154,16 @@ def serve(
     engine = BatchingEngine(engine_target, max_batch=max_batch, window_ms=batch_window_ms)
     cont_engine = None
     cont_kind = "window"
+    # supervision + admission knobs shared by both slot engines
+    engine_kwargs = {
+        "max_queue_depth": max_queue_depth,
+        "queue_deadline_s": queue_deadline_s,
+        "restart_backoff_s": restart_backoff_s,
+        "restart_backoff_max_s": restart_backoff_max_s,
+        "circuit_threshold": circuit_threshold,
+        "circuit_window_s": circuit_window_s,
+        "watchdog_timeout_s": watchdog_timeout_s,
+    }
     if engine_kind in ("continuous", "paged"):
         if coordinator is not None:
             print(f"[serve] multi-host: {engine_kind} engine unavailable, using window")
@@ -133,6 +175,7 @@ def serve(
             cont_engine = PagedContinuousBatchingEngine(
                 generator, slots=slots, buf_len=kv_buf_len,
                 block_len=kv_block_len, prefill_chunk=prefill_chunk,
+                **engine_kwargs,
             )
             cont_kind = "paged"
         else:
@@ -141,9 +184,10 @@ def serve(
             )
 
             cont_engine = ContinuousBatchingEngine(
-                generator, slots=slots, buf_len=kv_buf_len
+                generator, slots=slots, buf_len=kv_buf_len, **engine_kwargs
             )
             cont_kind = "continuous"
+    drain_state = {"draining": False}
     print(
         f"Model ready (engine={cont_kind}, "
         f"slots={slots}, max_batch={max_batch}, quantize={quantize})."
@@ -154,7 +198,12 @@ def serve(
         # non-stream response carries an explicit Content-Length)
         protocol_version = "HTTP/1.1"
 
-        def _send(self, code: int, payload: dict | str) -> None:
+        def _send(
+            self,
+            code: int,
+            payload: dict | str,
+            headers: Optional[dict] = None,
+        ) -> None:
             body = (
                 payload if isinstance(payload, str) else json.dumps(payload)
             ).encode()
@@ -164,8 +213,20 @@ def serve(
                 "text/plain" if isinstance(payload, str) else "application/json",
             )
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
+
+        def _send_error(self, exc: BaseException) -> None:
+            """Map any serving failure through the taxonomy (infer/errors.py)
+            to status + structured JSON body + Retry-After when known."""
+            status, payload, retry_after = error_payload(exc)
+            headers = {}
+            if retry_after is not None:
+                # ceil to a whole second: Retry-After must be a positive int
+                headers["Retry-After"] = max(1, int(-(-retry_after // 1)))
+            self._send(status, payload, headers=headers)
 
         def do_GET(self):  # noqa: N802 (stdlib casing)
             if self.path == "/healthz":
@@ -174,6 +235,22 @@ def serve(
                 # the orchestrator restarts every host (multihost.py)
                 if coordinator is not None and coordinator.wedged:
                     self._send(503, {"error": "follower hosts wedged; restart fleet"})
+                elif drain_state["draining"]:
+                    # SIGTERM received: the orchestrator should stop routing
+                    # here while in-flight requests finish
+                    self._send(
+                        503,
+                        {"status": "draining"},
+                        headers={"Retry-After": max(1, int(drain_timeout_s))},
+                    )
+                elif cont_engine is not None and not cont_engine.healthy:
+                    # circuit open or fatal worker death: in-process recovery
+                    # is over, ask for a pod recycle
+                    self._send(503, {
+                        "status": "unhealthy",
+                        "circuit_state": cont_engine.circuit_state,
+                        "error": cont_engine.terminal_error.to_dict(),
+                    })
                 else:
                     self._send(200, "ok")
             elif self.path == "/v1/stats":
@@ -249,6 +326,18 @@ def serve(
             if coordinator is not None:
                 self._send(501, {"error": "streaming unavailable in multi-host serving"})
                 return
+            token_iter = None
+            if cont_engine is not None:
+                # admission (overflow / drain / circuit) happens at stream()
+                # call time, BEFORE headers, so shed requests get a real
+                # status code + Retry-After instead of an empty SSE body
+                try:
+                    token_iter = cont_engine.stream(
+                        prompt_ids, gen, seed=seed, timeout=request_timeout_s
+                    )
+                except (ServingError, TimeoutError) as e:
+                    self._send_error(e)
+                    return
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -258,15 +347,10 @@ def serve(
             def chunk_out(data: bytes) -> None:
                 self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
 
-            if cont_engine is not None:
+            if token_iter is not None:
                 # ride the shared slot batch: one token per piece, emitted
                 # as the engine's scheduler loop decodes it
-                source = (
-                    [t]
-                    for t in cont_engine.stream(
-                        prompt_ids, gen, seed=seed, timeout=request_timeout_s
-                    )
-                )
+                source = ([t] for t in token_iter)
             else:
                 source = generator.generate_stream(
                     prompt_ids, gen, seed=seed, chunk=stream_chunk
@@ -287,6 +371,14 @@ def serve(
                 chunk_out(
                     f"data: {json.dumps({'done': True, 'n_tokens': len(ids_all)})}\n\n".encode()
                 )
+            except Exception as e:
+                # the request died mid-stream (decode failure, shed, device
+                # error): emit a terminal error event with the structured
+                # body instead of silently truncating the stream
+                _, payload, _ = error_payload(e)
+                chunk_out(
+                    f"event: error\ndata: {json.dumps(payload['error'])}\n\n".encode()
+                )
             finally:
                 self.wfile.write(b"0\r\n\r\n")
 
@@ -299,6 +391,16 @@ def serve(
         }
 
         def do_POST(self):  # noqa: N802
+            if drain_state["draining"] and self.path in (
+                "/v1/generate", "/v1/stream"
+            ):
+                # admission is closed server-wide during drain; in-flight
+                # work keeps running until done or --drain-timeout-s
+                self._send_error(DrainingError(
+                    "server draining; retry against another replica",
+                    retry_after_s=float(drain_timeout_s),
+                ))
+                return
             if self.path == "/v1/stream":
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
@@ -364,6 +466,11 @@ def serve(
                         prompt_ids, gen, seed=seed, timeout=request_timeout_s
                     )
                 answer = generator.decode_reply(pending.result)
+            except ServingError as e:
+                # taxonomy failures (overflow 429, restart/drain/deadline
+                # 503, circuit/fatal 500): structured body + Retry-After
+                self._send_error(e)
+                return
             except TimeoutError as e:  # wedged device: shed load, don't pile up
                 self._send(503, {"error": str(e)})
                 return
@@ -384,6 +491,53 @@ def serve(
             print(f"[serve] {self.address_string()} {fmt % args}", flush=True)
 
     httpd = ThreadingHTTPServer((host, port), Handler)
+
+    def begin_drain(signum=None, frame=None):
+        """SIGTERM entry point (k8s drain / spot preemption): close
+        admission, let in-flight work finish up to ``drain_timeout_s``,
+        then stop the server loop so ``serve`` returns and the process
+        exits 0 — a clean goodbye instead of killed mid-stream."""
+        if drain_state["draining"]:
+            return
+        drain_state["draining"] = True
+        print(
+            f"[serve] drain: admission closed, finishing in-flight work "
+            f"(timeout {drain_timeout_s}s)",
+            flush=True,
+        )
+        for eng in (cont_engine, engine):
+            if eng is not None:
+                eng.begin_drain()
+
+        def _finish():
+            deadline = time.monotonic() + float(drain_timeout_s)
+            clean = True
+            for eng in (cont_engine, engine):
+                if eng is not None:
+                    clean = eng.wait_drained(
+                        max(0.0, deadline - time.monotonic())
+                    ) and clean
+            print(
+                "[serve] drain complete; shutting down"
+                if clean
+                else "[serve] drain timeout: shutting down with "
+                     "requests unresolved",
+                flush=True,
+            )
+            httpd.shutdown()
+
+        threading.Thread(target=_finish, name="drain", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, begin_drain)
+    except ValueError:
+        pass  # not the main thread: tests drive begin_drain via `control`
+    if control is not None:
+        control["begin_drain"] = begin_drain
+        control["httpd"] = httpd
+        control["cont_engine"] = cont_engine
+        control["window_engine"] = engine
+
     print(f"Serving on {host}:{port}")
     try:
         httpd.serve_forever()
@@ -393,6 +547,8 @@ def serve(
         httpd.server_close()
         if coordinator is not None:
             coordinator.stop()  # release follower hosts
+        if drain_state["draining"]:
+            print("[serve] drained; exiting", flush=True)
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -451,6 +607,45 @@ def main(argv: Optional[list] = None) -> int:
         help="max seconds a request waits for the device before a 503 "
              "(0 = wait forever)",
     )
+    parser.add_argument(
+        "--max-queue-depth", type=int, default=256,
+        help="bounded admission: requests beyond this many waiters are shed "
+             "with 429 + Retry-After (0 = unbounded)",
+    )
+    parser.add_argument(
+        "--queue-deadline-s", type=float, default=0.0,
+        help="shed requests still queued after this many seconds BEFORE "
+             "prefill (503, retryable; 0 = no deadline)",
+    )
+    parser.add_argument(
+        "--drain-timeout-s", type=float, default=30.0,
+        help="SIGTERM grace: how long in-flight requests may finish before "
+             "the server exits anyway",
+    )
+    parser.add_argument(
+        "--restart-backoff-s", type=float, default=0.5,
+        help="supervisor: delay before the first in-process engine restart "
+             "(doubles per failure in the circuit window)",
+    )
+    parser.add_argument(
+        "--restart-backoff-max-s", type=float, default=30.0,
+        help="supervisor: cap on the exponential restart backoff",
+    )
+    parser.add_argument(
+        "--circuit-threshold", type=int, default=5,
+        help="supervisor: retryable failures within --circuit-window-s that "
+             "open the circuit (engine stops restarting, /healthz goes 503)",
+    )
+    parser.add_argument(
+        "--circuit-window-s", type=float, default=60.0,
+        help="supervisor: sliding window for the circuit-breaker count",
+    )
+    parser.add_argument(
+        "--watchdog-timeout-s", type=float, default=0.0,
+        help="hard-exit if the decode worker makes no progress for this many "
+             "seconds (wedged device sync; runtime/watchdog.py). Must exceed "
+             "the worst-case prefill compile. 0 = off",
+    )
     args = parser.parse_args(argv)
     if not os.path.isdir(args.model_dir):
         print(f"Error: model directory not found: {args.model_dir!r}")
@@ -460,7 +655,15 @@ def main(argv: Optional[list] = None) -> int:
           request_timeout_s=args.request_timeout_s or None, tp=args.tp,
           engine_kind=args.engine, slots=args.slots,
           kv_buf_len=args.kv_buf_len, kv_block_len=args.kv_block_len,
-          prefill_chunk=args.prefill_chunk)
+          prefill_chunk=args.prefill_chunk,
+          max_queue_depth=args.max_queue_depth,
+          queue_deadline_s=args.queue_deadline_s or None,
+          drain_timeout_s=args.drain_timeout_s,
+          restart_backoff_s=args.restart_backoff_s,
+          restart_backoff_max_s=args.restart_backoff_max_s,
+          circuit_threshold=args.circuit_threshold,
+          circuit_window_s=args.circuit_window_s,
+          watchdog_timeout_s=args.watchdog_timeout_s)
     return 0
 
 

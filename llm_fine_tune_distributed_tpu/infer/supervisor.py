@@ -1,0 +1,131 @@
+"""Engine supervision policy: restart backoff, circuit breaker, and
+deterministic fault injection.
+
+The policy half of the self-healing loop in infer/engine.py. The engine
+worker catches a failed tick, asks ``EngineSupervisor.record_failure()``
+whether to restart or give up, sleeps ``backoff_delay()``, rebuilds its
+device state (params stay resident, jit caches stay warm — a restart costs
+milliseconds, not a recompilation), and bumps ``generation``. N failures
+inside a sliding window open the circuit: the worker stops restarting,
+fails everything fast, and ``/healthz`` goes unhealthy so the orchestrator
+recycles the pod. That split — in-process recovery for blips, external
+restart for persistent faults — is the difference between a transient
+tunneled-link stall costing one batch of requests versus a full pod
+bounce with cold HBM and a dropped prefix cache.
+
+``FaultInjector`` is the deterministic chaos hook the tests and
+``benchmarks/serve_bench.py --chaos`` drive: fail decode at an absolute
+step index, fail the next k decode steps, or fail the next k prefills.
+Inert unless armed; armed faults raise ``InjectedFault`` inside the worker
+so they take exactly the classification path a real device error would.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from llm_fine_tune_distributed_tpu.infer.errors import InjectedFault
+
+
+class EngineSupervisor:
+    """Restart/backoff/circuit policy for one engine worker.
+
+    All mutation happens on the engine worker thread; ``generation`` and
+    ``circuit_open`` are read from server threads (single-word reads, safe
+    under the GIL).
+    """
+
+    def __init__(
+        self,
+        restart_backoff_s: float = 0.5,
+        restart_backoff_max_s: float = 30.0,
+        circuit_threshold: int = 5,
+        circuit_window_s: float = 60.0,
+    ):
+        self.restart_backoff_s = max(0.0, float(restart_backoff_s))
+        self.restart_backoff_max_s = max(
+            self.restart_backoff_s, float(restart_backoff_max_s)
+        )
+        self.circuit_threshold = max(1, int(circuit_threshold))
+        self.circuit_window_s = float(circuit_window_s)
+        self.generation = 0
+        self.circuit_open = False
+        self._failures: "deque[float]" = deque()
+
+    def record_failure(self, now: Optional[float] = None) -> str:
+        """Record one retryable worker failure; returns ``"restart"`` or
+        ``"open"`` (threshold failures inside the sliding window)."""
+        now = time.monotonic() if now is None else now
+        self._failures.append(now)
+        while self._failures and now - self._failures[0] > self.circuit_window_s:
+            self._failures.popleft()
+        if len(self._failures) >= self.circuit_threshold:
+            self.circuit_open = True
+            return "open"
+        return "restart"
+
+    def backoff_delay(self) -> float:
+        """Exponential backoff keyed to in-window failure count: the first
+        failure restarts after ``restart_backoff_s``, each further one
+        doubles it, capped at ``restart_backoff_max_s``."""
+        n = max(0, len(self._failures) - 1)
+        return min(self.restart_backoff_s * (2.0 ** n), self.restart_backoff_max_s)
+
+    def restarted(self) -> None:
+        """The worker rebuilt device state and is serving again."""
+        self.generation += 1
+
+    @property
+    def failure_count(self) -> int:
+        return len(self._failures)
+
+
+class FaultInjector:
+    """Deterministic fault hooks the engine worker polls each tick.
+
+    Armed from any thread, fired on the worker thread; every fire raises
+    ``InjectedFault`` and disarms itself, so "fail k times then heal" is
+    just ``fail_decode_next(k)``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._decode_at: set = set()  # absolute decode step indices
+        self._decode_next = 0
+        self._prefill_next = 0
+
+    def fail_decode_at(self, *steps: int) -> None:
+        """Fail the decode tick whose absolute step index (1-based, counted
+        over the engine's lifetime) matches — "fail decode at step K"."""
+        with self._lock:
+            self._decode_at.update(int(s) for s in steps)
+
+    def fail_decode_next(self, k: int = 1) -> None:
+        """Fail the next ``k`` decode ticks, then heal."""
+        with self._lock:
+            self._decode_next += int(k)
+
+    def fail_prefill_next(self, k: int = 1) -> None:
+        """Fail the next ``k`` prefill operations, then heal."""
+        with self._lock:
+            self._prefill_next += int(k)
+
+    def maybe_fail_decode(self, step_index: int) -> None:
+        with self._lock:
+            if step_index in self._decode_at:
+                self._decode_at.discard(step_index)
+            elif self._decode_next > 0:
+                self._decode_next -= 1
+            else:
+                return
+        raise InjectedFault(f"injected decode failure at step {step_index}")
+
+    def maybe_fail_prefill(self) -> None:
+        with self._lock:
+            if self._prefill_next <= 0:
+                return
+            self._prefill_next -= 1
+        raise InjectedFault("injected prefill failure")

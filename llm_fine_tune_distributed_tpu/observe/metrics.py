@@ -37,8 +37,14 @@ class ServingStats:
     ``requests_completed``, ``requests_abandoned``, ``decode_steps``; the
     paged engine adds ``prompt_tokens`` (prompt tokens admitted),
     ``prefix_tokens_reused`` (of those, served from the prefix cache
-    without a forward pass) and ``prefill_chunks``.
-    Gauges (instantaneous): ``queue_depth``, ``live_slots``, plus paged
+    without a forward pass) and ``prefill_chunks``. Supervision adds
+    ``engine_restarts`` (in-process worker recoveries),
+    ``requests_failed`` (resolved with an error — includes shed and
+    recovery casualties), ``requests_shed_overflow`` (429s from the
+    bounded queue) and ``requests_shed_deadline`` (queue-wait deadline
+    expiries).
+    Gauges (instantaneous): ``queue_depth``, ``live_slots``,
+    ``engine_generation`` (restart epoch), plus paged
     ``blocks_in_use`` / ``peak_blocks_in_use`` / ``prefix_cache_blocks``.
     ``slots`` is the engine's capacity and ``total_blocks`` the usable pool
     size; the snapshot derives ``slot_occupancy`` = live_slots / slots —
@@ -52,9 +58,11 @@ class ServingStats:
         "tokens_served", "requests_admitted", "requests_completed",
         "requests_abandoned", "decode_steps",
         "prompt_tokens", "prefix_tokens_reused", "prefill_chunks",
+        "engine_restarts", "requests_failed",
+        "requests_shed_overflow", "requests_shed_deadline",
     )
     GAUGES = (
-        "queue_depth", "live_slots",
+        "queue_depth", "live_slots", "engine_generation",
         "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
     )
 

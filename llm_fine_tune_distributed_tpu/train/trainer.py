@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -76,6 +78,10 @@ class SFTTrainer:
             config.tokenizer_path or config.model_name
         )
         self.rng = jax.random.PRNGKey(config.seed if rng_seed is None else rng_seed)
+        # preemption flag (SIGTERM / request_preemption): checked at the step
+        # boundary in train(); set -> emergency checkpoint + clean exit so a
+        # JobSet restart resumes instead of losing up to save_steps of work
+        self._preempt = threading.Event()
         # subclasses (DPO) stash extra eval-time scalars here; merged into the
         # metric sinks whenever an eval fires
         self.extra_eval_logs: Dict[str, float] = {}
@@ -837,6 +843,13 @@ class SFTTrainer:
             )
         return mode
 
+    def request_preemption(self) -> None:
+        """Ask the training loop to stop at the next step boundary, write an
+        emergency checkpoint, and return cleanly (exit 0 for the CLI). The
+        SIGTERM handler installed by ``train`` calls this; tests and
+        embedding processes may call it directly from any thread."""
+        self._preempt.set()
+
     def train(self) -> Dict[str, Any]:
         cfg = self.config
         ckpt_dir = os.path.join(cfg.output_dir, "checkpoints")
@@ -908,9 +921,29 @@ class SFTTrainer:
                 cfg.watchdog_timeout_s, cfg.watchdog_action, start_paused=True
             )
 
+        # Preemption safety (k8s node drain / spot reclaim): SIGTERM sets a
+        # flag the loop checks at the step boundary — emergency checkpoint,
+        # clean exit 0, and the JobSet restart resumes from it instead of
+        # replaying up to save_steps of work. Signal handlers can only be
+        # installed on the main thread; elsewhere (tests, embedding servers)
+        # request_preemption() is the entry point.
+        prev_sigterm = None
+        if threading.current_thread() is threading.main_thread():
+            def _on_sigterm(signum, frame):
+                if not self._preempt.is_set() and is_primary_host():
+                    print(
+                        "[train] SIGTERM: checkpointing at the next step "
+                        "boundary, then exiting for restart+resume",
+                        flush=True,
+                    )
+                self.request_preemption()
+
+            prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+
         t_start = time.perf_counter()
         step = int(self.state.step)
         final_loss = None
+        preempted = False
         pending_samples, synced_step = 0, step
 
         try:
@@ -929,6 +962,11 @@ class SFTTrainer:
                     pending_samples += samples_per_step
                     if watchdog is not None:
                         watchdog.poke(step)
+                    if self._preempt.is_set():
+                        # SIGTERM landed: stop HERE, at a step boundary, where
+                        # the state is a consistent (params, opt, step) triple
+                        preempted = True
+                        break
 
                     do_log = (
                         (cfg.logging_first_step and step == 1)
@@ -1020,6 +1058,8 @@ class SFTTrainer:
                         # against the NEXT steady-state interval (the
                         # cumulative rate still includes them)
                         meter.rebase()
+                if preempted:
+                    break
         finally:
             profiler.close()
             if detector is not None:
@@ -1030,6 +1070,39 @@ class SFTTrainer:
                 # repeated train() calls in one process must not accumulate
                 # pollers)
                 watchdog.stop()
+            if prev_sigterm is not None:
+                signal.signal(signal.SIGTERM, prev_sigterm)
+
+        if preempted:
+            # Emergency checkpoint, then get out: the periodic cadence may be
+            # up to save_steps-1 steps stale, and the whole point of catching
+            # SIGTERM is to resume from HERE. Skip final eval / best-model
+            # restore / artifact export — the restarted run finishes those.
+            if ckpt.latest_step != step:
+                self._ckpt_save(
+                    ckpt,
+                    step,
+                    {cfg.metric_for_best_model: last_eval}
+                    if last_eval is not None
+                    else None,
+                )
+            ckpt.wait()
+            wall = time.perf_counter() - t_start
+            if is_primary_host():
+                print(
+                    f"[train] preempted at step {step}: emergency checkpoint "
+                    "saved; exiting cleanly for restart+resume",
+                    flush=True,
+                )
+            ckpt.close()
+            self.metrics.close()
+            return {
+                "preempted": True,
+                "step": step,
+                "final_train_loss": final_loss,
+                "final_eval_loss": last_eval,
+                "wall_clock_seconds": wall,
+            }
 
         # end of training: final checkpoint + optional best-model restore.
         # Refresh the metric when the final step is not an eval boundary:

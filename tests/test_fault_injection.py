@@ -116,3 +116,127 @@ def test_sigkill_then_resume(tmp_path):
     # phase 2 history starts after the resume point (no step trained twice
     # within this run) and reaches the end of epoch 2
     assert steps and steps[0] > resumed_step
+
+
+def test_preemption_flag_checkpoints_and_returns(tmp_path):
+    """request_preemption(): the loop stops at the NEXT step boundary,
+    writes an emergency checkpoint (off the save_steps cadence), and
+    train() returns a reduced summary — and a resumed run picks up exactly
+    after the preempted step."""
+    from test_train_e2e import make_config, qa_parquet  # noqa: F401
+
+    from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    jsonl = tmp_path / "qa.jsonl"
+    with open(jsonl, "w") as f:
+        for i in range(48):
+            f.write(json.dumps({
+                "topic": "Knots",
+                "question": f"question {i}?",
+                "answer": f"answer {i}: word word word",
+            }) + "\n")
+    convert_jsonl_to_parquet(
+        str(jsonl), str(tmp_path / "qa_dataset.parquet"), verbose=False
+    )
+    out = tmp_path / "out"
+    cfg = make_config(
+        out, tmp_path, "qa_dataset.parquet", epochs=1, eval_steps=0,
+        logging_steps=1, save_steps=100,  # cadence never fires: the only
+        use_native_loader=False,          # checkpoint is the emergency one
+    )
+    trainer = SFTTrainer(cfg)
+    trainer.request_preemption()  # preempt before the loop: stops at step 1
+    summary = trainer.train()
+    assert summary["preempted"] is True
+    assert summary["step"] == 1
+
+    from llm_fine_tune_distributed_tpu.train.checkpoints import CheckpointManager
+
+    ckpt = CheckpointManager(str(out / "checkpoints"))
+    assert ckpt.latest_step == 1  # emergency save, off the 100-step cadence
+    ckpt.close()
+
+    resume_cfg = make_config(
+        out, tmp_path, "qa_dataset.parquet", epochs=1, eval_steps=0,
+        logging_steps=1, save_steps=100, use_native_loader=False,
+        resume_from_checkpoint="latest",
+    )
+    resumed = SFTTrainer(resume_cfg)
+    summary2 = resumed.train()
+    assert "preempted" not in summary2  # ran to completion this time
+    steps = [h["step"] for h in resumed.metrics.history if "step" in h]
+    assert steps and steps[0] == 2  # no step trained twice
+
+
+@pytest.mark.slow
+def test_sigterm_drains_to_checkpoint_and_exits_zero(tmp_path):
+    """SIGTERM mid-training (the JobSet drain signal): the run writes an
+    emergency checkpoint at the step boundary and exits 0 — then a restart
+    with resume continues from that exact step."""
+    from llm_fine_tune_distributed_tpu.data.convert import convert_jsonl_to_parquet
+
+    jsonl = tmp_path / "qa.jsonl"
+    with open(jsonl, "w") as f:
+        for i in range(64):
+            f.write(json.dumps({
+                "topic": "Knots",
+                "question": f"question {i}?",
+                "answer": f"answer {i}: " + "word " * (3 + i % 4),
+            }) + "\n")
+    convert_jsonl_to_parquet(
+        str(jsonl), str(tmp_path / "qa_dataset.parquet"), verbose=False
+    )
+    out = tmp_path / "outputs"
+    cfg = {
+        "model_name": "tiny-random",
+        "model_preset": "tiny",
+        "tokenizer_path": "byte-chatml",
+        "system_prompt": "You are an expert.",
+        "data_dir": str(tmp_path),
+        "dataset_file": "qa_dataset.parquet",
+        "output_dir": str(out),
+        "epochs": 2,
+        "per_device_batch_size": 2,
+        "gradient_accumulation_steps": 1,
+        "learning_rate": 2e-3,
+        "max_seq_length": 128,
+        "eval_steps": 100,
+        "logging_steps": 1,
+        "save_steps": 100,  # cadence never fires before the signal lands
+        "mesh": {"data": 1, "fsdp": 2, "tensor": 1, "seq": 1},
+        "use_native_loader": False,
+    }
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+
+    # ---- phase 1: run a few steps, then SIGTERM (graceful, unlike SIGKILL)
+    proc = _launch(cfg_path, resume=False)
+    deadline = time.time() + 420
+    lines = []
+    for line in proc.stdout:
+        lines.append(line)
+        if "step=" in line:
+            step = int(line.split("step=")[1].split(",")[0])
+            if step >= 3:
+                proc.send_signal(signal.SIGTERM)
+                break
+        if time.time() > deadline:
+            proc.kill()
+            pytest.fail("phase 1 never reached step 3")
+    rest, _ = proc.communicate(timeout=180)
+    lines.append(rest)
+    output = "".join(lines)
+    assert proc.returncode == 0, f"SIGTERM exit was not clean:\n{output[-4000:]}"
+    assert "preempted at step" in output
+    ckpts = os.listdir(out / "checkpoints")
+    assert any(d.isdigit() for d in ckpts), ckpts
+
+    # ---- phase 2: restart with resume continues from the emergency save
+    proc2 = _launch(cfg_path, resume=True)
+    stdout, _ = proc2.communicate(timeout=420)
+    assert proc2.returncode == 0, f"resume run failed:\n{stdout[-4000:]}"
+    assert "Resumed from checkpoint step" in stdout
+    resumed_step = int(stdout.split("Resumed from checkpoint step")[1].split()[0])
+    assert resumed_step >= 3  # the emergency save, not an earlier cadence one
+    assert (out / "training_summary.json").exists()

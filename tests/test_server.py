@@ -265,3 +265,173 @@ def test_stats_endpoint_window_engine(model_dir):
         stats = json.loads(r.read())
     assert stats["engine"] == "window"
     assert "queue_depth" in stats
+
+
+# ------------------------------------------------- self-healing + drain
+
+
+def _start_controlled(model_dir, **serve_kwargs):
+    """_start_server variant returning (base, serve_thread, control): the
+    control dict carries the drain entry points, since a signal handler
+    can only be installed on the main thread (not a test worker)."""
+    from llm_fine_tune_distributed_tpu.infer.server import serve
+
+    control = {}
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    t = threading.Thread(
+        target=serve, args=(model_dir, "127.0.0.1", port),
+        kwargs={"control": control, **serve_kwargs}, daemon=True,
+    )
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"{base}/healthz", timeout=2) as r:
+                if r.status == 200:
+                    return base, t, control
+        except OSError:
+            time.sleep(0.25)
+    raise RuntimeError("server did not become healthy")
+
+
+def _post(base, path, body, timeout=120):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_drain_finishes_in_flight_and_exits(model_dir):
+    """The SIGTERM path: drain flips /healthz to 503 draining, sheds new
+    admissions with 503 + Retry-After, lets the in-flight request finish,
+    and returns from serve() (process exit 0) within the drain timeout."""
+    base, serve_thread, control = _start_controlled(
+        model_dir, drain_timeout_s=60.0
+    )
+    answers = []
+    inflight = threading.Thread(
+        target=lambda: answers.append(json.loads(_post(
+            base, "/v1/generate",
+            {"question": "q?", "max_new_tokens": 48, "greedy": True},
+        ).read())["answer"])
+    )
+    inflight.start()
+    time.sleep(0.3)  # let it admit
+    control["begin_drain"]()  # what the SIGTERM handler calls
+
+    with pytest.raises(urllib.error.HTTPError) as he:
+        urllib.request.urlopen(f"{base}/healthz", timeout=10)
+    assert he.value.code == 503
+    assert json.loads(he.value.read())["status"] == "draining"
+    assert int(he.value.headers["Retry-After"]) >= 1
+
+    with pytest.raises(urllib.error.HTTPError) as pe:
+        _post(base, "/v1/generate",
+              {"question": "late?", "max_new_tokens": 4, "greedy": True},
+              timeout=30)
+    assert pe.value.code == 503
+    assert json.loads(pe.value.read())["error"]["kind"] == "draining"
+    assert int(pe.value.headers["Retry-After"]) >= 1
+
+    inflight.join(timeout=180)
+    assert answers and isinstance(answers[0], str)  # in-flight unharmed
+    serve_thread.join(timeout=120)
+    assert not serve_thread.is_alive()  # serve() returned -> clean exit 0
+
+
+def test_queue_overflow_maps_to_429(model_dir):
+    """Admission-queue overflow surfaces as HTTP 429 with a finite integer
+    Retry-After header and a structured queue_overflow body."""
+    base, _, control = _start_controlled(
+        model_dir, slots=1, max_queue_depth=1
+    )
+    body = {"question": "q?", "max_new_tokens": 256, "greedy": True}
+    holders = [
+        threading.Thread(target=lambda: _post(base, "/v1/generate", body).read())
+        for _ in range(2)
+    ]
+    holders[0].start()  # occupies the single slot
+    # wait until it is actually admitted before queueing the second
+    engine = control["cont_engine"]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if engine.stats_snapshot()["live_slots"] >= 1:
+            break
+        time.sleep(0.02)
+    holders[1].start()  # fills the depth-1 queue
+    while time.time() < deadline:
+        if engine.stats_snapshot()["queue_depth"] >= 1:
+            break
+        time.sleep(0.02)
+    with pytest.raises(urllib.error.HTTPError) as he:
+        _post(base, "/v1/generate",
+              {"question": "third?", "max_new_tokens": 4, "greedy": True},
+              timeout=30)
+    assert he.value.code == 429
+    err = json.loads(he.value.read())["error"]
+    assert err["kind"] == "queue_overflow"
+    assert err["retryable"] is True
+    assert int(he.value.headers["Retry-After"]) >= 1
+    for t in holders:
+        t.join(timeout=180)
+
+
+def test_stream_emits_error_event_then_engine_recovers(model_dir):
+    """A decode failure mid-stream ends the SSE body with a terminal
+    ``event: error`` chunk (structured, not silent truncation) — and the
+    supervised engine serves the next request normally."""
+    base, _, control = _start_controlled(
+        model_dir, restart_backoff_s=0.01, restart_backoff_max_s=0.02
+    )
+    # warm the jit caches so the fault lands in steady-state decode
+    _post(base, "/v1/generate",
+          {"question": "warm?", "max_new_tokens": 4, "greedy": True}).read()
+    control["cont_engine"].faults.fail_decode_next(1)
+    with _post(base, "/v1/stream",
+               {"question": "q?", "max_new_tokens": 16, "greedy": True}) as r:
+        assert r.status == 200  # headers were already committed
+        raw = r.read().decode()
+    assert "event: error" in raw
+    lines = raw.splitlines()
+    err = json.loads(lines[lines.index("event: error") + 1][len("data: "):])
+    assert err["kind"] == "engine_restarting"
+    assert err["retryable"] is True
+    # recovered in-process: the next request decodes fine
+    answer = json.loads(_post(
+        base, "/v1/generate",
+        {"question": "after?", "max_new_tokens": 4, "greedy": True},
+    ).read())["answer"]
+    assert isinstance(answer, str)
+    assert control["cont_engine"].stats_snapshot()["engine_restarts"] >= 1
+
+
+def test_healthz_unhealthy_once_circuit_opens(model_dir):
+    """circuit_threshold=1: the first decode failure opens the breaker, the
+    engine goes terminally unhealthy, and /healthz reports 503 with the
+    structured terminal error — the orchestrator's recycle signal."""
+    base, _, control = _start_controlled(
+        model_dir, circuit_threshold=1, restart_backoff_s=0.01
+    )
+    _post(base, "/v1/generate",
+          {"question": "warm?", "max_new_tokens": 4, "greedy": True}).read()
+    control["cont_engine"].faults.fail_decode_next(1)
+    with pytest.raises(urllib.error.HTTPError) as pe:
+        _post(base, "/v1/generate",
+              {"question": "q?", "max_new_tokens": 16, "greedy": True},
+              timeout=60)
+    assert pe.value.code == 503
+    assert json.loads(pe.value.read())["error"]["kind"] == "circuit_open"
+    deadline = time.time() + 30
+    while time.time() < deadline and control["cont_engine"].healthy:
+        time.sleep(0.02)
+    with pytest.raises(urllib.error.HTTPError) as he:
+        urllib.request.urlopen(f"{base}/healthz", timeout=10)
+    assert he.value.code == 503
+    body = json.loads(he.value.read())
+    assert body["status"] == "unhealthy"
+    assert body["circuit_state"] == "open"
+    assert body["error"]["kind"] == "circuit_open"
