@@ -55,12 +55,18 @@ class Request:
     # request instead of decoding for nobody (a recovered device would
     # otherwise burn minutes on dead work before serving live traffic)
     abandoned: bool = False
-    # speculative-decoding telemetry of the batch this request rode in
-    # (None unless the request asked for speculation): read off the
-    # Generator right after ITS generate_batch call on the worker thread,
-    # so a later batch cannot overwrite it
+    # speculative-decoding telemetry, PER REQUEST: this row's/slot's own
+    # proposed and accepted draft-token counts, and its acceptance rate
+    # (spec_acceptance = accepted / proposed; None unless the request asked
+    # for speculation). spec_steps stays batch-global where it exists at
+    # all (the window engine's sequential-forward count is a property of
+    # the whole batch, not of one row); the continuous engines leave it
+    # None. Set on the worker thread right after the request's own batch or
+    # finishing tick, so a later batch cannot overwrite it.
     spec_acceptance: Optional[float] = None
     spec_steps: Optional[int] = None
+    draft_tokens_proposed: int = 0
+    draft_tokens_accepted: int = 0
     # continuous engine only: when set, every decoded token is ALSO pushed
     # here as it is emitted (None terminates the stream) — per-request SSE
     # streaming while the request rides a shared decode batch
@@ -249,12 +255,27 @@ class BatchingEngine:
                 results = self._generator.generate_batch(
                     prompts, first.gen, seed=first.seed, live_rows=n_live
                 )
-                rate = getattr(self._generator, "last_acceptance_rate", None)
+                # per-row attribution: live request i rode row i (pads sit
+                # past n_live), so each request reports ITS OWN draft counts
+                # instead of the batch-global rate every rider used to get
                 steps = getattr(self._generator, "last_spec_steps", None)
-                for p, r in zip(batch, results):
+                row_prop = getattr(
+                    self._generator, "last_row_draft_proposed", None
+                )
+                row_acc = getattr(
+                    self._generator, "last_row_draft_accepted", None
+                )
+                for i, (p, r) in enumerate(zip(batch, results)):
                     p.result = r
-                    p.spec_acceptance = rate
                     p.spec_steps = steps
+                    if row_prop is not None:
+                        p.draft_tokens_proposed = int(row_prop[i])
+                        p.draft_tokens_accepted = int(row_acc[i])
+                        p.spec_acceptance = (
+                            p.draft_tokens_accepted / p.draft_tokens_proposed
+                            if p.draft_tokens_proposed
+                            else 0.0
+                        )
             except BaseException as e:  # resolve waiters even on failure
                 for p in batch:
                     p.error = e

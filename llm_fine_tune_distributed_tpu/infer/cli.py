@@ -87,9 +87,12 @@ def run_ask_cli(
         # ignored arguments instead of starting a misconfigured-looking server
         if question:
             parser.error("--serve takes no question (clients POST /v1/generate)")
+        # --speculative is NOT in this list: with --serve it configures the
+        # engine-level fused draft+verify tick (server.py speculative_k),
+        # while requests still opt in per-call with 'speculative': K
         sampling_flags = (
             "max_new_tokens", "temperature", "top_p", "top_k",
-            "repetition_penalty", "greedy", "seed", "speculative",
+            "repetition_penalty", "greedy", "seed",
         )
         ignored = [
             f"--{k.replace('_', '-')}" for k in sampling_flags
@@ -106,6 +109,7 @@ def run_ask_cli(
             args.model_dir, host=args.host, port=args.port,
             quantize=args.quantize, template_kwargs=template_kwargs,
             tp=args.tp, draft_dir=args.draft_dir,
+            speculative_k=args.speculative,
         )
         return 0
     if not question:

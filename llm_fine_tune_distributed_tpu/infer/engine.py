@@ -107,6 +107,32 @@ from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
 from llm_fine_tune_distributed_tpu.runtime.watchdog import StepWatchdog
 
 
+def _prompt_lookup(ctx: np.ndarray, k: int) -> np.ndarray:
+    """Prompt-lookup draft proposal, host-side: the continuation of an
+    EARLIER occurrence of the context's trailing bigram (the numpy twin of
+    the solo decoder's on-device ``lookup_draft``, infer/generate.py).
+    Among the matches it prefers the most recent one whose continuation
+    holds a FULL ``k`` tokens: when generation loops with a period shorter
+    than ``k`` (exactly the traffic speculation pays off on), the very
+    latest match sits flush against the end of the context and would
+    truncate the draft to ~1 token — an earlier period of the same loop
+    yields the identical continuation at full length. Falls back to the
+    latest (truncated) match, and returns an empty array when no bigram
+    repeats — the engine then runs the slot as a plain 1-token step. Any
+    draft is SAFE (verification re-derives every token); lookup quality
+    only moves the acceptance rate."""
+    n = ctx.size
+    if n < 3:
+        return ctx[:0]
+    l0, l1 = ctx[-2], ctx[-1]
+    starts = np.flatnonzero((ctx[:-2] == l0) & (ctx[1:-1] == l1))
+    if starts.size == 0:
+        return ctx[:0]
+    full = starts[starts + 2 + k <= n]
+    j = int(full[-1]) if full.size else int(starts[-1])
+    return ctx[j + 2 : j + 2 + k]
+
+
 class ContinuousBatchingEngine:
     """S-slot persistent decode loop with in-flight FIFO admission."""
 
@@ -126,6 +152,7 @@ class ContinuousBatchingEngine:
         watchdog_timeout_s: float = 0.0,
         watchdog: Optional[StepWatchdog] = None,
         faults: Optional[FaultInjector] = None,
+        speculative_k: int = 0,
     ):
         if getattr(generator, "_multihost", False):
             raise ValueError(
@@ -180,6 +207,17 @@ class ContinuousBatchingEngine:
         self._state = None
         self._decode_index = 0  # absolute decode-tick count, engine lifetime
         self._eos = set(getattr(generator, "eos_token_ids", ()) or ())
+        # speculative decoding: engine-level draft depth K. When K > 0 EVERY
+        # tick runs the fused draft+verify step (slots that propose nothing
+        # reduce to the plain 1-token step inside the same program) so each
+        # live slot consumes a fixed K+2 RNG subkeys per tick — sampled
+        # streams stay deterministic in (request, seed, engine K) no matter
+        # which neighbors speculate or how many drafts get accepted.
+        self._spec_k = max(0, int(speculative_k))
+        self._use_draft = self._spec_k > 0 and bool(
+            getattr(generator, "has_draft", False)
+        )
+        self._dcache = None  # draft model's per-slot cache (worker-only)
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -418,16 +456,34 @@ class ContinuousBatchingEngine:
         is an allocation + a couple of dispatches — not a recompilation."""
         gen = self._generator
         self._cache, self._state = gen.init_slot_state(self._slots, self._buf_len)
+        self._startup_draft()
+
+    def _startup_draft(self) -> None:
+        """(Re)build the draft model's per-slot cache. Its contents die with
+        the worker state exactly like the target cache; requeued requests
+        re-prefill both on the next admission, so PR 3 recovery semantics
+        are unchanged by speculation."""
+        if self._use_draft:
+            self._dcache = self._generator.init_draft_slot_cache(
+                self._slots, self._buf_len
+            )
 
     def _serve_loop(self) -> None:
-        step = self._generator.slot_step(self._slots, self._buf_len)
+        if self._spec_k > 0:
+            step = self._generator.spec_slot_step(
+                self._slots, self._buf_len, self._spec_k
+            )
+            decode = lambda: self._decode_once_spec(step)  # noqa: E731
+        else:
+            step = self._generator.slot_step(self._slots, self._buf_len)
+            decode = lambda: self._decode_once(step)  # noqa: E731
         while True:
             self._admit()
             if not self._live.any():
                 # idle: block until traffic instead of spinning
                 self._handle_new(self._idle_get())
                 continue
-            self._decode_once(step)
+            decode()
 
     def _idle_get(self) -> Request:
         """Blocking queue read with the watchdog disarmed: an empty queue is
@@ -570,6 +626,13 @@ class ContinuousBatchingEngine:
         )
         if self._watchdog is not None:
             self._watchdog.poke(self._decode_index)
+        if self._use_draft and req.gen.speculative_lookup > 0:
+            # mirror the prompt into the draft model's dense row so its
+            # first drafting tick sees the same context as the target
+            dprefill = gen.draft_slot_prefill(bucket)
+            self._dcache = dprefill(
+                gen.draft_params, self._dcache, padded, np.int32(slot)
+            )
         self._slot_req[slot] = req
         self._slot_tokens[slot] = []
         # the budget honors max_new_tokens but never the buffer's end: the
@@ -601,6 +664,111 @@ class ContinuousBatchingEngine:
                 continue
             self._emit_token(slot, req, int(toks[slot]))
 
+    # ------------------------------------------------------------ speculative
+
+    def _slot_ctx(self, slot: int) -> np.ndarray:
+        """The slot's full token context (prompt + accepted generations).
+        Its length - 1 equals the device-side ``pos`` for the slot."""
+        req = self._slot_req[slot]
+        return np.asarray(list(req.prompt) + self._slot_tokens[slot], np.int32)
+
+    def _spec_want(self, slot: int) -> int:
+        """Draft depth this slot asks for this tick: the request's K capped
+        by the engine's compiled K; 0 for dead slots and non-spec requests."""
+        req = self._slot_req[slot]
+        if req is None or not self._live[slot]:
+            return 0
+        return min(int(req.gen.speculative_lookup), self._spec_k)
+
+    def _propose_drafts(self):
+        """Host-side drafting for one tick: ``(drafts [S,K], n_draft [S])``.
+
+        Prompt-lookup by default; the attached draft model when configured.
+        Rows with ``n_draft == 0`` carry garbage draft tokens — harmless,
+        because the verify step treats every position ``>= n_draft`` as a
+        bonus position (the draft token is ignored there)."""
+        k = self._spec_k
+        drafts = np.zeros((self._slots, k), np.int32)
+        n_draft = np.zeros((self._slots,), np.int32)
+        if self._use_draft:
+            window = np.zeros((self._slots, k + 1), np.int32)
+            start = np.zeros((self._slots,), np.int32)
+            for slot in range(self._slots):
+                want = self._spec_want(slot)
+                if want <= 0:
+                    continue
+                ctx = self._slot_ctx(slot)
+                s0 = max(ctx.size - 1 - k, 0)
+                win = ctx[s0 : s0 + k + 1]
+                window[slot, : win.size] = win
+                start[slot] = s0
+                n_draft[slot] = want
+            if n_draft.any():
+                gen = self._generator
+                dstep = gen.draft_slot_step(self._slots, k)
+                self._dcache, dbuf = dstep(
+                    gen.draft_params, self._dcache, self._state, window, start
+                )
+                drafts = np.asarray(dbuf).astype(np.int32)
+            return drafts, n_draft
+        for slot in range(self._slots):
+            want = self._spec_want(slot)
+            if want <= 0:
+                continue
+            found = _prompt_lookup(self._slot_ctx(slot), want)
+            if found.size:
+                drafts[slot, : found.size] = found
+                n_draft[slot] = int(found.size)
+        return drafts, n_draft
+
+    def _decode_once_spec(self, step) -> None:
+        """One fused speculative tick: draft on host (or draft model), then
+        ONE jitted target forward verifies all slots' K+1 positions and
+        emits each slot's accepted prefix + one model-sampled token."""
+        gen = self._generator
+        self._decode_index += 1
+        self.faults.maybe_fail_decode(self._decode_index)
+        drafts, n_draft = self._propose_drafts()
+        self._cache, self._state, toks, n_emit = step(
+            gen.params, self._cache, self._state, self._live.copy(),
+            drafts, n_draft,
+        )
+        toks = np.asarray(toks)  # the host sync a wedged link would hang
+        n_emit = np.asarray(n_emit)
+        if self._watchdog is not None:
+            self._watchdog.poke(self._decode_index)
+        self.stats.incr("decode_steps")
+        self._emit_spec(toks, n_emit, n_draft)
+
+    def _emit_spec(self, toks: np.ndarray, n_emit: np.ndarray,
+                   n_draft: np.ndarray) -> None:
+        """Emit each slot's verified run in order. Shared by both engines.
+
+        Per-tick accepted-draft count is ``n_emit - 1``: a live slot always
+        emits its model-sampled token (the rejection replacement or the
+        bonus), so everything before it is an accepted draft."""
+        for slot in range(self._slots):
+            req = self._slot_req[slot]
+            if req is None or not self._live[slot]:
+                continue
+            if req.abandoned:
+                # mid-flight timeout: shed the slot so live traffic refills it
+                self._settle_abandoned(req)
+                self._release(slot)
+                continue
+            proposed = int(n_draft[slot])
+            m = int(n_emit[slot])
+            if proposed:
+                accepted = max(m - 1, 0)
+                req.draft_tokens_proposed += proposed
+                req.draft_tokens_accepted += accepted
+                self.stats.incr("draft_tokens_proposed", proposed)
+                self.stats.incr("draft_tokens_accepted", accepted)
+            for j in range(m):
+                self._emit_token(slot, req, int(toks[slot, j]))
+                if self._slot_req[slot] is not req:
+                    break  # EOS or budget finished the request mid-run
+
     def _emit_token(self, slot: int, req: Request, tok: int) -> None:
         if tok in self._eos:
             self._finish(slot, req)
@@ -614,6 +782,12 @@ class ContinuousBatchingEngine:
 
     def _finish(self, slot: int, req: Request) -> None:
         req.result = self._slot_tokens[slot]
+        if req.draft_tokens_proposed:
+            req.spec_acceptance = (
+                req.draft_tokens_accepted / req.draft_tokens_proposed
+            )
+        elif self._spec_k > 0 and req.gen.speculative_lookup > 0:
+            req.spec_acceptance = 0.0  # asked to speculate, nothing drafted
         if req.tokens_q is not None:
             req.tokens_q.put(None)
         if req.enqueued_at:
@@ -701,8 +875,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._block_len = max(1, int(block_len))
         bucket = max(1, int(prompt_bucket))
         # table width: enough blocks to cover buf_len PLUS the final prefill
-        # chunk's pad bucket (write_end <= plen - 1 + bucket <= buf_len + Г)
-        self._table_blocks = -(-(int(buf_len) + bucket) // self._block_len)
+        # chunk's pad bucket (write_end <= plen - 1 + bucket <= buf_len + Г).
+        # With speculation the verify forward also writes K positions past a
+        # slot's last emitted token (pos + 1 .. pos + K, pos <= buf_len - 2),
+        # so the table must additionally cover buf_len - 2 + K — widen the
+        # slack to max(bucket, K + 1). Unlike the dense cache, paged writes
+        # past the allocation would NOT drop: the block index clips into the
+        # slot's LAST real block (models/transformer.py), corrupting live KV.
+        spec_k = max(0, int(kwargs.get("speculative_k", 0) or 0))
+        slack = max(bucket, spec_k + 1) if spec_k else bucket
+        self._table_blocks = -(-(int(buf_len) + slack) // self._block_len)
         self._prefill_chunk = max(1, int(prefill_chunk))
         if num_blocks is None:
             # full tables for every slot + one table's worth of prefix-cache
@@ -741,6 +923,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._cache, self._state = gen.init_paged_state(
             self._slots, self._num_blocks, self._block_len
         )
+        self._startup_draft()
 
     def _serve_loop(self) -> None:
         while True:
@@ -843,7 +1026,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         shared = self._prefix.match(keys, (plen - 1) // L)
         shared_len = len(shared) * L
         _, _, _, write_end = self._chunk_plan(plen, shared_len)
-        total = -(-max(budget_end, write_end) // L)
+        # speculation headroom: a verify tick at the last in-budget position
+        # (pos = budget_end - 2) writes drafts + bonus up to budget_end + K - 1,
+        # so reserve through budget_end + K. +1 more keeps the bound simple
+        # and covers the bonus position's own write — all-or-nothing at
+        # admission, so a live slot can never clip into a real block.
+        spec_pad = (self._spec_k + 1) if self._spec_k else 0
+        total = -(-max(budget_end + spec_pad, write_end) // L)
         usable = self._allocator.num_blocks - 1
         if total > usable:
             for bid in shared:
@@ -950,6 +1139,19 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.stats.incr("prefill_chunks")
         if self._watchdog is not None:
             self._watchdog.poke(self._decode_index)
+        if self._use_draft and req.gen.speculative_lookup > 0:
+            # the draft model keeps a DENSE per-slot cache even under the
+            # paged target engine (it is small by construction); mirror the
+            # whole prompt into its row now that the prompt is fully known
+            dbucket = min(
+                -(-task.plen // self._bucket) * self._bucket, self._buf_len
+            )
+            dpad = np.zeros((1, dbucket), np.int32)
+            dpad[0, : task.plen] = req.prompt
+            dprefill = gen.draft_slot_prefill(dbucket)
+            self._dcache = dprefill(
+                gen.draft_params, self._dcache, dpad, np.int32(task.slot)
+            )
         # register the prompt's FULL blocks for reuse BEFORE emitting (the
         # first token may already finish the request and free the slot)
         full = task.plen // self._block_len
@@ -957,24 +1159,36 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._live[task.slot] = True
         self._emit_token(task.slot, req, int(first))
 
-    def _decode_tick(self) -> None:
-        gen = self._generator
+    def _decode_bucket(self, lookahead: int) -> int:
+        """Power-of-two block-count bucket covering every live slot's
+        blocks-in-use, with ``lookahead`` extra positions of visibility
+        (speculative verify reads/writes up to pos + K)."""
         L = self._block_len
         in_use = 1
         for slot in range(self._slots):
             if self._live[slot]:
                 pos = self._slot_plen[slot] + len(self._slot_tokens[slot]) - 1
-                in_use = max(in_use, pos // L + 1)
+                in_use = max(in_use, (pos + lookahead) // L + 1)
         nb = 1
         while nb < in_use:
             nb *= 2
-        nb = min(nb, self._table_blocks)
+        return min(nb, self._table_blocks)
+
+    def _decode_tables(self, nb: int) -> np.ndarray:
         # dead rows decode with all-null tables: their frozen-position
         # writes land in null-block garbage, never in a reassigned block
-        tables = np.ascontiguousarray(
+        return np.ascontiguousarray(
             np.where(self._live[:, None], self._table, NULL_BLOCK)[:, :nb]
         )
-        step = gen.paged_step(self._slots, nb, L)
+
+    def _decode_tick(self) -> None:
+        if self._spec_k > 0:
+            self._decode_tick_spec()
+            return
+        gen = self._generator
+        nb = self._decode_bucket(0)
+        tables = self._decode_tables(nb)
+        step = gen.paged_step(self._slots, nb, self._block_len)
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         self._cache, self._state, toks = step(
@@ -994,6 +1208,30 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self._release(slot)
                 continue
             self._emit_token(slot, req, int(toks[slot]))
+
+    def _decode_tick_spec(self) -> None:
+        """Speculative paged tick: same fused draft+verify as the dense
+        engine, with writes routed through block tables. The nb bucket gets
+        K positions of lookahead so verify queries can see (and write) up to
+        pos + K inside the mapped table view."""
+        gen = self._generator
+        nb = self._decode_bucket(self._spec_k)
+        tables = self._decode_tables(nb)
+        self._decode_index += 1
+        self.faults.maybe_fail_decode(self._decode_index)
+        drafts, n_draft = self._propose_drafts()
+        step = gen.spec_paged_step(self._slots, nb, self._block_len, self._spec_k)
+        self._cache, self._state, toks, n_emit = step(
+            gen.params, self._cache, self._state, self._live.copy(), tables,
+            drafts, n_draft,
+        )
+        toks = np.asarray(toks)
+        n_emit = np.asarray(n_emit)
+        if self._watchdog is not None:
+            self._watchdog.poke(self._decode_index)
+        self.stats.incr("decode_steps")
+        self.stats.gauge_max("peak_blocks_in_use", self._allocator.used_count)
+        self._emit_spec(toks, n_emit, n_draft)
 
     # ------------------------------------------------------------- plumbing
 

@@ -22,6 +22,7 @@ import numpy as np
 from llm_fine_tune_distributed_tpu.config import ModelConfig
 from llm_fine_tune_distributed_tpu.infer.sampling import (
     GenerationConfig,
+    rejection_sample_step_traced,
     sample_token,
     sample_token_traced,
 )
@@ -153,9 +154,24 @@ class Generator:
         self._jit_cache = {}
         # sequential-forward count + draft acceptance rate of the last
         # speculative run (telemetry; None when the last call took the plain
-        # batch path)
+        # batch path). The per-row arrays attribute each LIVE row's own
+        # proposed/accepted draft counts so the window batcher can report
+        # per-request numbers instead of pinning the batch-global rate on
+        # every rider (infer/batching.py).
         self.last_spec_steps: Optional[int] = None
         self.last_acceptance_rate: Optional[float] = None
+        self.last_row_draft_proposed: Optional[np.ndarray] = None
+        self.last_row_draft_accepted: Optional[np.ndarray] = None
+
+    @property
+    def has_draft(self) -> bool:
+        """True when a draft model is attached (speculation drafts with it)."""
+        return self._draft_params is not None
+
+    @property
+    def draft_params(self):
+        """Draft-model params pytree (operand for the engine draft step)."""
+        return self._draft_params
 
     # ------------------------------------------------------------- jit build
 
@@ -850,6 +866,297 @@ class Generator:
 
         return final_chunk
 
+    # ----------------------------------------- speculative continuous decode
+
+    # Fused verify-tick programs for the continuous engines (infer/engine.py
+    # with ``speculative_k > 0``): every tick, each live slot's
+    # ``[last, d_1..d_K]`` goes through ONE target forward at that slot's own
+    # vector cache_pos, and a K+1-position sequential verify
+    # (rejection_sample_step_traced, per-slot traced knobs) accepts a
+    # variable per-slot prefix. A slot with ``n_draft == 0`` reduces exactly
+    # to the plain step: position 0 is its bonus sample, positions 1..K are
+    # never taken — so mixed spec/non-spec traffic shares the fused program
+    # and greedy non-spec slots stay bit-identical to solo decode.
+    #
+    # RNG discipline: every live slot consumes EXACTLY K+2 subkeys per tick
+    # (one chain key + one per verify position), independent of its own or
+    # any neighbor's draft/acceptance counts — so a sampled request's stream
+    # depends only on (request seed, engine K), never on co-residents.
+    #
+    # EOS/budget are settled HOST-side: the device reports the emitted run
+    # ``toks [S, K+1]`` / ``n_emit [S]`` (EOS gates further takes within the
+    # tick) and the engine truncates, finishes, and releases. Positions a
+    # rejected draft wrote are rolled back for free: dense, they sit above
+    # the slot's new position (masked) until the next tick's writes cover
+    # them (slot == position invariant); paged, the engine slices tables
+    # wide enough for pos+K and budgets K+1 spare positions per slot so
+    # verify writes land in the slot's own blocks (never a neighbor's — see
+    # PagedContinuousBatchingEngine._plan).
+
+    def spec_slot_step(self, slots: int, buf_len: int, k: int):
+        """Jitted fused draft-verify step, dense cache (cached per shape)."""
+        key = ("spec_slot_step", slots, buf_len, k)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_spec_slot_step(slots, buf_len, k)
+        return self._jit_cache[key]
+
+    def spec_paged_step(self, slots: int, nb: int, block_len: int, k: int):
+        """Jitted fused draft-verify step, paged pool (cached per table width)."""
+        key = ("spec_paged_step", slots, nb, block_len, k)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_spec_paged_step(
+                slots, nb, block_len, k
+            )
+        return self._jit_cache[key]
+
+    def _build_spec_verify(self, slots: int, K: int):
+        """The shared verify tail of both fused spec steps: logits for all
+        K+1 positions of every slot -> (emitted run, per-slot counts, state
+        advance pieces). Factored so dense and paged steps cannot drift."""
+        eos = jnp.asarray(self.eos_token_ids, jnp.int32) if self.eos_token_ids else None
+
+        def is_eos(tok):
+            return jnp.isin(tok, eos) if eos is not None else jnp.zeros_like(tok, bool)
+
+        def verify_all(state, live, drafts, n_draft, logits_all, splits):
+            rows = jnp.arange(slots)
+            seen = state["seen"]
+            toks = jnp.full((slots, K + 1), -1, jnp.int32)
+            last = state["last"]
+            n_emit = jnp.zeros((slots,), jnp.int32)
+            active = live
+            done = jnp.zeros((slots,), bool)
+
+            def verify(i, c):
+                seen, toks, last, n_emit, active, done = c
+                d = drafts[:, jnp.minimum(i, K - 1)]
+                tok, accepted = rejection_sample_step_traced(
+                    splits[:, i + 1], logits_all[:, i], seen, d,
+                    temperature=state["temperature"], top_p=state["top_p"],
+                    top_k=state["top_k"],
+                    repetition_penalty=state["repetition_penalty"],
+                    do_sample=state["do_sample"], bonus=i >= n_draft,
+                )
+                take = active & ~done
+                seen = jnp.where(
+                    take[:, None], seen.at[rows, tok].set(True), seen
+                )
+                toks = toks.at[:, i].set(jnp.where(take, tok, -1))
+                last = jnp.where(take, tok, last)
+                n_emit = n_emit + take.astype(jnp.int32)
+                done = done | (take & is_eos(tok))
+                # position i+1's draft is only consumable if position i
+                # accepted ITS draft (a bonus/replacement token ends the run)
+                active = active & accepted & (i < n_draft)
+                return (seen, toks, last, n_emit, active, done)
+
+            seen, toks, last, n_emit, _, _ = jax.lax.fori_loop(
+                0, K + 1, verify, (seen, toks, last, n_emit, active, done)
+            )
+            return seen, toks, last, n_emit
+
+        return verify_all
+
+    def _build_spec_slot_step(self, slots: int, buf_len: int, K: int):
+        """Fused draft-verify decode step over the dense shared cache.
+
+        The forward writes positions pos..pos+K per row (vector cache_pos,
+        multi-token row — models/transformer.py's existing per-row scatter);
+        position pos is ``last``'s K/V rewrite-in-place (same values), pos+i
+        holds draft i-1. Rejected-draft writes need no cleanup: they sit at
+        positions > the slot's advanced ``pos`` (always masked) and the next
+        tick's writes start at the new pos, covering them before any query
+        climbs past. Writes past ``buf_len`` (only possible on a slot's
+        final tick before the host finishes it at budget) are dropped by the
+        scatter's out-of-bounds rule — never clipped onto live cells.
+        """
+        mc = self.config
+        dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
+        verify_all = self._build_spec_verify(slots, K)
+
+        @jax.jit
+        def step(params, cache, state, live, drafts, n_draft):
+            last, pos = state["last"], state["pos"]
+            inputs = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, K+1]
+            hidden, cache = forward(
+                params, inputs, mc, cache=cache, cache_pos=pos,
+                compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+            )
+            logits_all = unembed(params, hidden, mc, compute_dtype=dtype, mesh=mesh)
+            splits = jax.vmap(lambda r: jax.random.split(r, K + 2))(state["rng"])
+            seen, toks, new_last, n_emit = verify_all(
+                state, live, drafts, n_draft, logits_all, splits
+            )
+            new_state = dict(
+                state,
+                last=new_last,
+                pos=jnp.where(live, jnp.minimum(pos + n_emit, buf_len - 1), pos),
+                seen=seen,
+                rng=jnp.where(live[:, None], splits[:, 0], state["rng"]),
+            )
+            return cache, new_state, toks, n_emit
+
+        return step
+
+    def _build_spec_paged_step(self, slots: int, nb: int, block_len: int, K: int):
+        """Fused draft-verify decode step against the block pool. Verify
+        writes route through the slot's block table exactly like decode
+        writes (cell = (table[p // L], p % L)); the engine widens each
+        slot's block budget by K+1 positions and slices tables to cover
+        pos+K, so every live-slot write lands in the slot's OWN blocks —
+        rejected-draft cells are overwritten by the next tick before any
+        query position reaches them, and dead rows' writes fall into the
+        null block (all-null tables, engine-side)."""
+        mc = self.config
+        dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
+        verify_all = self._build_spec_verify(slots, K)
+
+        @jax.jit
+        def step(params, pool, state, live, tables, drafts, n_draft):
+            last, pos = state["last"], state["pos"]
+            inputs = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, K+1]
+            hidden, pool = forward(
+                params, inputs, mc, cache=pool, cache_pos=pos,
+                block_tables=tables, compute_dtype=dtype, output_hidden=True,
+                activation_sharding=act,
+            )
+            logits_all = unembed(params, hidden, mc, compute_dtype=dtype, mesh=mesh)
+            splits = jax.vmap(lambda r: jax.random.split(r, K + 2))(state["rng"])
+            seen, toks, new_last, n_emit = verify_all(
+                state, live, drafts, n_draft, logits_all, splits
+            )
+            new_state = dict(
+                state,
+                last=new_last,
+                # no ceiling clamp: the engine's K+1-widened block budget
+                # keeps a live row's positions inside its allocation
+                pos=jnp.where(live, pos + n_emit, pos),
+                seen=seen,
+                rng=jnp.where(live[:, None], splits[:, 0], state["rng"]),
+            )
+            return pool, new_state, toks, n_emit
+
+        return step
+
+    # Draft-model programs for the engines: the draft keeps its OWN dense
+    # per-slot cache (small model — a dense [slots, buf_len] buffer is cheap
+    # even under the paged target engine, so the draft skips paging). Each
+    # tick one jitted program re-ingests the (K+1)-wide accepted-token window
+    # (resyncing the draft cache under the same slot == position rollback the
+    # solo path uses — at most K+1 tokens advance per tick, so the window
+    # always covers what changed) and rolls K greedy proposals with the
+    # TARGET's repetition-penalty semantics over a speculative seen copy.
+
+    def init_draft_slot_cache(self, slots: int, buf_len: int):
+        """Fresh dense per-slot cache for the attached draft model."""
+        if self._draft_config is None:
+            raise ValueError("no draft model attached")
+        return init_cache(
+            self._draft_config, slots, buf_len, dtype=self.compute_dtype
+        )
+
+    def draft_slot_prefill(self, bucket: int):
+        """Jitted draft-cache prompt ingest + row insert (cached per bucket)."""
+        key = ("draft_slot_prefill", bucket)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_draft_slot_prefill(bucket)
+        return self._jit_cache[key]
+
+    def draft_slot_step(self, slots: int, K: int):
+        """Jitted per-tick K-token draft proposal (cached per shape)."""
+        key = ("draft_slot_step", slots, K)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_draft_slot_step(slots, K)
+        return self._jit_cache[key]
+
+    def _build_draft_slot_prefill(self, bucket: int):
+        dmc = self._draft_config
+        dtype = self.compute_dtype
+        act = self._act_sharding
+
+        @jax.jit
+        def prefill(dparams, dcache, prompt_ids, slot):
+            small = init_cache(dmc, 1, bucket, dtype=dtype)
+            _, small = forward(
+                dparams, prompt_ids, dmc, cache=small, cache_pos=0,
+                compute_dtype=dtype, output_hidden=True,
+                activation_sharding=act,
+            )
+            return insert_cache_row(dcache, small, slot)
+
+        return prefill
+
+    def _build_draft_slot_step(self, slots: int, K: int):
+        """K greedy proposals per slot from the draft model.
+
+        ``window [S, K+1]`` holds each slot's context tokens at positions
+        start..start+K (``start = max(pos - K, 0)``, so ``last`` sits at
+        window index pos-start); the re-ingest forward writes them at their
+        true positions, then K-1 single-token draft forwards extend at
+        pos+1..pos+K-1. Window cells past a short context (pos < K) write
+        garbage ABOVE pos — overwritten by the draft extension before any
+        draft query passes them, masked meanwhile. Non-live rows get
+        window=0/start=0 from the engine; their garbage stays in their own
+        dcache row and their proposals are discarded (n_draft = 0)."""
+        dmc = self._draft_config
+        dtype = self.compute_dtype
+        mesh, act = self.mesh, self._act_sharding
+
+        @jax.jit
+        def draft(dparams, dcache, state, window, start):
+            pos = state["pos"]
+            rows = jnp.arange(slots)
+            dh, dcache = forward(
+                dparams, window, dmc, cache=dcache, cache_pos=start,
+                compute_dtype=dtype, output_hidden=True,
+                activation_sharding=act,
+            )
+            idx = jnp.clip(pos - start, 0, K)  # stale dead-row pos: clamp
+            cur_h = jnp.take_along_axis(dh, idx[:, None, None], axis=1)[:, 0]
+            rp = state["repetition_penalty"][:, None]
+
+            def propose(logits, spec_seen):
+                # greedy with the TARGET's penalty over the speculative seen
+                # set — a perfect draft then matches the target's greedy
+                # verify choice exactly (100% acceptance on self-draft)
+                pl = jnp.where(
+                    spec_seen,
+                    jnp.where(logits > 0, logits / rp, logits * rp),
+                    logits,
+                )
+                d = jnp.argmax(pl, axis=-1).astype(jnp.int32)
+                return d, spec_seen.at[rows, d].set(True)
+
+            d0, spec_seen = propose(
+                unembed(dparams, cur_h, dmc, compute_dtype=dtype, mesh=mesh),
+                state["seen"],
+            )
+            dbuf = jnp.zeros((slots, K), jnp.int32).at[:, 0].set(d0)
+
+            def dstep(i, c):
+                dcache, dbuf, spec_seen = c
+                prev = dbuf[rows, i - 1]
+                dh, dcache = forward(
+                    dparams, prev[:, None], dmc, cache=dcache, cache_pos=pos + i,
+                    compute_dtype=dtype, output_hidden=True,
+                    activation_sharding=act,
+                )
+                nxt, spec_seen = propose(
+                    unembed(dparams, dh[:, -1], dmc, compute_dtype=dtype, mesh=mesh),
+                    spec_seen,
+                )
+                return (dcache, dbuf.at[:, i].set(nxt), spec_seen)
+
+            if K > 1:
+                dcache, dbuf, _ = jax.lax.fori_loop(
+                    1, K, dstep, (dcache, dbuf, spec_seen)
+                )
+            return dcache, dbuf
+
+        return draft
+
     def generate_stream(
         self,
         prompt_ids: Sequence[int],
@@ -982,12 +1289,19 @@ class Generator:
             n_vec = np.asarray(n)[:nl]
             row_steps = np.asarray(res[3])[:nl]
             self.last_spec_steps = int(res[2])
-            drafted = int(row_steps.sum()) * gen.speculative_lookup
-            accepted = int((n_vec - 1 - row_steps).sum())
+            # per-row attribution: row i drafted K per spec step it was still
+            # generating in, and each emitted token beyond prefill's first
+            # and the per-step mandatory one is an accepted draft
+            self.last_row_draft_proposed = row_steps * gen.speculative_lookup
+            self.last_row_draft_accepted = np.maximum(n_vec - 1 - row_steps, 0)
+            drafted = int(self.last_row_draft_proposed.sum())
+            accepted = int(self.last_row_draft_accepted.sum())
             self.last_acceptance_rate = max(accepted, 0) / max(drafted, 1)
         else:
             self.last_spec_steps = None
             self.last_acceptance_rate = None
+            self.last_row_draft_proposed = None
+            self.last_row_draft_accepted = None
         out = np.asarray(out)
         results: List[List[int]] = []
         for r, row in enumerate(out):

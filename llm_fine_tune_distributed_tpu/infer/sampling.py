@@ -178,3 +178,71 @@ def rejection_sample_step(rng, logits, seen, draft, config: GenerationConfig, *,
     alt = jnp.take_along_axis(idx, alt_k[:, None], axis=-1)[:, 0].astype(jnp.int32)
     token = jnp.where(accept, draft, alt)
     return token, accept
+
+
+def rejection_sample_step_traced(keys, logits, seen, draft, *, temperature,
+                                 top_p, top_k, repetition_penalty, do_sample,
+                                 bonus):
+    """``rejection_sample_step`` with per-row TRACED knobs — one speculative
+    verify position inside the continuous-batching engines' fused spec step
+    (infer/engine.py), where every slot carries its own config and draft.
+
+    Mirrors ``sample_token_traced``'s warp pipeline exactly (penalty ->
+    temperature -> full descending sort -> top-k rank mask -> top-p), so:
+
+    - greedy rows (``do_sample`` False) emit ``argmax(penalized)`` bitwise
+      identical to the plain traced step — a greedy slot's speculative
+      tokens are the solo ``generate_ids`` tokens, accepted prefix or not;
+      the draft is "accepted" when it EQUALS that argmax (and ``bonus`` is
+      off), which is what keeps the verified run advancing;
+    - sampled rows accept ``draft`` with probability q(draft) under the
+      row's own warped distribution, else draw the renormalized residual —
+      exactly q-distributed per position (Leviathan et al.), deterministic
+      in the row's key.
+
+    Every row consumes exactly one ``split`` of its key regardless of
+    accept/reject or ``bonus`` — the engine leans on this fixed consumption
+    to keep sampled streams independent of co-resident acceptance.
+
+    keys [batch, 2] uint32; logits/seen [batch, vocab]; draft [batch] int32;
+    knobs [batch]; bonus [batch] bool (position past the row's last draft:
+    plain sample, never "accepted"). Returns (token [batch] int32,
+    accepted [batch] bool).
+    """
+    pen = repetition_penalty[:, None]
+    penalized = jnp.where(
+        seen, jnp.where(logits > 0, logits / pen, logits * pen), logits
+    )
+    greedy = jnp.argmax(penalized, axis=-1).astype(jnp.int32)
+
+    scaled = penalized / jnp.maximum(temperature, 1e-6)[:, None]
+    order = jnp.argsort(-scaled, axis=-1)
+    vals = jnp.take_along_axis(scaled, order, axis=-1)
+    vocab = logits.shape[-1]
+    rank = jnp.arange(vocab)[None, :]
+    vals = jnp.where(rank < top_k[:, None], vals, _NEG_INF)
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    keep = keep.at[:, 0].set(True)
+    vals = jnp.where(keep, vals, _NEG_INF)
+    probs = jax.nn.softmax(vals, axis=-1)
+
+    match = order == draft[:, None]
+    q_d = (probs * match).sum(axis=-1)  # [batch]
+    split = jax.vmap(jax.random.split)(keys)  # [batch, 2, 2]
+    u = jax.vmap(lambda k: jax.random.uniform(k, ()))(split[:, 0])
+    accept_sampled = jnp.logical_and(jnp.logical_not(bonus), u < q_d)
+    residual = jnp.where(bonus[:, None], probs, jnp.where(match, 0.0, probs))
+    z = residual.sum(axis=-1, keepdims=True)
+    residual = jnp.where(z > 0, residual / z, probs)
+    alt_k = jax.vmap(jax.random.categorical)(
+        split[:, 1], jnp.log(residual + 1e-30)
+    )
+    alt = jnp.take_along_axis(order, alt_k[:, None], axis=-1)[:, 0]
+    sampled_tok = jnp.where(accept_sampled, draft, alt)
+
+    accept_greedy = jnp.logical_and(jnp.logical_not(bonus), draft == greedy)
+    token = jnp.where(do_sample, sampled_tok, greedy).astype(jnp.int32)
+    accepted = jnp.where(do_sample, accept_sampled, accept_greedy)
+    return token, accepted

@@ -27,9 +27,12 @@ Handlers run on threads; a single worker owns the TPU. Three engines
 
 - ``continuous`` (default, single-host): slot-based persistent decode loop
   (infer/engine.py) — mixed greedy/sampled traffic co-batches, freed slots
-  refill mid-flight, and /v1/stream rides the shared batch. Speculative
-  requests still run through the window engine (speculation needs the
-  fused verify program).
+  refill mid-flight, and /v1/stream rides the shared batch. With
+  ``--speculative K`` every tick drafts up to K tokens per slot
+  (prompt-lookup, or a small same-vocab model via ``--draft-dir``) and ONE
+  fused forward verifies all slots' K+1 positions — speculative requests
+  (streaming included) ride the shared batch; without the flag they fall
+  back to the window engine's solo program.
 - ``paged`` (single-host): the continuous engine over a block-paged KV
   pool (``--kv-block-len``) — decode cost tracks live occupancy, shared
   prompt prefixes prefill once (refcounted block reuse), and long prompts
@@ -65,6 +68,7 @@ def serve(
     request_timeout_s: Optional[float] = 600.0,
     tp: int = 1,
     draft_dir: Optional[str] = None,
+    speculative_k: int = 0,
     engine_kind: str = "continuous",
     slots: int = 8,
     kv_buf_len: int = 4096,
@@ -105,6 +109,22 @@ def serve(
         raise ValueError(
             f"unknown quantize mode {quantize!r} (expected one of {QUANTIZE_MODES})"
         )
+    # flag-combination validation mirrors infer/cli.py: a bad speculation
+    # setup must fail AT STARTUP with a clear message, not at first request
+    speculative_k = max(0, int(speculative_k or 0))
+    if draft_dir and not speculative_k:
+        raise ValueError(
+            "--draft-dir requires --speculative K (the draft model only "
+            "runs inside the speculative decode loop)"
+        )
+    if speculative_k and engine_kind == "window":
+        raise ValueError(
+            "--speculative K applies to the continuous/paged engines "
+            "(engine-level fused draft+verify ticks); the window engine "
+            "instead takes per-request speculation via POST /v1/generate "
+            "with 'speculative': K — drop --speculative or pick "
+            "--engine continuous|paged"
+        )
     print(f"Loading model from {model_dir} ...")
     params, model_config = load_model_dir(model_dir)
     params = maybe_quantize(params, quantize)
@@ -143,14 +163,22 @@ def serve(
         coordinator = MultihostCoordinator(generator)
         engine_target = coordinator
         print(f"[serve] coordinating {jax.process_count()} hosts")
+        if speculative_k:
+            raise ValueError(
+                "--speculative K needs a continuous/paged engine, which is "
+                "single-host only; multi-host serving falls back to the "
+                "window engine (per-request 'speculative': K on "
+                "POST /v1/generate still works there)"
+            )
     if engine_kind not in ("continuous", "paged", "window"):
         raise ValueError(
             f"unknown engine {engine_kind!r} (expected 'continuous', 'paged' "
             "or 'window')"
         )
     # The window engine always exists: it is the multi-host path AND the
-    # carrier for speculative requests (speculation needs the fused
-    # draft+verify while_loop program, which has no slot-step form).
+    # carrier for speculative requests when the slot engines were started
+    # WITHOUT --speculative (engine-level speculation compiles the fused
+    # draft+verify slot step up front; K=0 engines keep the plain step).
     engine = BatchingEngine(engine_target, max_batch=max_batch, window_ms=batch_window_ms)
     cont_engine = None
     cont_kind = "window"
@@ -163,6 +191,7 @@ def serve(
         "circuit_threshold": circuit_threshold,
         "circuit_window_s": circuit_window_s,
         "watchdog_timeout_s": watchdog_timeout_s,
+        "speculative_k": speculative_k,
     }
     if engine_kind in ("continuous", "paged"):
         if coordinator is not None:
@@ -287,15 +316,29 @@ def serve(
             # everything fallible happens BEFORE headers go out, so clients
             # get a 400 instead of a hung keep-alive connection
             try:
-                if int(req.get("speculative", 0)):
-                    # streaming has no speculative decode path in ANY serving
-                    # mode — reject consistently (same code, same message)
-                    # rather than silently serving plain decode, and name
-                    # what IS supported. speculative=0 (the documented off
+                spec = int(req.get("speculative", 0))
+                if spec and cont_engine is None:
+                    # window engine (explicit or multi-host fallback):
+                    # streaming has no speculative path there — name what
+                    # IS supported. speculative=0 (the documented off
                     # value) passes through.
                     raise ValueError(
-                        "'speculative' is not supported on /v1/stream; "
-                        "supported alternatives: POST /v1/generate with "
+                        "'speculative' on /v1/stream needs a continuous/"
+                        "paged engine started with --speculative K; with "
+                        "--engine window the supported alternatives are: "
+                        "POST /v1/generate with 'speculative': K "
+                        "(non-streaming speculative decode), or /v1/stream "
+                        "without 'speculative' (plain streaming)"
+                    )
+                if spec and not speculative_k:
+                    # continuous/paged engine compiled WITHOUT the fused
+                    # draft+verify step: speculation cannot ride the slot
+                    # batch. Restart with the flag, or use the supported
+                    # shapes on this server.
+                    raise ValueError(
+                        "'speculative' on /v1/stream needs the server "
+                        "started with --speculative K (engine-level fused "
+                        "verify); supported now: POST /v1/generate with "
                         "'speculative': K (non-streaming speculative "
                         "decode), or /v1/stream without 'speculative' "
                         "(plain streaming)"
@@ -305,6 +348,11 @@ def serve(
                     for k, cast in self._FIELD_CASTS.items()
                     if k in req
                 }
+                if spec:
+                    # the stream rides the speculative slot batch: the
+                    # engine drafts min(K, --speculative) per tick and
+                    # accepted runs surface as ordinary streamed tokens
+                    gen_kwargs["speculative_lookup"] = spec
                 if "greedy" in req:
                     gen_kwargs["do_sample"] = not req["greedy"]
                 gen = GenerationConfig(**gen_kwargs)
@@ -454,10 +502,13 @@ def serve(
                 # chat helpers, so CLI and server cannot diverge); only the
                 # device work goes through the batching engine's worker
                 prompt_ids = generator.encode_chat(messages, **(template_kwargs or {}))
-                # speculative requests need the fused draft+verify program —
-                # they keep riding the window engine; everything else takes
-                # the continuous engine when it is on
-                if cont_engine is not None and gen.speculative_lookup == 0:
+                # speculative requests ride the slot batch when the engine
+                # was started with --speculative K (per-slot drafting +
+                # fused verify); on a K=0 engine they fall back to the
+                # window engine's solo fused draft+verify program
+                if cont_engine is not None and (
+                    gen.speculative_lookup == 0 or speculative_k > 0
+                ):
                     pending = cont_engine.submit_full(
                         prompt_ids, gen, seed=seed, timeout=request_timeout_s
                     )
@@ -480,11 +531,17 @@ def serve(
             resp = {"answer": answer}
             if gen.speculative_lookup > 0 and pending.spec_acceptance is not None:
                 # draft-acceptance telemetry so clients can see whether the
-                # speculation they asked for is actually paying off
+                # speculation they asked for is actually paying off — THIS
+                # request's own counts, not its batch's
                 resp["speculative"] = {
                     "acceptance_rate": round(pending.spec_acceptance, 3),
-                    "sequential_forwards": pending.spec_steps,
+                    "draft_tokens_proposed": pending.draft_tokens_proposed,
+                    "draft_tokens_accepted": pending.draft_tokens_accepted,
                 }
+                if pending.spec_steps is not None:
+                    # window engine only: its whole-batch sequential-forward
+                    # count (a slot engine has no per-request equivalent)
+                    resp["speculative"]["sequential_forwards"] = pending.spec_steps
             self._send(200, resp)
 
         def log_message(self, fmt, *args):
@@ -586,6 +643,19 @@ def main(argv: Optional[list] = None) -> int:
              "(longer prompts interleave with decode)",
     )
     parser.add_argument(
+        "--speculative", type=int, default=0, metavar="K",
+        help="continuous/paged engines: draft up to K tokens per slot per "
+             "tick (prompt-lookup by default) and verify them in ONE fused "
+             "forward; requests opt in per-call with 'speculative': K. "
+             "0 = off (speculative requests fall back to the window engine)",
+    )
+    parser.add_argument(
+        "--draft-dir", default=None,
+        help="small same-vocab draft model directory: engine-level "
+             "speculation drafts with it instead of prompt-lookup "
+             "(requires --speculative K)",
+    )
+    parser.add_argument(
         "--max-batch", type=int, default=8,
         help="window engine: max concurrent requests grouped into one device "
              "batch (1 = serialize)",
@@ -653,6 +723,7 @@ def main(argv: Optional[list] = None) -> int:
     serve(args.model_dir, args.host, args.port, args.max_batch,
           args.batch_window_ms, args.quantize,
           request_timeout_s=args.request_timeout_s or None, tp=args.tp,
+          draft_dir=args.draft_dir, speculative_k=args.speculative,
           engine_kind=args.engine, slots=args.slots,
           kv_buf_len=args.kv_buf_len, kv_block_len=args.kv_block_len,
           prefill_chunk=args.prefill_chunk,

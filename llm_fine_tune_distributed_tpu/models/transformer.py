@@ -240,6 +240,13 @@ def _block(
             cache_pos[:, None] if getattr(cache_pos, "ndim", 0) == 1 else cache_pos
         )
         pos = jnp.broadcast_to(offset + jnp.arange(s)[None, :], (b, s))
+        # NOTE the clip: a position past the table view REDIRECTS its write
+        # into the view's LAST entry instead of dropping it (the dense branch
+        # below drops out-of-bounds scatters). Callers whose writes can run
+        # past a row's logical end — the speculative verify step writes K
+        # positions past the last accepted token — must size the table view
+        # to cover pos + K (engine-side block headroom), or live KV gets
+        # overwritten.
         blk = jnp.take_along_axis(block_tables, jnp.clip(pos // L, 0, nb - 1), axis=1)
         off = pos % L
         ck = cache_entry["k"].at[blk, off].set(k.astype(cache_entry["k"].dtype))
@@ -254,6 +261,9 @@ def _block(
         # prompt / aligned batch); a [batch] vector writes per-row slots —
         # ragged batched decode, where row i's token t lives at slot
         # len_i + t so the slot == position invariant holds per row.
+        # Out-of-bounds slots DROP (jax scatter default): a speculative
+        # verify chunk overrunning the buffer on a slot's final tick
+        # cannot clobber other rows' live KV.
         if getattr(cache_pos, "ndim", 0) == 1:
             slots = cache_pos[:, None] + jnp.arange(s)[None, :]  # [b, s]
             ck = cache_entry["k"].at[jnp.arange(b)[:, None], slots].set(

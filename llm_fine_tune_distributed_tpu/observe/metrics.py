@@ -42,7 +42,12 @@ class ServingStats:
     ``requests_failed`` (resolved with an error — includes shed and
     recovery casualties), ``requests_shed_overflow`` (429s from the
     bounded queue) and ``requests_shed_deadline`` (queue-wait deadline
-    expiries).
+    expiries). Speculative decoding adds ``draft_tokens_proposed`` /
+    ``draft_tokens_accepted`` (engine-lifetime draft totals across all
+    requests); the snapshot derives ``draft_acceptance_rate`` =
+    accepted / proposed and ``mean_tokens_per_step`` = tokens_served /
+    decode_steps — the verified-tokens-per-forward number speculation
+    exists to raise above 1.0.
     Gauges (instantaneous): ``queue_depth``, ``live_slots``,
     ``engine_generation`` (restart epoch), plus paged
     ``blocks_in_use`` / ``peak_blocks_in_use`` / ``prefix_cache_blocks``.
@@ -60,6 +65,7 @@ class ServingStats:
         "prompt_tokens", "prefix_tokens_reused", "prefill_chunks",
         "engine_restarts", "requests_failed",
         "requests_shed_overflow", "requests_shed_deadline",
+        "draft_tokens_proposed", "draft_tokens_accepted",
     )
     GAUGES = (
         "queue_depth", "live_slots", "engine_generation",
@@ -103,6 +109,16 @@ class ServingStats:
         out["prefix_hit_rate"] = (
             out["prefix_tokens_reused"] / out["prompt_tokens"]
             if out["prompt_tokens"]
+            else 0.0
+        )
+        out["draft_acceptance_rate"] = (
+            out["draft_tokens_accepted"] / out["draft_tokens_proposed"]
+            if out["draft_tokens_proposed"]
+            else 0.0
+        )
+        out["mean_tokens_per_step"] = (
+            out["tokens_served"] / out["decode_steps"]
+            if out["decode_steps"]
             else 0.0
         )
         return out

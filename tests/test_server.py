@@ -224,8 +224,9 @@ def test_stream_bad_request(server):
 
 
 def test_stream_speculative_400_names_alternatives(server):
-    """"speculative" on /v1/stream is a 400 (it stays on the window engine's
-    fused draft+verify program) and the error names the supported routes."""
+    """"speculative" on /v1/stream is a 400 when the server was started
+    WITHOUT --speculative (the engine has no fused verify step compiled)
+    and the error names the supported routes."""
     body = {"question": "q?", "max_new_tokens": 4, "greedy": True, "speculative": 4}
     req = urllib.request.Request(
         f"{server}/v1/stream", data=json.dumps(body).encode(),
@@ -265,6 +266,90 @@ def test_stats_endpoint_window_engine(model_dir):
         stats = json.loads(r.read())
     assert stats["engine"] == "window"
     assert "queue_depth" in stats
+
+
+# ------------------------------------------------- engine-level speculation
+
+
+def test_speculative_flag_validation_at_startup():
+    """Bad speculation flag combinations fail AT STARTUP with a clear
+    message (parity with infer/cli.py), before the model even loads — so
+    the model_dir can be bogus here and the check still runs."""
+    from llm_fine_tune_distributed_tpu.infer.server import serve
+
+    with pytest.raises(ValueError, match="--draft-dir requires --speculative"):
+        serve("/nonexistent", draft_dir="/also/nonexistent")
+    with pytest.raises(ValueError, match="window engine"):
+        serve("/nonexistent", speculative_k=4, engine_kind="window")
+
+
+@pytest.fixture(scope="module")
+def spec_server(model_dir):
+    """A continuous engine started with --speculative 4: speculative
+    requests (streaming included) ride the fused slot batch."""
+    return _start_server(model_dir, speculative_k=4, slots=4)
+
+
+def test_speculative_server_generate_reports_draft_counts(spec_server):
+    """On a --speculative server, /v1/generate speculation rides the slot
+    engine and the response carries the request's OWN draft counts."""
+    body = {
+        "question": "water water water water?", "max_new_tokens": 12,
+        "greedy": True, "speculative": 4,
+    }
+    req = urllib.request.Request(
+        f"{spec_server}/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        payload = json.loads(r.read())
+    assert isinstance(payload["answer"], str)
+    spec = payload["speculative"]
+    assert 0.0 <= spec["acceptance_rate"] <= 1.0
+    assert spec["draft_tokens_proposed"] >= spec["draft_tokens_accepted"] >= 0
+    # slot engines have no whole-batch sequential-forward count
+    assert "sequential_forwards" not in spec
+
+
+def test_speculative_server_stream_accepts_k(spec_server):
+    """/v1/stream accepts 'speculative': K on a --speculative engine, and
+    the streamed deltas concatenate to the non-streamed greedy answer."""
+    body = {
+        "question": "water water water water?", "max_new_tokens": 12,
+        "greedy": True, "speculative": 4,
+    }
+    req = urllib.request.Request(
+        f"{spec_server}/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        answer = json.loads(r.read())["answer"]
+    sreq = urllib.request.Request(
+        f"{spec_server}/v1/stream", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(sreq, timeout=120) as r:
+        assert r.status == 200
+        raw = r.read().decode()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in raw.splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events and events[-1].get("done") is True
+    text = "".join(e.get("delta", "") for e in events)
+    assert text.strip() == answer
+
+
+def test_speculative_server_stats_counters(spec_server):
+    """GET /v1/stats surfaces the draft counters + derived acceptance rate
+    after speculative traffic has been served."""
+    with urllib.request.urlopen(f"{spec_server}/v1/stats", timeout=30) as r:
+        stats = json.loads(r.read())
+    assert stats["draft_tokens_proposed"] >= 1
+    assert 0 <= stats["draft_tokens_accepted"] <= stats["draft_tokens_proposed"]
+    assert 0.0 <= stats["draft_acceptance_rate"] <= 1.0
+    assert stats["mean_tokens_per_step"] > 0.0
 
 
 # ------------------------------------------------- self-healing + drain

@@ -185,6 +185,35 @@ def test_decode_crash_recovers_bit_identical(generator, kind):
 
 
 @pytest.mark.parametrize("kind", ["continuous", "paged"])
+def test_crash_during_speculation_recovers_bit_identical(generator, kind):
+    """PR 3 recovery semantics are unchanged by speculation: a decode crash
+    on a speculative tick fails the in-flight waiter retryable, the rebuilt
+    engine (fresh target AND draft state) reproduces solo speculative decode
+    bit-for-bit, and the jitted spec programs survive on the Generator."""
+    tok = ByteChatMLTokenizer()
+    rep = tok.encode("water water water water water")  # drafting engages
+    spec = GenerationConfig(
+        max_new_tokens=8, do_sample=False, speculative_lookup=4
+    )
+    solo = generator.generate_ids(rep, spec)
+    engine = _make(generator, kind, speculative_k=4)
+    warm = engine.submit_full(rep, spec, timeout=240)
+    assert warm.result == solo  # warm: speculation correct before the chaos
+    assert warm.draft_tokens_proposed > 0  # the crash hits a REAL spec tick
+
+    engine.faults.fail_decode_next(1)
+    with pytest.raises(RetryableEngineError):
+        engine.submit(rep, spec, timeout=60)
+
+    after = engine.submit_full(rep, spec, timeout=240)
+    assert after.result == solo
+    assert after.draft_tokens_proposed > 0
+    snap = engine.stats_snapshot()
+    assert snap["engine_restarts"] >= 1
+    assert engine.healthy
+
+
+@pytest.mark.parametrize("kind", ["continuous", "paged"])
 def test_prefill_crash_recovers(generator, kind):
     """A device failure during prefill takes the same supervision path. On
     the dense engine the not-yet-committed request is requeued and retried
