@@ -115,16 +115,22 @@ def _repetitive_workload(rng, vocab, n, spec_k, max_new=32):
 
 
 def _run_config(engine, clients, reqs_per_client, workload):
-    """clients threads x reqs_per_client sequential submits each."""
+    """clients threads x reqs_per_client sequential submits each. Returns
+    (tokens_served, wall_s, errors, per-request client latencies)."""
     served = [0] * clients
     errors = []
+    lats = []
+    lats_lock = threading.Lock()
 
     def client(ci):
         for ri in range(reqs_per_client):
             prompt, gen, seed = workload[(ci * reqs_per_client + ri) % len(workload)]
+            t_req = time.perf_counter()
             try:
                 toks = engine.submit(prompt, gen, seed=seed, timeout=600)
                 served[ci] += len(toks)
+                with lats_lock:
+                    lats.append(time.perf_counter() - t_req)
             except Exception as e:  # pragma: no cover - surfaced in the JSON
                 errors.append(repr(e))
 
@@ -135,7 +141,34 @@ def _run_config(engine, clients, reqs_per_client, workload):
     for t in threads:
         t.join()
     dt = time.perf_counter() - t0
-    return sum(served), dt, errors
+    return sum(served), dt, errors, lats
+
+
+def _pctl(sorted_vals, q):
+    """Nearest-rank percentile over a pre-sorted list (0.0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def _latency_fields(lats, engine):
+    """Client-side request-latency percentiles plus the engine's OWN view
+    (TTFT and inter-token histograms from the per-tick tracer) — the pairing
+    that separates queueing delay seen by clients from decode cadence on the
+    device. Window engine has no stats_snapshot; engine fields are omitted."""
+    out = {}
+    vals = sorted(lats)
+    out["client_request_p50_ms"] = round(_pctl(vals, 0.50) * 1e3, 2)
+    out["client_request_p99_ms"] = round(_pctl(vals, 0.99) * 1e3, 2)
+    if hasattr(engine, "stats_snapshot"):
+        hists = engine.stats_snapshot().get("histograms", {})
+        for key, tag in (("ttft_s", "ttft"), ("inter_token_s", "inter_token")):
+            h = hists.get(key)
+            if h and h.get("count"):
+                out[f"engine_{tag}_p50_ms"] = round(h["p50"] * 1e3, 3)
+                out[f"engine_{tag}_p99_ms"] = round(h["p99"] * 1e3, 3)
+    return out
 
 
 def _chaos_sweep(make_engine, workload, clients, reqs_per_client, base_line):
@@ -290,7 +323,7 @@ def main():
             # warm the jit caches so the sweep times decode, not compilation
             _run_config(engine, 1, 2, load)
             for clients in client_counts:
-                total, dt, errors = _run_config(
+                total, dt, errors, lats = _run_config(
                     engine, clients, reqs_per_client, load
                 )
                 tps = total / dt if dt > 0 else 0.0
@@ -309,6 +342,7 @@ def main():
                     "platform": jax.devices()[0].platform,
                     "slots": slots,
                     "errors": errors,
+                    **_latency_fields(lats, engine),
                 }
                 if kind == "paged":
                     snap = engine.stats_snapshot()
@@ -370,7 +404,7 @@ def main():
             # warm at the sweep's client count so every decode bucket the
             # sweep will hit is already compiled before the clock starts
             _run_config(engine, spec_clients, 1, load)
-            total, dt, errors = _run_config(
+            total, dt, errors, lats = _run_config(
                 engine, spec_clients, reqs_per_client, load
             )
             tps = total / dt if dt > 0 else 0.0
@@ -395,6 +429,7 @@ def main():
                 "platform": jax.devices()[0].platform,
                 "slots": slots,
                 "errors": errors,
+                **_latency_fields(lats, engine),
             }), flush=True)
         if spec_tps.get("baseline"):
             print(json.dumps({

@@ -76,6 +76,15 @@ class Request:
     # hints) and the absolute deadline past which it is shed un-prefilled
     enqueued_at: float = 0.0
     queue_deadline: Optional[float] = None
+    # observability (continuous engines only): engine-assigned request id,
+    # the lifecycle trace (observe/tracing.RequestTrace — received/queued/
+    # admitted/prefill/first_token/terminal spans), and the monotonic
+    # timestamps behind the TTFT and inter-token histograms. The window
+    # engine leaves these at their defaults.
+    id: int = 0
+    trace: Optional[object] = None
+    first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
 
 
 # historical name, kept for callers/tests that referenced the private type

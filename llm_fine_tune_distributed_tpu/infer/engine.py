@@ -71,6 +71,7 @@ serialization, which dominates (benchmarks/serve_bench.py).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -104,6 +105,11 @@ from llm_fine_tune_distributed_tpu.infer.supervisor import (
     FaultInjector,
 )
 from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+from llm_fine_tune_distributed_tpu.observe.tracing import (
+    FlightRecorder,
+    RequestTrace,
+    TraceJsonlWriter,
+)
 from llm_fine_tune_distributed_tpu.runtime.watchdog import StepWatchdog
 
 
@@ -153,6 +159,9 @@ class ContinuousBatchingEngine:
         watchdog: Optional[StepWatchdog] = None,
         faults: Optional[FaultInjector] = None,
         speculative_k: int = 0,
+        flight_dir: Optional[str] = None,
+        flight_capacity: int = 1024,
+        trace_log: Optional[str] = None,
     ):
         if getattr(generator, "_multihost", False):
             raise ValueError(
@@ -185,8 +194,19 @@ class ContinuousBatchingEngine:
             restart_backoff_max_s=restart_backoff_max_s,
             circuit_threshold=circuit_threshold,
             circuit_window_s=circuit_window_s,
+            flight_dir=flight_dir,
         )
         self.faults = faults if faults is not None else FaultInjector()
+        # observability: bounded event ring the supervisor dumps on
+        # crash/circuit-open, optional JSONL export of settled request
+        # traces, and a monotonically increasing request id. The tick
+        # timestamp ``_now`` is taken ONCE per scheduler tick (right after
+        # the host sync) and shared by every per-token emit on that tick —
+        # tracing adds no extra clock reads to the token hot path.
+        self.recorder = FlightRecorder(flight_capacity)
+        self._trace_writer = TraceJsonlWriter(trace_log) if trace_log else None
+        self._req_seq = itertools.count(1)
+        self._now = time.monotonic()
         # wedged-device escape hatch (runtime/watchdog.py): poked per decode
         # tick, paused while legitimately idle or in restart backoff.
         # start_paused so the first request's compile cannot false-trip.
@@ -296,6 +316,7 @@ class ContinuousBatchingEngine:
         in-flight requests keep decoding to completion. The SIGTERM path
         (infer/server.py) follows with ``wait_drained``."""
         self._draining = True
+        self.recorder.record("drain_begin", queued=self._queue_len())
 
     def wait_drained(self, timeout_s: float, poll_s: float = 0.05) -> bool:
         """Block until every submitted request has resolved (True) or the
@@ -374,17 +395,22 @@ class ContinuousBatchingEngine:
             )
         if self._max_queue_depth and self._queue_len() >= self._max_queue_depth:
             self.stats.incr("requests_shed_overflow")
+            self.recorder.record("shed_overflow", queued=self._queue_len())
             raise QueueOverflowError(
                 f"admission queue full ({self._queue_len()} waiting >= "
                 f"max_queue_depth {self._max_queue_depth})",
                 retry_after_s=self._retry_after(),
             )
         req = Request(list(prompt_ids), gen, seed, tokens_q=tokens_q)
+        req.id = next(self._req_seq)
         req.enqueued_at = time.monotonic()
+        req.trace = RequestTrace(req.id, t0=req.enqueued_at)
+        req.trace.mark("received", req.enqueued_at)
         if self._queue_deadline_s is not None:
             req.queue_deadline = req.enqueued_at + self._queue_deadline_s
         with self._plock:
             self._pending += 1
+        req.trace.mark("queued", req.enqueued_at)
         return req
 
     def _expired(self, req: Request) -> bool:
@@ -398,9 +424,21 @@ class ContinuousBatchingEngine:
     def _settle(self, req: Request) -> None:
         """The one place a request leaves the pending ledger and wakes its
         waiter. Every admission has exactly one settle — the no-hung-waiter
-        invariant wait_drained and the tests lean on."""
+        invariant wait_drained and the tests lean on. Also the one export
+        point for the request's lifecycle trace: every terminal path has
+        already marked its terminal span by the time it settles."""
         with self._plock:
             self._pending -= 1
+        if self._trace_writer is not None and req.trace is not None:
+            self._trace_writer.write(
+                {
+                    "request_id": req.id,
+                    "prompt_tokens": len(req.prompt),
+                    "generated_tokens": len(req.result or ()),
+                    "error": type(req.error).__name__ if req.error else None,
+                    **req.trace.to_dict(),
+                }
+            )
         req.done.set()
 
     def _resolve_error(self, req: Request, err: BaseException) -> None:
@@ -409,6 +447,8 @@ class ContinuousBatchingEngine:
         if req.done.is_set():
             return
         req.error = err
+        if req.trace is not None:
+            req.trace.mark("failed")
         if req.tokens_q is not None:
             req.tokens_q.put(None)
         self.stats.incr("requests_failed")
@@ -416,11 +456,14 @@ class ContinuousBatchingEngine:
 
     def _settle_abandoned(self, req: Request) -> None:
         self.stats.incr("requests_abandoned")
+        if req.trace is not None:
+            req.trace.mark("abandoned")
         self._settle(req)
 
     def _shed_deadline(self, req: Request) -> None:
         waited = time.monotonic() - req.enqueued_at if req.enqueued_at else 0.0
         self.stats.incr("requests_shed_deadline")
+        self.recorder.record("shed_deadline", request=req.id, waited_s=round(waited, 4))
         self._resolve_error(
             req,
             QueueDeadlineError(
@@ -497,6 +540,12 @@ class ContinuousBatchingEngine:
         if self._watchdog is not None:
             self._watchdog.pause()  # backoff sleep is not a wedge
         sup = self.supervisor
+        self.recorder.record(
+            "crash",
+            step=self._decode_index,
+            error=f"{type(cause).__name__}: {cause}",
+            live=int(self._live.sum()),
+        )
         if is_retryable_failure(cause) and sup.record_failure() == "restart":
             err = RetryableEngineError(
                 f"engine worker failed mid-flight "
@@ -512,11 +561,23 @@ class ContinuousBatchingEngine:
                 time.sleep(delay)
             sup.restarted()
             self.stats.incr("engine_restarts")
+            self.recorder.record(
+                "restart",
+                generation=sup.generation,
+                backoff_s=round(delay, 4),
+                failures_in_window=sup.failure_count,
+            )
+            # dump AFTER recording the restart so the artifact holds the
+            # whole transition: pre-crash ticks -> crash -> restart
+            dump = sup.dump_flight(
+                self.recorder, "crash_restart", error=str(cause)
+            )
             print(
                 f"[engine] recovered from {type(cause).__name__} — "
                 f"generation {sup.generation} "
                 f"({sup.failure_count} failure(s) in window, "
-                f"backoff {delay:.2f}s)",
+                f"backoff {delay:.2f}s)"
+                + (f"; flight recorder dumped to {dump}" if dump else ""),
                 flush=True,
             )
             return True
@@ -533,11 +594,18 @@ class ContinuousBatchingEngine:
             )
         err.__cause__ = cause
         self._terminal = err  # set BEFORE resolving, so waiters see it
+        reason = "circuit_open" if sup.circuit_open else "fatal"
+        self.recorder.record(reason, error=str(err))
+        dump = sup.dump_flight(self.recorder, reason, error=str(cause))
         self._fail_inflight(err)
         self._fail_queued(err)
         if self._watchdog is not None:
             self._watchdog.stop()
-        print(f"[engine] worker terminal: {err}", flush=True)
+        print(
+            f"[engine] worker terminal: {err}"
+            + (f" (flight recorder dumped to {dump})" if dump else ""),
+            flush=True,
+        )
         return False
 
     def _fail_inflight(self, err: ServingError) -> None:
@@ -613,6 +681,12 @@ class ContinuousBatchingEngine:
                 f"{self._buf_len}-slot KV buffer (need >= 1 decode slot)"
             )
         self.faults.maybe_fail_prefill()
+        t0 = time.monotonic()
+        if req.trace is not None:
+            req.trace.mark("admitted", t0)
+        if req.enqueued_at:
+            self.stats.observe("queue_wait_s", t0 - req.enqueued_at)
+        self.recorder.record("admit", request=req.id, slot=slot, prompt_tokens=plen)
         bucket = min(-(-plen // self._bucket) * self._bucket, self._buf_len)
         prefill = gen.slot_prefill(bucket, self._buf_len)
         padded = np.zeros((1, bucket), np.int32)
@@ -624,6 +698,11 @@ class ContinuousBatchingEngine:
             gen.params, self._cache, self._state, padded, np.int32(plen),
             np.int32(slot), knobs, jax.random.PRNGKey(req.seed),
         )
+        first = int(first)  # host sync: the prefill really ran to completion
+        self._now = time.monotonic()
+        self.stats.observe("prefill_chunk_s", self._now - t0)
+        if req.trace is not None:
+            req.trace.mark("prefill", self._now)
         if self._watchdog is not None:
             self._watchdog.poke(self._decode_index)
         if self._use_draft and req.gen.speculative_lookup > 0:
@@ -640,19 +719,35 @@ class ContinuousBatchingEngine:
         self._slot_budget[slot] = min(req.gen.max_new_tokens, self._buf_len - plen)
         self._live[slot] = True
         self.stats.incr("requests_admitted")
-        self._emit_token(slot, req, int(first))
+        self._emit_token(slot, req, first)
+
+    def _tick_done(self, t0: float) -> None:
+        """Per-tick epilogue shared by all four decode variants: stamp the
+        tick clock (every emit on this tick reuses it), observe the tick
+        duration, poke the watchdog, bump counters, and drop one flight-
+        recorder event summarizing the tick."""
+        self._now = time.monotonic()
+        self.stats.observe("decode_tick_s", self._now - t0)
+        if self._watchdog is not None:
+            self._watchdog.poke(self._decode_index)
+        self.stats.incr("decode_steps")
+        self.recorder.record(
+            "tick",
+            step=self._decode_index,
+            live=int(self._live.sum()),
+            dt_ms=round((self._now - t0) * 1000.0, 3),
+        )
 
     def _decode_once(self, step) -> None:
         gen = self._generator
+        t0 = time.monotonic()
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         self._cache, self._state, toks = step(
             gen.params, self._cache, self._state, self._live.copy()
         )
         toks = np.asarray(toks)  # the host sync a wedged link would hang
-        if self._watchdog is not None:
-            self._watchdog.poke(self._decode_index)
-        self.stats.incr("decode_steps")
+        self._tick_done(t0)
         for slot in range(self._slots):
             req = self._slot_req[slot]
             if req is None:
@@ -726,6 +821,7 @@ class ContinuousBatchingEngine:
         ONE jitted target forward verifies all slots' K+1 positions and
         emits each slot's accepted prefix + one model-sampled token."""
         gen = self._generator
+        t0 = time.monotonic()
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         drafts, n_draft = self._propose_drafts()
@@ -735,9 +831,7 @@ class ContinuousBatchingEngine:
         )
         toks = np.asarray(toks)  # the host sync a wedged link would hang
         n_emit = np.asarray(n_emit)
-        if self._watchdog is not None:
-            self._watchdog.poke(self._decode_index)
-        self.stats.incr("decode_steps")
+        self._tick_done(t0)
         self._emit_spec(toks, n_emit, n_draft)
 
     def _emit_spec(self, toks: np.ndarray, n_emit: np.ndarray,
@@ -747,6 +841,7 @@ class ContinuousBatchingEngine:
         Per-tick accepted-draft count is ``n_emit - 1``: a live slot always
         emits its model-sampled token (the rejection replacement or the
         bonus), so everything before it is an accepted draft."""
+        tick_proposed = tick_accepted = 0
         for slot in range(self._slots):
             req = self._slot_req[slot]
             if req is None or not self._live[slot]:
@@ -764,10 +859,20 @@ class ContinuousBatchingEngine:
                 req.draft_tokens_accepted += accepted
                 self.stats.incr("draft_tokens_proposed", proposed)
                 self.stats.incr("draft_tokens_accepted", accepted)
+                self.stats.observe("spec_run_len", accepted)
+                tick_proposed += proposed
+                tick_accepted += accepted
             for j in range(m):
                 self._emit_token(slot, req, int(toks[slot, j]))
                 if self._slot_req[slot] is not req:
                     break  # EOS or budget finished the request mid-run
+        if tick_proposed:
+            self.recorder.record(
+                "spec",
+                step=self._decode_index,
+                proposed=tick_proposed,
+                accepted=tick_accepted,
+            )
 
     def _emit_token(self, slot: int, req: Request, tok: int) -> None:
         if tok in self._eos:
@@ -775,6 +880,20 @@ class ContinuousBatchingEngine:
             return
         self._slot_tokens[slot].append(tok)
         self.stats.incr("tokens_served")
+        # latency accounting against the tick clock stamped in _tick_done /
+        # the prefill epilogue — no clock read per token. Tokens emitted on
+        # the same tick (speculation) land 0 apart, which is the truth: the
+        # client got them in one burst.
+        now = self._now
+        if req.first_token_t is None:
+            req.first_token_t = now
+            if req.enqueued_at:
+                self.stats.observe("ttft_s", now - req.enqueued_at)
+            if req.trace is not None:
+                req.trace.mark("first_token", now)
+        elif req.last_token_t is not None:
+            self.stats.observe("inter_token_s", now - req.last_token_t)
+        req.last_token_t = now
         if req.tokens_q is not None:
             req.tokens_q.put(tok)
         if len(self._slot_tokens[slot]) >= self._slot_budget[slot]:
@@ -782,6 +901,8 @@ class ContinuousBatchingEngine:
 
     def _finish(self, slot: int, req: Request) -> None:
         req.result = self._slot_tokens[slot]
+        if req.trace is not None:
+            req.trace.mark("completed", self._now)
         if req.draft_tokens_proposed:
             req.spec_acceptance = (
                 req.draft_tokens_accepted / req.draft_tokens_proposed
@@ -1046,6 +1167,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         private = self._allocator.alloc(nprivate)
         if private is None:
             self._prefix.evict(nprivate)
+            self.recorder.record(
+                "prefix_evict", request=req.id, blocks_needed=nprivate
+            )
             private = self._allocator.alloc(nprivate)
         if private is None:
             for bid in shared:
@@ -1079,6 +1203,18 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._slot_tokens[slot] = []
         self._slot_budget[slot] = plan["budget"]
         shared_len = len(plan["shared"]) * self._block_len
+        now = time.monotonic()
+        if req.trace is not None:
+            req.trace.mark("admitted", now)
+        if req.enqueued_at:
+            self.stats.observe("queue_wait_s", now - req.enqueued_at)
+        self.recorder.record(
+            "admit",
+            request=req.id,
+            slot=slot,
+            prompt_tokens=plan["plen"],
+            prefix_tokens_reused=shared_len,
+        )
         self.stats.incr("requests_admitted")
         self.stats.incr("prompt_tokens", plan["plen"])
         self.stats.incr("prefix_tokens_reused", shared_len)
@@ -1103,6 +1239,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.faults.maybe_fail_prefill()
         import jax
 
+        t0 = time.monotonic()
         C = self._prefill_chunk
         remaining = task.plen - task.next
         table = np.ascontiguousarray(self._table[task.slot : task.slot + 1])
@@ -1116,8 +1253,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self._cache = ingest(
                 gen.params, self._cache, table, chunk, np.int32(task.next)
             )
+            # sync before timing: the single device stream serializes this
+            # against the next decode dispatch anyway, so blocking here only
+            # moves the wait — it does not add one — and it makes the chunk
+            # histogram measure device time, not dispatch time
+            jax.block_until_ready(self._cache)
             task.next += C
             self.stats.incr("prefill_chunks")
+            self.stats.observe("prefill_chunk_s", time.monotonic() - t0)
+            if req.trace is not None:
+                req.trace.mark("prefill_chunk")
             if self._watchdog is not None:
                 self._watchdog.poke(self._decode_index)
             return
@@ -1135,8 +1280,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             np.int32(task.slot), self._knob_arrays(req),
             jax.random.PRNGKey(req.seed),
         )
+        first = int(first)  # host sync: the final chunk really landed
+        self._now = time.monotonic()
         self._prefills.pop(0)
         self.stats.incr("prefill_chunks")
+        self.stats.observe("prefill_chunk_s", self._now - t0)
+        if req.trace is not None:
+            req.trace.mark("prefill", self._now)
         if self._watchdog is not None:
             self._watchdog.poke(self._decode_index)
         if self._use_draft and req.gen.speculative_lookup > 0:
@@ -1157,7 +1307,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         full = task.plen // self._block_len
         self._prefix.insert(task.keys[:full], self._slot_blocks[task.slot][:full])
         self._live[task.slot] = True
-        self._emit_token(task.slot, req, int(first))
+        self._emit_token(task.slot, req, first)
 
     def _decode_bucket(self, lookahead: int) -> int:
         """Power-of-two block-count bucket covering every live slot's
@@ -1189,15 +1339,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         nb = self._decode_bucket(0)
         tables = self._decode_tables(nb)
         step = gen.paged_step(self._slots, nb, self._block_len)
+        t0 = time.monotonic()
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         self._cache, self._state, toks = step(
             gen.params, self._cache, self._state, self._live.copy(), tables
         )
         toks = np.asarray(toks)
-        if self._watchdog is not None:
-            self._watchdog.poke(self._decode_index)
-        self.stats.incr("decode_steps")
+        self._tick_done(t0)
         self.stats.gauge_max("peak_blocks_in_use", self._allocator.used_count)
         for slot in range(self._slots):
             req = self._slot_req[slot]
@@ -1217,6 +1366,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         gen = self._generator
         nb = self._decode_bucket(self._spec_k)
         tables = self._decode_tables(nb)
+        t0 = time.monotonic()
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         drafts, n_draft = self._propose_drafts()
@@ -1227,9 +1377,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         )
         toks = np.asarray(toks)
         n_emit = np.asarray(n_emit)
-        if self._watchdog is not None:
-            self._watchdog.poke(self._decode_index)
-        self.stats.incr("decode_steps")
+        self._tick_done(t0)
         self.stats.gauge_max("peak_blocks_in_use", self._allocator.used_count)
         self._emit_spec(toks, n_emit, n_draft)
 

@@ -8,10 +8,16 @@ closes that gap with a dependency-free stdlib server exposing:
   GET  /healthz                      -> 200 "ok" (readiness probe target);
                                         503 while draining, circuit-open,
                                         or multi-host-wedged
-  GET  /v1/stats                     -> serving counters/gauges (JSON)
+  GET  /v1/stats                     -> serving counters/gauges + histogram
+                                        percentile summaries + HBM report
+                                        (JSON)
+  GET  /metrics                      -> the same telemetry as Prometheus
+                                        text exposition (scrape target)
   POST /v1/generate {"question": .., -> {"answer": ..}
         optional: "max_new_tokens", "temperature", "top_p", "top_k",
-                  "repetition_penalty", "greedy", "seed", "system_prompt"}
+                  "repetition_penalty", "greedy", "seed", "system_prompt",
+                  "trace" (true -> response carries the request's
+                  lifecycle span timeline)}
 
 Failures surface through the taxonomy in infer/errors.py: queue overflow
 is a 429 with a finite ``Retry-After`` derived from observed service time,
@@ -82,6 +88,8 @@ def serve(
     circuit_threshold: int = 5,
     circuit_window_s: float = 60.0,
     watchdog_timeout_s: float = 0.0,
+    flight_dir: Optional[str] = "outputs/flight_recorder",
+    trace_log: Optional[str] = None,
     control: Optional[dict] = None,
 ) -> None:
     """``control``, when given, is populated with the drain entry points
@@ -103,6 +111,11 @@ def serve(
         error_payload,
     )
 
+    from llm_fine_tune_distributed_tpu.observe.metrics import (
+        PROMETHEUS_CONTENT_TYPE,
+        prometheus_exposition,
+    )
+    from llm_fine_tune_distributed_tpu.observe.profiler import device_memory_report
     from llm_fine_tune_distributed_tpu.ops.int8 import QUANTIZE_MODES, maybe_quantize
 
     if quantize not in QUANTIZE_MODES:  # fail fast, before the model load
@@ -192,6 +205,8 @@ def serve(
         "circuit_window_s": circuit_window_s,
         "watchdog_timeout_s": watchdog_timeout_s,
         "speculative_k": speculative_k,
+        "flight_dir": flight_dir or None,
+        "trace_log": trace_log or None,
     }
     if engine_kind in ("continuous", "paged"):
         if coordinator is not None:
@@ -232,6 +247,7 @@ def serve(
             code: int,
             payload: dict | str,
             headers: Optional[dict] = None,
+            content_type: Optional[str] = None,
         ) -> None:
             body = (
                 payload if isinstance(payload, str) else json.dumps(payload)
@@ -239,7 +255,8 @@ def serve(
             self.send_response(code)
             self.send_header(
                 "Content-Type",
-                "text/plain" if isinstance(payload, str) else "application/json",
+                content_type
+                or ("text/plain" if isinstance(payload, str) else "application/json"),
             )
             self.send_header("Content-Length", str(len(body)))
             for k, v in (headers or {}).items():
@@ -295,7 +312,25 @@ def serve(
                         "queue_depth": engine._q.qsize(),
                         "max_batch": max_batch,
                     }
+                stats["device_memory"] = device_memory_report()
                 self._send(200, stats)
+            elif self.path == "/metrics":
+                # Prometheus text exposition: every ServingStats counter/
+                # gauge/histogram plus per-device HBM gauges, scrape-ready
+                if cont_engine is not None:
+                    snap = {"engine": cont_kind, **cont_engine.stats_snapshot()}
+                    hists = cont_engine.stats.hist
+                else:
+                    snap = {
+                        "engine": "window",
+                        "queue_depth": engine._q.qsize(),
+                        "max_batch": max_batch,
+                    }
+                    hists = None
+                text = prometheus_exposition(
+                    snap, hists, memory=device_memory_report()
+                )
+                self._send(200, text, content_type=PROMETHEUS_CONTENT_TYPE)
             else:
                 self._send(404, {"error": "not found"})
 
@@ -486,6 +521,7 @@ def serve(
                 if "speculative" in req:
                     gen_kwargs["speculative_lookup"] = int(req["speculative"])
                 seed = int(req.get("seed", 0))
+                want_trace = bool(req.get("trace", False))
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"bad request: {e}"})
                 return
@@ -542,6 +578,12 @@ def serve(
                     # window engine only: its whole-batch sequential-forward
                     # count (a slot engine has no per-request equivalent)
                     resp["speculative"]["sequential_forwards"] = pending.spec_steps
+            if want_trace and pending.trace is not None:
+                # per-request lifecycle timeline (continuous/paged engines;
+                # the window engine does not trace) — span names and
+                # request-relative times, the client-visible view of the
+                # engine's RequestTrace
+                resp["trace"] = pending.trace.to_dict()
             self._send(200, resp)
 
         def log_message(self, fmt, *args):
@@ -716,6 +758,16 @@ def main(argv: Optional[list] = None) -> int:
              "seconds (wedged device sync; runtime/watchdog.py). Must exceed "
              "the worst-case prefill compile. 0 = off",
     )
+    parser.add_argument(
+        "--flight-dir", default="outputs/flight_recorder",
+        help="directory for flight-recorder JSON dumps (recent engine "
+             "events, written on crash/circuit-open). Empty string disables",
+    )
+    parser.add_argument(
+        "--trace-log", default=None,
+        help="JSONL file appending every settled request's lifecycle trace "
+             "(span + request-relative time). Off by default",
+    )
     args = parser.parse_args(argv)
     if not os.path.isdir(args.model_dir):
         print(f"Error: model directory not found: {args.model_dir!r}")
@@ -734,7 +786,9 @@ def main(argv: Optional[list] = None) -> int:
           restart_backoff_max_s=args.restart_backoff_max_s,
           circuit_threshold=args.circuit_threshold,
           circuit_window_s=args.circuit_window_s,
-          watchdog_timeout_s=args.watchdog_timeout_s)
+          watchdog_timeout_s=args.watchdog_timeout_s,
+          flight_dir=args.flight_dir or None,
+          trace_log=args.trace_log)
     return 0
 
 

@@ -22,6 +22,8 @@ so they take exactly the classification path a real device error would.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from collections import deque
@@ -44,6 +46,7 @@ class EngineSupervisor:
         restart_backoff_max_s: float = 30.0,
         circuit_threshold: int = 5,
         circuit_window_s: float = 60.0,
+        flight_dir: Optional[str] = None,
     ):
         self.restart_backoff_s = max(0.0, float(restart_backoff_s))
         self.restart_backoff_max_s = max(
@@ -51,9 +54,11 @@ class EngineSupervisor:
         )
         self.circuit_threshold = max(1, int(circuit_threshold))
         self.circuit_window_s = float(circuit_window_s)
+        self.flight_dir = flight_dir
         self.generation = 0
         self.circuit_open = False
         self._failures: "deque[float]" = deque()
+        self._dump_seq = 0
 
     def record_failure(self, now: Optional[float] = None) -> str:
         """Record one retryable worker failure; returns ``"restart"`` or
@@ -81,6 +86,40 @@ class EngineSupervisor:
     @property
     def failure_count(self) -> int:
         return len(self._failures)
+
+    def dump_flight(self, recorder, reason: str, error: Optional[str] = None) -> Optional[str]:
+        """Serialize the engine's flight recorder to a JSON artifact.
+
+        Called on the worker thread at the moments worth a post-mortem —
+        after a crash's restart transition has been recorded, and when the
+        circuit opens or a fatal error kills the worker. Returns the
+        artifact path, or ``None`` when no ``flight_dir`` is configured.
+        Dump failures are swallowed: the recorder must never take down a
+        recovery that would otherwise succeed.
+        """
+        if not self.flight_dir or recorder is None:
+            return None
+        try:
+            os.makedirs(self.flight_dir, exist_ok=True)
+            self._dump_seq += 1
+            path = os.path.join(
+                self.flight_dir,
+                f"flight_{reason}_gen{self.generation}_{self._dump_seq}.json",
+            )
+            payload = {
+                "reason": reason,
+                "error": error,
+                "generation": self.generation,
+                "failures_in_window": self.failure_count,
+                "circuit_open": self.circuit_open,
+                "dumped_at_unix": time.time(),
+                "events": recorder.events(),
+            }
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=2)
+            return path
+        except OSError:
+            return None
 
 
 class FaultInjector:

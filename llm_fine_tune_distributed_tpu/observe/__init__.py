@@ -1,2 +1,12 @@
-from llm_fine_tune_distributed_tpu.observe.metrics import MetricLogger  # noqa: F401
+from llm_fine_tune_distributed_tpu.observe.metrics import (  # noqa: F401
+    MetricLogger,
+    ServingStats,
+    prometheus_exposition,
+)
 from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter  # noqa: F401
+from llm_fine_tune_distributed_tpu.observe.tracing import (  # noqa: F401
+    FlightRecorder,
+    Histogram,
+    RequestTrace,
+    TraceJsonlWriter,
+)

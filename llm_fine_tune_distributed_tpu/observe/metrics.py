@@ -20,8 +20,10 @@ import json
 import math
 import os
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional
 
+from llm_fine_tune_distributed_tpu.observe.tracing import Histogram
 from llm_fine_tune_distributed_tpu.runtime.distributed import is_primary_host
 
 
@@ -71,6 +73,14 @@ class ServingStats:
         "queue_depth", "live_slots", "engine_generation",
         "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
     )
+    # latency/shape histograms owned alongside the counters — fixed log
+    # buckets so restart generations and fleet replicas stay mergeable.
+    # spec_run_len is the accepted-run length per drafting slot per tick
+    # (0..K), a count, so it gets linear unit buckets.
+    HISTOGRAM_SPECS = (
+        "ttft_s", "inter_token_s", "queue_wait_s",
+        "decode_tick_s", "prefill_chunk_s", "spec_run_len",
+    )
 
     def __init__(self, slots: int = 0, total_blocks: int = 0):
         self._lock = threading.Lock()
@@ -79,6 +89,20 @@ class ServingStats:
         self._values: Dict[str, int] = {
             k: 0 for k in self.COUNTERS + self.GAUGES
         }
+        self.hist: Dict[str, Histogram] = {
+            name: (
+                Histogram.linear(0.0, 16.0, 1.0)
+                if name == "spec_run_len"
+                else Histogram.exponential()
+            )
+            for name in self.HISTOGRAM_SPECS
+        }
+        self.started_at = time.monotonic()
+        # windowed throughput EWMA (~1 min time constant), advanced lazily
+        # at snapshot time so the token hot path never touches a clock here
+        self._rate_t = self.started_at
+        self._rate_tokens = 0
+        self._rate_ewma: Optional[float] = None
 
     def incr(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -93,9 +117,35 @@ class ServingStats:
         with self._lock:
             self._values[name] = max(self._values[name], int(value))
 
-    def snapshot(self) -> Dict[str, float]:
+    def observe(self, name: str, value: float) -> None:
+        """Record one histogram observation (histograms carry their own
+        locks, so this does not contend with the counter lock)."""
+        self.hist[name].observe(value)
+
+    def _tokens_rate(self, now: float, tokens_served: int) -> float:
+        # irregular-interval EWMA: weight = 1 - exp(-dt/60s), so the gauge
+        # decays toward the instantaneous rate with a ~1 min time constant
+        # regardless of how often /v1/stats is polled. Sub-200ms polls
+        # reuse the last value instead of amplifying quantization noise.
+        dt = now - self._rate_t
+        if dt >= 0.2:
+            inst = max(0, tokens_served - self._rate_tokens) / dt
+            w = 1.0 - math.exp(-dt / 60.0)
+            self._rate_ewma = (
+                inst
+                if self._rate_ewma is None
+                else (1.0 - w) * self._rate_ewma + w * inst
+            )
+            self._rate_t = now
+            self._rate_tokens = tokens_served
+        return self._rate_ewma if self._rate_ewma is not None else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = time.monotonic()
         with self._lock:
-            out: Dict[str, float] = dict(self._values)
+            out: Dict[str, Any] = dict(self._values)
+            out["tokens_per_s_1m"] = self._tokens_rate(now, out["tokens_served"])
+        out["uptime_s"] = now - self.started_at
         out["slots"] = self.slots
         out["slot_occupancy"] = (
             out["live_slots"] / self.slots if self.slots else 0.0
@@ -121,7 +171,81 @@ class ServingStats:
             if out["decode_steps"]
             else 0.0
         )
+        out["histograms"] = {
+            name: h.summary() for name, h in self.hist.items()
+        }
         return out
+
+
+def _prom_name(key: str, prefix: str) -> str:
+    # Prometheus convention wants base-unit suffixes spelled out
+    base = key[:-2] + "_seconds" if key.endswith("_s") else key
+    return f"{prefix}_{base}"
+
+
+def prometheus_exposition(
+    snap: Dict[str, Any],
+    histograms: Optional[Dict[str, Histogram]] = None,
+    memory: Optional[Dict[str, Dict[str, Optional[int]]]] = None,
+    prefix: str = "serving",
+) -> str:
+    """Render a ``ServingStats.snapshot()`` (plus the live histogram
+    objects and an optional ``device_memory_report()``) as Prometheus text
+    exposition (format version 0.0.4).
+
+    Counter keys (``ServingStats.COUNTERS``) get the ``_total`` suffix and
+    ``# TYPE counter``; every other numeric value is a gauge; string
+    values (engine kind, circuit state) collapse into one
+    ``<prefix>_info{...} 1`` info-style line; trailing ``_s`` becomes
+    ``_seconds``. Histograms emit cumulative ``le`` buckets straight from
+    the live ``Histogram`` objects, not the snapshot summaries.
+    """
+    counters = set(ServingStats.COUNTERS)
+    lines: List[str] = []
+    labels = []
+    for key in sorted(snap):
+        value = snap[key]
+        if isinstance(value, str):
+            labels.append(f'{key}="{value}"')
+    if labels:
+        name = f"{prefix}_info"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f'{name}{{{",".join(labels)}}} 1')
+    for key in snap:
+        value = snap[key]
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            continue
+        if key in counters:
+            name = _prom_name(key, prefix) + "_total"
+            lines.append(f"# TYPE {name} counter")
+        else:
+            name = _prom_name(key, prefix)
+            lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value:.10g}")
+    for key in histograms or {}:
+        lines.extend(histograms[key].prometheus_lines(_prom_name(key, prefix)))
+    if memory:
+        by_field = {
+            "bytes_in_use": "device_hbm_bytes_in_use",
+            "peak_bytes_in_use": "device_hbm_peak_bytes_in_use",
+            "bytes_limit": "device_hbm_bytes_limit",
+        }
+        for field, name in by_field.items():
+            emitted_type = False
+            for dev in sorted(memory):
+                value = memory[dev].get(field)
+                if value is None:
+                    continue
+                if not emitted_type:
+                    lines.append(f"# TYPE {name} gauge")
+                    emitted_type = True
+                lines.append(f'{name}{{device="{dev}"}} {int(value)}')
+    return "\n".join(lines) + "\n"
+
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def inject_perplexity(logs: Dict[str, float]) -> Dict[str, float]:

@@ -1,0 +1,247 @@
+"""Request-lifecycle tracing, mergeable latency histograms, and the crash
+flight recorder.
+
+Serving telemetry has to be cheap enough to leave on: every primitive here
+is designed around the engine's single scheduler thread being the hot
+writer and HTTP handler threads being occasional readers.
+
+- ``Histogram``: fixed-bucket counts with a per-instance lock. One
+  ``observe()`` is a ``bisect`` plus three integer adds — nanoseconds next
+  to a decode step. Fixed bounds make histograms *mergeable* across
+  engine restarts and (later) across fleet replicas: same bounds, add the
+  counts. Percentiles interpolate inside the winning bucket, which is as
+  good as latency percentiles ever honestly get.
+- ``RequestTrace``: an append-only list of ``(span, monotonic_t)`` marks.
+  Appends are GIL-atomic, so the scheduler thread never takes a lock to
+  mark a span; readers only look after the request settled.
+- ``TraceJsonlWriter``: terminal-settle export of completed traces, one
+  JSON object per line.
+- ``FlightRecorder``: a bounded ``deque`` of recent engine events. The
+  supervisor dumps it to a JSON artifact on crash/circuit-open so a
+  post-mortem is a file, not log archaeology.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class Histogram:
+    """Thread-safe fixed-bucket histogram with mergeable counts.
+
+    ``bounds`` are the inclusive upper edges of the finite buckets; one
+    implicit overflow bucket catches everything above ``bounds[-1]``.
+    An observation lands in the first bucket whose upper edge is >= value
+    (Prometheus ``le`` semantics).
+    """
+
+    __slots__ = ("bounds", "counts", "total", "sum", "_lock")
+
+    def __init__(self, bounds: Sequence[float]):
+        if not bounds or list(bounds) != sorted(bounds):
+            raise ValueError("histogram bounds must be non-empty and ascending")
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in bounds)
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.total = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- factories
+
+    @classmethod
+    def exponential(
+        cls, lo: float = 1e-4, hi: float = 400.0, factor: float = 2.0
+    ) -> "Histogram":
+        """Log-spaced bounds from ``lo`` doubling up past ``hi`` — the
+        default latency shape: 0.1 ms resolution at the bottom, ~7 min at
+        the top, 22 buckets."""
+        bounds = []
+        b = float(lo)
+        while b <= hi:
+            bounds.append(b)
+            b *= factor
+        return cls(bounds)
+
+    @classmethod
+    def linear(cls, lo: float = 0.0, hi: float = 16.0, step: float = 1.0) -> "Histogram":
+        """Evenly spaced bounds — for small-integer quantities like
+        speculation accepted-run lengths."""
+        n = int(round((hi - lo) / step))
+        return cls([lo + i * step for i in range(n + 1)])
+
+    # ------------------------------------------------------------ hot path
+
+    def observe(self, value: float) -> None:
+        i = bisect_left(self.bounds, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.total += 1
+            self.sum += value
+
+    # ------------------------------------------------------------- readers
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram's counts into this one (same bounds)."""
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different bounds")
+        with other._lock:
+            counts, total, s = list(other.counts), other.total, other.sum
+        with self._lock:
+            for i, c in enumerate(counts):
+                self.counts[i] += c
+            self.total += total
+            self.sum += s
+
+    def _state(self) -> Tuple[List[int], int, float]:
+        with self._lock:
+            return list(self.counts), self.total, self.sum
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 100]) by linear
+        interpolation inside the winning bucket. The overflow bucket
+        reports the last finite bound (a floor, honestly labeled)."""
+        counts, total, _ = self._state()
+        if total == 0:
+            return 0.0
+        rank = q / 100.0 * total
+        seen = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                if i >= len(self.bounds):
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (rank - seen) / c if c else 1.0
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            seen += c
+        return self.bounds[-1]
+
+    def summary(self) -> Dict[str, float]:
+        counts, total, s = self._state()
+        if total == 0:
+            return {"count": 0, "mean": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+        return {
+            "count": total,
+            "mean": s / total,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+    def prometheus_lines(self, name: str) -> List[str]:
+        """Prometheus text exposition: cumulative ``_bucket{le=...}`` lines
+        plus ``_sum`` and ``_count``."""
+        counts, total, s = self._state()
+        lines = [f"# TYPE {name} histogram"]
+        cum = 0
+        for bound, c in zip(self.bounds, counts):
+            cum += c
+            lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{name}_sum {_fmt(s)}")
+        lines.append(f"{name}_count {total}")
+        return lines
+
+
+def _fmt(v: float) -> str:
+    if v != v or v in (math.inf, -math.inf):
+        return "+Inf" if v > 0 else "-Inf"
+    out = f"{v:.10g}"
+    return out
+
+
+class RequestTrace:
+    """Per-request lifecycle timeline: ordered ``(span, monotonic_t)`` marks.
+
+    Created at submit, marked from whichever thread owns the request at
+    that moment (submit thread for received/queued, scheduler thread for
+    everything else). ``list.append`` of a ready tuple is GIL-atomic, so
+    the hot path takes no lock; ``to_dict`` is only called after the
+    request settled (or by the owner of the request record).
+    """
+
+    __slots__ = ("request_id", "t0", "events")
+
+    def __init__(self, request_id: int = 0, t0: Optional[float] = None):
+        self.request_id = request_id
+        self.t0 = time.monotonic() if t0 is None else t0
+        self.events: List[Tuple[str, float]] = []
+
+    def mark(self, span: str, t: Optional[float] = None) -> None:
+        self.events.append((span, time.monotonic() if t is None else t))
+
+    def to_dict(self) -> Dict[str, Any]:
+        events = list(self.events)
+        out = {
+            "request_id": self.request_id,
+            "events": [
+                {"span": span, "t_s": round(t - self.t0, 6)} for span, t in events
+            ],
+        }
+        if events:
+            out["total_s"] = round(events[-1][1] - self.t0, 6)
+        return out
+
+
+class TraceJsonlWriter:
+    """Appends one JSON line per settled request to ``path``.
+
+    Writes happen on the engine scheduler thread at terminal settle; the
+    lock only matters for the window-engine case where settles can race a
+    drain, and it is uncontended in steady state.
+    """
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+class FlightRecorder:
+    """Bounded ring buffer of recent engine events.
+
+    The engine records admissions, sheds, per-tick summaries, speculation
+    acceptance, prefix evictions, drains, crashes, restarts, and circuit
+    transitions here; ``EngineSupervisor.dump_flight`` serializes the ring
+    to a JSON artifact when the worker crashes or the circuit opens. The
+    ``deque(maxlen=...)`` bound means steady-state cost is O(1) per event
+    and memory never grows.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self._events: "deque[Dict[str, Any]]" = deque(maxlen=max(1, int(capacity)))
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+
+    def record(self, kind: str, **fields: Any) -> None:
+        event = {"t_s": round(time.monotonic() - self._t0, 6), "kind": kind}
+        event.update(fields)
+        with self._lock:
+            self._events.append(event)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)

@@ -43,6 +43,7 @@ from llm_fine_tune_distributed_tpu.models.hf_io import load_hf_checkpoint, save_
 from llm_fine_tune_distributed_tpu.models.transformer import init_params
 from llm_fine_tune_distributed_tpu.observe.metrics import MetricLogger
 from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter
+from llm_fine_tune_distributed_tpu.observe.tracing import Histogram
 from llm_fine_tune_distributed_tpu.parallel.freeze import describe_trainable, trainable_mask
 from llm_fine_tune_distributed_tpu.parallel.optimizer import build_lr_schedule, build_optimizer
 from llm_fine_tune_distributed_tpu.parallel.sharding import param_spec
@@ -903,7 +904,10 @@ class SFTTrainer:
             except RuntimeError as e:
                 if is_primary_host():
                     print(f"[runtime] heartbeat unavailable: {e}")
-        from llm_fine_tune_distributed_tpu.observe.profiler import StepProfiler
+        from llm_fine_tune_distributed_tpu.observe.profiler import (
+            StepProfiler,
+            device_memory_report,
+        )
         from llm_fine_tune_distributed_tpu.runtime.desync import DesyncMonitor
 
         desync = DesyncMonitor(cfg.desync_check_steps)
@@ -946,6 +950,29 @@ class SFTTrainer:
         preempted = False
         pending_samples, synced_step = 0, step
 
+        # Per-step phase timing into the serving stack's mergeable histogram
+        # (observe/tracing.Histogram): where does a step's wall clock go —
+        # waiting on the loader, the step itself, or checkpoint IO? Note the
+        # step phase measures HOST-side dispatch under async dispatch; the
+        # steps that land on a log/eval/save boundary include the
+        # block_until_ready and so bound the true device time (the p99).
+        phase_hist = {
+            "data_wait": Histogram.exponential(),
+            "step": Histogram.exponential(),
+            "checkpoint": Histogram.exponential(),
+        }
+
+        def _timed_batches(it):
+            it = iter(it)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                phase_hist["data_wait"].observe(time.perf_counter() - t0)
+                yield batch
+
         try:
             for epoch in range(start_epoch, cfg.epochs):
                 batches = self.loader.epoch(epoch)
@@ -953,10 +980,11 @@ class SFTTrainer:
                     import itertools
 
                     batches = itertools.islice(batches, skip_batches, None)
-                for batch in batches:
+                for batch in _timed_batches(batches):
                     dev_batch = self._device_batch(
                         batch, self._batch_sharding, local_shards=True
                     )
+                    t_step = time.perf_counter()
                     self.state, metrics = self.train_step(self.state, dev_batch)
                     step += 1
                     pending_samples += samples_per_step
@@ -986,6 +1014,7 @@ class SFTTrainer:
                         jax.block_until_ready(metrics["loss"])
                         meter.update(pending_samples, steps=step - synced_step)
                         pending_samples, synced_step = 0, step
+                    phase_hist["step"].observe(time.perf_counter() - t_step)
                     profiler.step(step)
 
                     desync.maybe_check(step, self.state.trainable)
@@ -1044,6 +1073,25 @@ class SFTTrainer:
                             if getattr(self, "_last_eval_answer", None) is not None:
                                 logs["eval_loss_answer"] = self._last_eval_answer
                             logs.update(self.extra_eval_logs)
+                        # phase-timing percentiles into the three sinks —
+                        # the per-step analog of /v1/stats histograms
+                        for pname, ph in phase_hist.items():
+                            psum = ph.summary()
+                            if psum["count"]:
+                                logs[f"phase_{pname}_p50_s"] = round(psum["p50"], 6)
+                                logs[f"phase_{pname}_p99_s"] = round(psum["p99"], 6)
+                        if is_primary_host():
+                            mem = device_memory_report()
+                            if mem:
+                                # summed across local devices; empty on
+                                # backends without memory_stats (CPU)
+                                logs["hbm_bytes_in_use"] = sum(
+                                    d["bytes_in_use"] or 0 for d in mem.values()
+                                )
+                                logs["hbm_peak_bytes_in_use"] = sum(
+                                    d["peak_bytes_in_use"] or 0
+                                    for d in mem.values()
+                                )
                         self.metrics.log(step, step / self.steps_per_epoch, logs)
 
                     if do_save:
@@ -1052,7 +1100,11 @@ class SFTTrainer:
                             # links — IO progress, not a wedge; the NEXT
                             # step's poke re-arms
                             watchdog.pause()
+                        t_ckpt = time.perf_counter()
                         self._ckpt_save(ckpt, step, {cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
+                        phase_hist["checkpoint"].observe(
+                            time.perf_counter() - t_ckpt
+                        )
                     if do_eval or do_save:
                         # eval sweeps / checkpoint saves must not count
                         # against the NEXT steady-state interval (the

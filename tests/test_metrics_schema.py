@@ -1,0 +1,194 @@
+"""Schema regression guard for the observability surfaces.
+
+Pins the key set of ``/v1/stats`` (engine ``stats_snapshot()`` plus the
+server-added fields) and the metric-name set of ``/metrics`` (Prometheus
+text exposition), so a rename or an accidentally dropped counter breaks a
+test instead of a dashboard. Uses a stub generator — the engines' stats
+plumbing is host-side only, so no model is needed to read an idle
+engine's schema.
+"""
+
+import re
+
+import pytest
+
+from llm_fine_tune_distributed_tpu.infer.engine import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
+from llm_fine_tune_distributed_tpu.observe.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    ServingStats,
+    prometheus_exposition,
+)
+
+
+class _StubGenerator:
+    """Just enough surface for engine construction; the worker idles on an
+    empty queue and never touches the device."""
+
+    _multihost = False
+    eos_token_ids = ()
+    has_draft = False
+
+
+def _make(kind):
+    if kind == "paged":
+        return PagedContinuousBatchingEngine(
+            _StubGenerator(), slots=2, buf_len=64, prompt_bucket=16,
+            block_len=16, prefill_chunk=32,
+        )
+    return ContinuousBatchingEngine(
+        _StubGenerator(), slots=2, buf_len=64, prompt_bucket=16
+    )
+
+
+# The /v1/stats contract: engine snapshot keys + the fields infer/server.py
+# adds ("engine", "device_memory"). Grow-only: extend this set when adding
+# telemetry; removals/renames are breaking changes to scrapers.
+SNAPSHOT_KEYS = {
+    # counters
+    "tokens_served", "requests_admitted", "requests_completed",
+    "requests_abandoned", "decode_steps",
+    "prompt_tokens", "prefix_tokens_reused", "prefill_chunks",
+    "engine_restarts", "requests_failed",
+    "requests_shed_overflow", "requests_shed_deadline",
+    "draft_tokens_proposed", "draft_tokens_accepted",
+    # gauges
+    "queue_depth", "live_slots", "engine_generation",
+    "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
+    # derived
+    "tokens_per_s_1m", "uptime_s", "slots", "slot_occupancy",
+    "prefix_hit_rate", "draft_acceptance_rate", "mean_tokens_per_step",
+    "histograms",
+    # supervision (engine.stats_snapshot)
+    "circuit_state", "draining",
+}
+PAGED_ONLY_KEYS = {
+    "total_blocks", "block_pool_occupancy", "peak_block_pool_occupancy",
+}
+HISTOGRAM_KEYS = {
+    "ttft_s", "inter_token_s", "queue_wait_s",
+    "decode_tick_s", "prefill_chunk_s", "spec_run_len",
+}
+SUMMARY_KEYS = {"count", "mean", "p50", "p90", "p99"}
+
+
+@pytest.mark.parametrize("kind", ["continuous", "paged"])
+def test_stats_snapshot_key_schema(kind):
+    snap = _make(kind).stats_snapshot()
+    expected = SNAPSHOT_KEYS - {"engine", "device_memory"}
+    if kind == "paged":
+        expected = expected | PAGED_ONLY_KEYS
+    assert set(snap) == expected
+    assert set(snap["histograms"]) == HISTOGRAM_KEYS
+    for name in HISTOGRAM_KEYS:
+        assert set(snap["histograms"][name]) == SUMMARY_KEYS
+
+
+# The /metrics contract: every # TYPE line the exposition emits for a paged
+# engine snapshot + live histograms + a (fake) two-device memory report.
+EXPECTED_METRICS = {
+    ("serving_info", "gauge"),
+    # counters
+    ("serving_tokens_served_total", "counter"),
+    ("serving_requests_admitted_total", "counter"),
+    ("serving_requests_completed_total", "counter"),
+    ("serving_requests_abandoned_total", "counter"),
+    ("serving_decode_steps_total", "counter"),
+    ("serving_prompt_tokens_total", "counter"),
+    ("serving_prefix_tokens_reused_total", "counter"),
+    ("serving_prefill_chunks_total", "counter"),
+    ("serving_engine_restarts_total", "counter"),
+    ("serving_requests_failed_total", "counter"),
+    ("serving_requests_shed_overflow_total", "counter"),
+    ("serving_requests_shed_deadline_total", "counter"),
+    ("serving_draft_tokens_proposed_total", "counter"),
+    ("serving_draft_tokens_accepted_total", "counter"),
+    # gauges
+    ("serving_queue_depth", "gauge"),
+    ("serving_live_slots", "gauge"),
+    ("serving_engine_generation", "gauge"),
+    ("serving_blocks_in_use", "gauge"),
+    ("serving_peak_blocks_in_use", "gauge"),
+    ("serving_prefix_cache_blocks", "gauge"),
+    ("serving_tokens_per_s_1m", "gauge"),
+    ("serving_uptime_seconds", "gauge"),
+    ("serving_slots", "gauge"),
+    ("serving_slot_occupancy", "gauge"),
+    ("serving_total_blocks", "gauge"),
+    ("serving_block_pool_occupancy", "gauge"),
+    ("serving_peak_block_pool_occupancy", "gauge"),
+    ("serving_prefix_hit_rate", "gauge"),
+    ("serving_draft_acceptance_rate", "gauge"),
+    ("serving_mean_tokens_per_step", "gauge"),
+    ("serving_draining", "gauge"),
+    # histograms (trailing _s -> _seconds; spec_run_len is unitless)
+    ("serving_ttft_seconds", "histogram"),
+    ("serving_inter_token_seconds", "histogram"),
+    ("serving_queue_wait_seconds", "histogram"),
+    ("serving_decode_tick_seconds", "histogram"),
+    ("serving_prefill_chunk_seconds", "histogram"),
+    ("serving_spec_run_len", "histogram"),
+    # per-device HBM
+    ("device_hbm_bytes_in_use", "gauge"),
+    ("device_hbm_peak_bytes_in_use", "gauge"),
+    ("device_hbm_bytes_limit", "gauge"),
+}
+
+FAKE_MEMORY = {
+    "0": {"bytes_in_use": 10, "peak_bytes_in_use": 20, "bytes_limit": 100},
+    "1": {"bytes_in_use": 11, "peak_bytes_in_use": 21, "bytes_limit": 100},
+}
+
+
+def test_metrics_exposition_schema():
+    engine = _make("paged")
+    snap = {"engine": "paged", **engine.stats_snapshot()}
+    text = prometheus_exposition(snap, engine.stats.hist, memory=FAKE_MEMORY)
+    typed = {
+        (m.group(1), m.group(2))
+        for m in re.finditer(r"^# TYPE (\S+) (\S+)$", text, re.M)
+    }
+    assert typed == EXPECTED_METRICS
+    assert "version=0.0.4" in PROMETHEUS_CONTENT_TYPE
+
+
+def test_metrics_exposition_well_formed():
+    """Every non-comment line parses as ``name{labels} value`` with a finite
+    numeric value — the shape a Prometheus scraper requires."""
+    engine = _make("paged")
+    engine.stats.incr("tokens_served", 5)
+    engine.stats.observe("ttft_s", 0.12)
+    snap = {"engine": "paged", **engine.stats_snapshot()}
+    text = prometheus_exposition(snap, engine.stats.hist, memory=FAKE_MEMORY)
+    assert text.endswith("\n")
+    sample = re.compile(
+        r'^[a-zA-Z_][a-zA-Z0-9_]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? [^ ]+$'
+    )
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert sample.match(line), line
+        value = line.rsplit(" ", 1)[1]
+        if value != "+Inf":
+            float(value)
+    assert "serving_tokens_served_total 5" in text
+    assert 'serving_info{' in text and 'engine="paged"' in text
+    assert 'device_hbm_bytes_in_use{device="0"} 10' in text
+    # the served TTFT observation landed in a cumulative bucket
+    assert re.search(r'serving_ttft_seconds_bucket\{le="0\.1024"\} 0', text)
+    assert re.search(r'serving_ttft_seconds_bucket\{le="0\.2048"\} 1', text)
+    assert "serving_ttft_seconds_count 1" in text
+
+
+def test_window_fallback_exposition():
+    """The window engine has no ServingStats; the server's reduced snapshot
+    still renders a valid exposition (no histograms, no paged keys)."""
+    text = prometheus_exposition(
+        {"engine": "window", "queue_depth": 0, "max_batch": 8}, None, memory={}
+    )
+    assert 'serving_info{engine="window"} 1' in text
+    assert "serving_queue_depth 0" in text
+    assert "histogram" not in text

@@ -1,0 +1,325 @@
+"""Observability primitives (observe/tracing.py) and their engine wiring:
+mergeable fixed-bucket histograms under concurrent mutation, per-request
+lifecycle traces, the trace JSONL export, and the crash flight recorder —
+including the acceptance gate that an injected decode crash produces a
+flight-recorder artifact holding both the pre-crash tick events AND the
+restart transition.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
+from llm_fine_tune_distributed_tpu.infer.engine import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
+from llm_fine_tune_distributed_tpu.infer.errors import RetryableEngineError
+from llm_fine_tune_distributed_tpu.infer.supervisor import EngineSupervisor
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+from llm_fine_tune_distributed_tpu.observe.tracing import (
+    FlightRecorder,
+    Histogram,
+    RequestTrace,
+    TraceJsonlWriter,
+)
+
+GREEDY = GenerationConfig(max_new_tokens=6, do_sample=False)
+
+
+@pytest.fixture(scope="module")
+def generator():
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    return Generator(
+        params, mc, ByteChatMLTokenizer(), compute_dtype=jnp.float32, eos_token_ids=[]
+    )
+
+
+# ------------------------------------------------------------- histograms
+
+
+def test_histogram_bucketing_and_percentiles():
+    h = Histogram([0.001, 0.01, 0.1, 1.0])
+    for v in (0.0005, 0.005, 0.005, 0.05, 0.5):
+        h.observe(v)
+    assert h.total == 5
+    assert h.counts == [1, 2, 1, 1, 0]
+    assert h.sum == pytest.approx(0.5605)
+    # p50 lands in the (0.001, 0.01] bucket, interpolated inside it
+    assert 0.001 < h.percentile(50) <= 0.01
+    assert 0.1 < h.percentile(99) <= 1.0
+    s = h.summary()
+    assert s["count"] == 5
+    assert s["mean"] == pytest.approx(0.1121)
+
+
+def test_histogram_empty_and_overflow():
+    h = Histogram([1.0, 2.0])
+    assert h.percentile(50) == 0.0
+    assert h.summary() == {
+        "count": 0, "mean": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0,
+    }
+    h.observe(100.0)  # overflow bucket
+    assert h.counts == [0, 0, 1]
+    # overflow reports the last finite bound — a floor, not an invention
+    assert h.percentile(99) == 2.0
+
+
+def test_histogram_merge_requires_same_bounds():
+    a = Histogram([1.0, 2.0])
+    b = Histogram([1.0, 2.0])
+    a.observe(0.5)
+    b.observe(1.5)
+    b.observe(9.0)
+    a.merge(b)
+    assert a.total == 3
+    assert a.counts == [1, 1, 1]
+    assert a.sum == pytest.approx(11.0)
+    with pytest.raises(ValueError):
+        a.merge(Histogram([1.0, 3.0]))
+
+
+def test_histogram_factories():
+    e = Histogram.exponential(lo=1e-4, hi=400.0, factor=2.0)
+    assert e.bounds[0] == pytest.approx(1e-4)
+    assert e.bounds[-1] <= 400.0 * 2.0
+    assert all(b2 / b1 == pytest.approx(2.0) for b1, b2 in zip(e.bounds, e.bounds[1:]))
+    lin = Histogram.linear(0.0, 16.0, 1.0)
+    assert lin.bounds == tuple(float(i) for i in range(17))
+    with pytest.raises(ValueError):
+        Histogram([])
+    with pytest.raises(ValueError):
+        Histogram([2.0, 1.0])
+
+
+def test_histogram_prometheus_lines_cumulative():
+    h = Histogram([0.1, 1.0])
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    lines = h.prometheus_lines("x_seconds")
+    assert lines[0] == "# TYPE x_seconds histogram"
+    assert 'x_seconds_bucket{le="0.1"} 1' in lines
+    assert 'x_seconds_bucket{le="1"} 2' in lines
+    assert 'x_seconds_bucket{le="+Inf"} 3' in lines
+    assert "x_seconds_count 3" in lines
+
+
+def test_histogram_concurrent_mutation_exact_totals():
+    """Writer threads hammer observe() while readers take summaries; the
+    final counts are exact — no lost updates."""
+    h = Histogram.exponential()
+    per_thread, writers = 2000, 4
+    stop = threading.Event()
+
+    def write():
+        for i in range(per_thread):
+            h.observe(0.0001 * (1 + i % 50))
+
+    def read():
+        while not stop.is_set():
+            s = h.summary()
+            assert 0 <= s["count"] <= per_thread * writers
+
+    readers = [threading.Thread(target=read) for _ in range(2)]
+    threads = [threading.Thread(target=write) for _ in range(writers)]
+    for t in readers + threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert h.total == per_thread * writers
+    assert sum(h.counts) == per_thread * writers
+
+
+def test_serving_stats_concurrent_mutation():
+    """Counters + histograms mutated from several threads while snapshots
+    are taken concurrently: final totals are exact and every snapshot is
+    internally consistent."""
+    stats = ServingStats(slots=4, total_blocks=8)
+    per_thread, writers = 1000, 4
+    stop = threading.Event()
+
+    def write():
+        for _ in range(per_thread):
+            stats.incr("tokens_served")
+            stats.observe("inter_token_s", 0.01)
+
+    def read():
+        while not stop.is_set():
+            snap = stats.snapshot()
+            assert snap["tokens_served"] <= per_thread * writers
+            assert snap["histograms"]["inter_token_s"]["count"] <= (
+                per_thread * writers
+            )
+
+    readers = [threading.Thread(target=read) for _ in range(2)]
+    threads = [threading.Thread(target=write) for _ in range(writers)]
+    for t in readers + threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    snap = stats.snapshot()
+    assert snap["tokens_served"] == per_thread * writers
+    assert snap["histograms"]["inter_token_s"]["count"] == per_thread * writers
+    assert snap["uptime_s"] > 0.0
+    assert snap["tokens_per_s_1m"] >= 0.0
+
+
+# ----------------------------------------------------------------- traces
+
+
+def test_request_trace_marks_and_dict():
+    tr = RequestTrace(request_id=7, t0=100.0)
+    tr.mark("received", t=100.0)
+    tr.mark("queued", t=100.0)
+    tr.mark("admitted", t=100.5)
+    tr.mark("completed", t=101.25)
+    d = tr.to_dict()
+    assert d["request_id"] == 7
+    assert [e["span"] for e in d["events"]] == [
+        "received", "queued", "admitted", "completed",
+    ]
+    assert d["events"][2]["t_s"] == pytest.approx(0.5)
+    assert d["total_s"] == pytest.approx(1.25)
+
+
+def test_trace_jsonl_writer(tmp_path):
+    path = str(tmp_path / "sub" / "traces.jsonl")
+    w = TraceJsonlWriter(path)
+    w.write({"request_id": 1, "total_s": 0.5})
+    w.write({"request_id": 2, "total_s": 0.7})
+    w.close()
+    with open(path) as f:
+        records = [json.loads(line) for line in f]
+    assert [r["request_id"] for r in records] == [1, 2]
+
+
+# -------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_bounded_ring():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("tick", step=i)
+    assert len(rec) == 4
+    events = rec.events()
+    assert [e["step"] for e in events] == [6, 7, 8, 9]
+    assert all(e["kind"] == "tick" and e["t_s"] >= 0.0 for e in events)
+
+
+def test_supervisor_dump_flight(tmp_path):
+    sup = EngineSupervisor(flight_dir=str(tmp_path / "flight"))
+    rec = FlightRecorder(capacity=8)
+    rec.record("tick", step=1)
+    rec.record("crash", step=2, error="boom")
+    path = sup.dump_flight(rec, "crash_restart", error="boom")
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "crash_restart"
+    assert payload["error"] == "boom"
+    assert [e["kind"] for e in payload["events"]] == ["tick", "crash"]
+    # no flight_dir configured -> dump is a no-op, never an error
+    assert EngineSupervisor().dump_flight(rec, "crash_restart") is None
+
+
+# ----------------------------------------------------- engine integration
+
+
+def _prompts():
+    tok = ByteChatMLTokenizer()
+    return [tok.encode(t) for t in ("alpha", "beta bravo", "the quick brown fox")]
+
+
+@pytest.mark.parametrize("kind", ["continuous", "paged"])
+def test_engine_request_trace_spans(generator, kind, tmp_path):
+    """A served request carries a full lifecycle trace (received -> queued
+    -> admitted -> prefill -> first_token -> completed, in time order), the
+    latency histograms fill, and the settled trace lands in the JSONL log."""
+    trace_log = str(tmp_path / "traces.jsonl")
+    kw = dict(slots=4, buf_len=96, prompt_bucket=16, trace_log=trace_log)
+    if kind == "paged":
+        engine = PagedContinuousBatchingEngine(
+            generator, block_len=16, prefill_chunk=32, **kw
+        )
+    else:
+        engine = ContinuousBatchingEngine(generator, **kw)
+    req = engine.submit_full(_prompts()[0], GREEDY, timeout=240)
+    assert req.result is not None
+    spans = [s for s, _ in req.trace.events]
+    for expected in ("received", "queued", "admitted", "first_token", "completed"):
+        assert expected in spans, spans
+    assert any(s.startswith("prefill") for s in spans)
+    times = [t for _, t in req.trace.events]
+    assert times == sorted(times)  # lifecycle is time-ordered
+
+    snap = engine.stats_snapshot()
+    hists = snap["histograms"]
+    assert hists["ttft_s"]["count"] == 1
+    # 6 new tokens -> 5 inter-token gaps
+    assert hists["inter_token_s"]["count"] == GREEDY.max_new_tokens - 1
+    assert hists["queue_wait_s"]["count"] == 1
+    assert hists["decode_tick_s"]["count"] >= 1
+    assert hists["prefill_chunk_s"]["count"] >= 1
+    with open(trace_log) as f:
+        records = [json.loads(line) for line in f]
+    assert len(records) == 1
+    assert records[0]["request_id"] == req.id
+    assert records[0]["generated_tokens"] == GREEDY.max_new_tokens
+    assert records[0]["error"] is None
+    assert {e["span"] for e in records[0]["events"]} >= {
+        "received", "admitted", "first_token", "completed",
+    }
+
+
+@pytest.mark.parametrize("kind", ["continuous", "paged"])
+def test_crash_dumps_flight_with_restart_transition(generator, kind, tmp_path):
+    """The acceptance gate: an injected decode crash dumps a flight artifact
+    containing pre-crash tick events AND the crash -> restart transition."""
+    flight_dir = str(tmp_path / "flight")
+    kw = dict(
+        slots=4, buf_len=96, prompt_bucket=16,
+        restart_backoff_s=0.01, restart_backoff_max_s=0.02,
+        flight_dir=flight_dir,
+    )
+    if kind == "paged":
+        engine = PagedContinuousBatchingEngine(
+            generator, block_len=16, prefill_chunk=32, **kw
+        )
+    else:
+        engine = ContinuousBatchingEngine(generator, **kw)
+    prompts = _prompts()
+    assert engine.submit(prompts[0], GREEDY, timeout=240) is not None  # warm
+    engine.faults.fail_decode_next(1)
+    with pytest.raises(RetryableEngineError):
+        engine.submit(prompts[1], GREEDY, timeout=60)
+    assert engine.submit(prompts[1], GREEDY, timeout=240) is not None  # healed
+
+    dumps = sorted(os.listdir(flight_dir))
+    assert len(dumps) == 1 and dumps[0].startswith("flight_crash_restart")
+    with open(os.path.join(flight_dir, dumps[0])) as f:
+        payload = json.load(f)
+    kinds = [e["kind"] for e in payload["events"]]
+    assert "tick" in kinds          # pre-crash decode activity
+    assert "crash" in kinds
+    assert "restart" in kinds       # the recovery transition made the dump
+    assert kinds.index("crash") < kinds.index("restart")
+    assert payload["reason"] == "crash_restart"
+    restart = next(e for e in payload["events"] if e["kind"] == "restart")
+    assert restart["generation"] >= 1
+    crash = next(e for e in payload["events"] if e["kind"] == "crash")
+    assert "injected decode failure" in crash["error"]

@@ -266,6 +266,75 @@ def test_stats_endpoint_window_engine(model_dir):
         stats = json.loads(r.read())
     assert stats["engine"] == "window"
     assert "queue_depth" in stats
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert 'serving_info{engine="window"} 1' in text  # reduced, still valid
+
+
+def test_stats_histograms_and_memory(server):
+    """/v1/stats carries latency-percentile summaries and the HBM report."""
+    with urllib.request.urlopen(f"{server}/v1/stats", timeout=30) as r:
+        stats = json.loads(r.read())
+    hists = stats["histograms"]
+    for name in ("ttft_s", "inter_token_s", "queue_wait_s", "decode_tick_s"):
+        assert {"count", "mean", "p50", "p90", "p99"} <= set(hists[name])
+    assert stats["uptime_s"] > 0.0
+    assert stats["tokens_per_s_1m"] >= 0.0
+    assert isinstance(stats["device_memory"], dict)  # {} on CPU
+
+
+def test_metrics_endpoint_prometheus(server):
+    """GET /metrics: Prometheus text exposition with the latency histograms
+    after at least one request has been served."""
+    req = urllib.request.Request(
+        f"{server}/v1/generate",
+        data=json.dumps({"question": "q?", "max_new_tokens": 4, "greedy": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        r.read()
+    with urllib.request.urlopen(f"{server}/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert "version=0.0.4" in r.headers["Content-Type"]
+        text = r.read().decode()
+    assert "# TYPE serving_tokens_served_total counter" in text
+    assert "# TYPE serving_ttft_seconds histogram" in text
+    assert "# TYPE serving_inter_token_seconds histogram" in text
+    count_lines = [
+        line for line in text.splitlines()
+        if line.startswith("serving_ttft_seconds_count")
+    ]
+    assert count_lines and int(count_lines[0].split()[-1]) >= 1
+
+
+def test_generate_with_trace(server):
+    """'trace': true -> the response carries the request's lifecycle span
+    timeline (received -> ... -> completed, nondecreasing offsets)."""
+    req = urllib.request.Request(
+        f"{server}/v1/generate",
+        data=json.dumps({
+            "question": "q?", "max_new_tokens": 4, "greedy": True,
+            "trace": True,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        payload = json.loads(r.read())
+    trace = payload["trace"]
+    spans = [e["span"] for e in trace["events"]]
+    for expected in ("received", "queued", "admitted", "first_token", "completed"):
+        assert expected in spans, spans
+    offsets = [e["t_s"] for e in trace["events"]]
+    assert offsets == sorted(offsets)
+    assert trace["total_s"] >= 0.0
+    # without the flag the response stays lean
+    lean = urllib.request.Request(
+        f"{server}/v1/generate",
+        data=json.dumps({"question": "q?", "max_new_tokens": 4, "greedy": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(lean, timeout=120) as r:
+        assert "trace" not in json.loads(r.read())
 
 
 # ------------------------------------------------- engine-level speculation
