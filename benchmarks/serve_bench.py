@@ -31,13 +31,23 @@ drafting pays off — on the paged engine with the fused draft/verify step
 SAME prompts, and reports tokens/sec, draft acceptance rate and mean
 verified-tokens-per-forward alongside the speedup.
 
+The fleet arm runs a FOURTH workload — several distinct long system
+prefixes, short question suffixes — against EngineFleet configurations at
+CONSTANT total slot capacity: 1 replica as the baseline, then 2 replicas
+under each routing policy. Prefix-affinity routing sends all traffic for
+one prefix to one replica (each prefix is prefilled once fleet-wide);
+round-robin scatters every prefix across all replicas (each replica pays
+its own first-touch prefill), so the JSON lines carry the fleet
+prefix-hit-rate per policy — the number affinity routing exists to raise.
+
 Usage: python benchmarks/serve_bench.py   (CPU ok: defaults to the tiny
 preset off-accelerator). Env: SERVE_PRESET, SERVE_CLIENTS=1,8,32,
 SERVE_REQS_PER_CLIENT (default 4), SERVE_SLOTS (default 8),
 SERVE_ENGINES=continuous,paged,window, SERVE_CHAOS=1 (chaos arm: inject one
 retryable decode failure mid-workload and report recovery wall time plus
 TTFT after recovery; SERVE_CHAOS_CLIENTS=8), SERVE_SPEC=1 (speculative arm;
-SERVE_SPEC_K=4, SERVE_SPEC_CLIENTS=16).
+SERVE_SPEC_K=4, SERVE_SPEC_CLIENTS=16), SERVE_FLEET=1 (fleet arm;
+SERVE_FLEET_CLIENTS=8).
 """
 
 import json
@@ -89,6 +99,31 @@ def _prefix_workload(rng, vocab, n, prefix_len=192):
         )
         suffix = rng.randint(0, min(vocab, 256), (slen,)).tolist()
         out.append((system + suffix, gen, i))
+    return out
+
+
+def _multi_prefix_workload(rng, vocab, n, prefixes=8, prefix_len=160):
+    """Fleet-affinity pool: ``prefixes`` DISTINCT long system prefixes,
+    each followed by a short random question suffix, interleaved. One
+    shared prefix (``_prefix_workload``) cannot separate routing policies
+    — every replica warms it once and then everything hits. Several
+    prefixes can: affinity keeps each prefix's traffic on one replica (one
+    first-touch prefill per prefix fleet-wide) while round-robin scatters
+    it (one first-touch prefill per prefix PER replica). All-greedy so the
+    sweep measures placement, not sampling variance."""
+    from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+
+    systems = [
+        rng.randint(0, min(vocab, 256), (prefix_len,)).tolist()
+        for _ in range(prefixes)
+    ]
+    out = []
+    for i in range(n):
+        slen = int(rng.choice([8, 16, 32]))
+        max_new = int(rng.choice([8, 16]))
+        gen = GenerationConfig(max_new_tokens=max_new, do_sample=False)
+        suffix = rng.randint(0, min(vocab, 256), (slen,)).tolist()
+        out.append((systems[i % prefixes] + suffix, gen, i))
     return out
 
 
@@ -438,6 +473,96 @@ def main():
                 "unit": "x over non-speculative paged engine (repetitive)",
                 "speculative_k": spec_k,
                 "clients": spec_clients,
+            }), flush=True)
+
+    # fleet arm: multi-prefix workload against 1- and 2-replica fleets at
+    # constant total slot capacity, one run per routing policy — the
+    # prefix-hit-rate separation is the router's reason to exist
+    if os.environ.get("SERVE_FLEET", "1") == "1" and "paged" in engines:
+        from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
+
+        fleet_clients = int(os.environ.get("SERVE_FLEET_CLIENTS", "8"))
+        fleet_load = _multi_prefix_workload(
+            np.random.RandomState(3), mc.vocab_size, 64
+        )
+        # warmup pool: same SHAPES (prompt buckets, greedy budgets) so every
+        # jit program the sweep hits is compiled before the clock starts, but
+        # different prefixes, so the timed run's first touches stay cold
+        fleet_warm = _multi_prefix_workload(
+            np.random.RandomState(4), mc.vocab_size, 8
+        )
+        fleet_runs = {}
+        for n_replicas, routing in (
+            (1, "prefix"),
+            (2, "prefix"),
+            (2, "least-loaded"),
+            (2, "round-robin"),
+        ):
+            per_slots = max(2, slots // n_replicas)  # constant total capacity
+            fleet = EngineFleet(
+                [
+                    PagedContinuousBatchingEngine(
+                        generator, slots=per_slots, buf_len=256,
+                        prompt_bucket=32, block_len=32, prefill_chunk=64,
+                    )
+                    for _ in range(n_replicas)
+                ],
+                routing=routing,
+            )
+            # measure hit rate as a delta so warmup traffic doesn't dilute it
+            _run_config(fleet, 2, 4, fleet_warm)
+            pre = fleet.stats_snapshot()
+            total, dt, errors, lats = _run_config(
+                fleet, fleet_clients, reqs_per_client, fleet_load
+            )
+            tps = total / dt if dt > 0 else 0.0
+            snap = fleet.stats_snapshot()
+            ptoks = snap["prompt_tokens"] - pre["prompt_tokens"]
+            reused = snap["prefix_tokens_reused"] - pre["prefix_tokens_reused"]
+            hit_rate = reused / ptoks if ptoks else 0.0
+            fleet_runs[(n_replicas, routing)] = (tps, hit_rate)
+            tag = f"r{n_replicas}_{routing.replace('-', '_')}"
+            print(json.dumps({
+                "metric": f"serve_tokens_per_sec_fleet_{tag}_c{fleet_clients}",
+                "value": round(tps, 2),
+                "unit": "tokens/sec",
+                "engine": "paged_fleet",
+                "workload": "multi_prefix",
+                "replicas": n_replicas,
+                "routing": routing,
+                "slots_per_replica": per_slots,
+                "clients": fleet_clients,
+                "requests": fleet_clients * reqs_per_client,
+                "tokens_served": total,
+                "wall_seconds": round(dt, 2),
+                "prefix_hit_rate": round(hit_rate, 4),
+                "requests_routed_prefix_affinity":
+                    snap["requests_routed_prefix_affinity"],
+                "requests_routed_least_loaded":
+                    snap["requests_routed_least_loaded"],
+                "requests_routed_round_robin":
+                    snap["requests_routed_round_robin"],
+                "requests_failed_over": snap["requests_failed_over"],
+                "requests_rerouted_overflow":
+                    snap["requests_rerouted_overflow"],
+                "model": preset,
+                "platform": jax.devices()[0].platform,
+                "errors": errors,
+                **_latency_fields(lats, fleet),
+            }), flush=True)
+        two_prefix = fleet_runs.get((2, "prefix"))
+        two_rr = fleet_runs.get((2, "round-robin"))
+        if two_prefix and two_rr:
+            print(json.dumps({
+                "metric": "serve_fleet_prefix_affinity_hit_rate_gain",
+                "value": round(two_prefix[1] - two_rr[1], 4),
+                "unit": "prefix hit-rate delta, prefix routing vs round-robin"
+                        " (2 replicas, multi-prefix)",
+                "prefix_hit_rate_prefix_routing": round(two_prefix[1], 4),
+                "prefix_hit_rate_round_robin": round(two_rr[1], 4),
+                "tokens_per_sec_prefix_routing": round(two_prefix[0], 2),
+                "tokens_per_sec_round_robin": round(two_rr[0], 2),
+                "clients": fleet_clients,
             }), flush=True)
 
     # chaos arm: one injected decode failure mid-workload; reports recovery

@@ -5,6 +5,7 @@ from llm_fine_tune_distributed_tpu.infer.engine import (
     ContinuousBatchingEngine,
     PagedContinuousBatchingEngine,
 )
+from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
 from llm_fine_tune_distributed_tpu.infer.generate import (
     Generator,
     load_model_dir,
@@ -14,6 +15,7 @@ from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "EngineFleet",
     "PagedContinuousBatchingEngine",
     "Generator",
     "GenerationConfig",

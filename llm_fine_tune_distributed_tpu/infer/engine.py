@@ -351,6 +351,43 @@ class ContinuousBatchingEngine:
             return "open"
         return "closed" if self._terminal is None else "fatal"
 
+    # Router-facing probes (infer/fleet.py): plain host-side reads a fleet
+    # front-door polls per placement. All are GIL-atomic snapshots of
+    # worker-owned state — a stale answer costs placement quality only.
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests admitted but not yet prefilling (public ``_queue_len``)."""
+        return self._queue_len()
+
+    @property
+    def live_slots(self) -> int:
+        """Slots currently decoding."""
+        return int(self._live.sum())
+
+    @property
+    def slot_count(self) -> int:
+        return self._slots
+
+    @property
+    def recovering(self) -> bool:
+        """True while the worker is mid-restart (backoff + rebuild)."""
+        return self.supervisor.recovering
+
+    def predicted_drain_s(self) -> float:
+        """Public Retry-After estimate: seconds until this replica's current
+        backlog drains through its slots (service-time EWMA; clamped
+        finite). The fleet's all-replicas-saturated 429 reports the MINIMUM
+        of these across replicas."""
+        return self._retry_after()
+
+    def prefix_match_len(self, keys: Sequence[bytes]) -> int:
+        """Leading prompt-prefix blocks resident on this replica (0 for the
+        dense engine — it has no prefix cache, so prefix affinity
+        degenerates to least-loaded). Keys come from
+        routing.prefix_block_keys — the same keys paged admission matches."""
+        return 0
+
     def stats_snapshot(self) -> dict:
         """Current counters + freshly-read gauges (``GET /v1/stats``)."""
         self.stats.gauge("queue_depth", self._queue_len())
@@ -547,6 +584,7 @@ class ContinuousBatchingEngine:
             live=int(self._live.sum()),
         )
         if is_retryable_failure(cause) and sup.record_failure() == "restart":
+            sup.begin_recovery()  # routers skip this replica until restarted()
             err = RetryableEngineError(
                 f"engine worker failed mid-flight "
                 f"({type(cause).__name__}: {cause}); in-flight state lost, "
@@ -1390,6 +1428,19 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._slot_plen[slot] = 0
         self._table[slot, :] = NULL_BLOCK
         super()._release(slot)
+
+    def prefix_match_len(self, keys: Sequence[bytes]) -> int:
+        """Leading prompt-prefix blocks resident in THIS replica's prefix
+        cache — the router's affinity signal. Read-only (no refs taken, no
+        LRU touch); safe from router threads (paged.PrefixCache.resident_run).
+        """
+        return self._prefix.resident_run(keys)
+
+    @property
+    def block_len(self) -> int:
+        """Prefix-cache block granularity (routers compute affinity keys
+        with it via routing.prefix_block_keys)."""
+        return self._block_len
 
     def stats_snapshot(self) -> dict:
         self.stats.gauge("blocks_in_use", self._allocator.used_count)

@@ -116,6 +116,16 @@ class DrainingError(ServingError):
     retryable = True
 
 
+class NoHealthyReplicaError(ServingError):
+    """Every replica in the fleet is terminally dead (circuit open or
+    fatal): the front-door router has nowhere to place the request. The
+    whole pod needs a recycle (fleet ``/healthz`` goes unhealthy)."""
+
+    kind = "no_healthy_replica"
+    status = 503
+    retryable = False
+
+
 class InjectedFault(RuntimeError):
     """Deterministic test/chaos fault raised inside the engine worker by
     FaultInjector (infer/supervisor.py). Deliberately NOT a ServingError:

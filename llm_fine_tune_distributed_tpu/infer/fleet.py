@@ -1,0 +1,472 @@
+"""Serving fleet: N supervised engine replicas behind one front-door router.
+
+One continuous/paged engine saturates its decode batch; absorbing more
+traffic means MORE engines, not bigger ones — one engine per accelerator
+slice, a router in front (the shape TPU serving deployments scale out
+with). ``EngineFleet`` is that router plus the replica set, presenting the
+SAME public surface as a single engine (``submit`` / ``submit_full`` /
+``stream`` / ``begin_drain`` / ``wait_drained`` / ``healthy`` /
+``stats_snapshot`` ...), so infer/server.py swaps a fleet in wherever an
+engine went.
+
+**Shared params, private state.** Every replica wraps the SAME Generator:
+model params stay resident once, and the jitted programs are memoized on
+the Generator, so N replicas cost N KV pools + N scheduler threads — host
+RAM and compile time do NOT scale with N. Each replica owns its own
+EngineSupervisor, KV/block pool, prefix cache, and stats; a crash is a
+replica-local event.
+
+**Placement** (infer/routing.py does the scoring): per request the router
+snapshots each replica (health, queue depth, live slots, prompt-prefix
+residency) and picks by policy — prefix-cache affinity first (the replica
+already holding the prompt's leading blocks via the EXACT cumulative-token
+keys paged admission matches), ties broken least-loaded, load ties broken
+by rotation. Affinity reads two signals: the replica's actual prefix cache
+(``prefix_match_len``, read-only) and the router's own intent map of
+recently routed keys — the map covers the window where a prefix is queued
+but not yet prefilled, so a burst of same-prefix requests lands together
+instead of scattering before the first one completes.
+
+**Degraded replicas are first-class.** Terminal (circuit open / fatal),
+draining, and mid-recovery replicas leave the candidate set. A request a
+replica fails retryably — RetryableEngineError (restart casualty),
+CircuitOpenError/FatalEngineError (died after queuing), DrainingError —
+is resettled on a sibling instead of surfacing a 503: the router excludes
+the failed replica and re-places, so killing a replica mid-load sheds its
+queue to the survivors with zero hung waiters (each replica's ``_settle``
+ledger still guarantees its own half). Streams fail over only at
+admission; once tokens flow, a mid-stream error surfaces (tokens already
+reached the client). A replica's QueueOverflowError triggers re-placement
+too; only when EVERY available replica is saturated does the fleet 429 —
+with ``Retry-After`` = the MINIMUM predicted drain across replicas (the
+soonest any replica can take the retry), not whichever replica happened
+to reject last.
+
+**Drain** fans out: ``begin_drain`` closes every replica's admission;
+``wait_drained`` waits on all replicas CONCURRENTLY under one shared
+timeout (serial waits would stack N drain timeouts into the SIGTERM
+grace window).
+
+**Stats**: ``stats_snapshot`` merges replica snapshots — counters sum,
+occupancy gauges sum, generation is the max, rates are recomputed from
+the summed counters, and latency histograms merge exactly (same fixed
+buckets, observe/tracing.Histogram.merge) — plus router counters and a
+``per_replica`` map for the labelled ``/metrics`` view.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from llm_fine_tune_distributed_tpu.infer.errors import (
+    CircuitOpenError,
+    DrainingError,
+    FatalEngineError,
+    NoHealthyReplicaError,
+    QueueOverflowError,
+    RetryableEngineError,
+    ServingError,
+)
+from llm_fine_tune_distributed_tpu.infer.routing import (
+    ROUTING_POLICIES,
+    Placement,
+    ReplicaView,
+    choose_replica,
+    prefix_block_keys,
+)
+from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+from llm_fine_tune_distributed_tpu.observe.tracing import Histogram
+
+# Replica failures that do not implicate the request: the fleet re-places
+# the request on a sibling instead of surfacing them. (QueueOverflowError
+# is handled separately — it feeds the all-saturated 429; TimeoutError and
+# QueueDeadlineError are client-deadline semantics and must NOT retry.)
+_FAILOVER_ERRORS = (
+    RetryableEngineError,
+    CircuitOpenError,
+    FatalEngineError,
+    DrainingError,
+)
+
+
+class EngineFleet:
+    """N engine replicas + the prefix-aware, load-balancing front door."""
+
+    ROUTER_COUNTERS = (
+        "requests_routed_prefix_affinity",
+        "requests_routed_least_loaded",
+        "requests_routed_round_robin",
+        "requests_failed_over",
+        "requests_rerouted_overflow",
+        "requests_shed_fleet_saturated",
+    )
+
+    def __init__(
+        self,
+        replicas: Sequence,
+        routing: str = "prefix",
+        prefix_home_capacity: int = 8192,
+    ):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        if routing not in ROUTING_POLICIES:
+            raise ValueError(
+                f"unknown routing policy {routing!r}; "
+                f"choose from {ROUTING_POLICIES}"
+            )
+        self.replicas = list(replicas)
+        self.routing = routing
+        # affinity keys use the replicas' prefix-cache granularity; dense
+        # replicas have none (block_len 0 -> no keys -> affinity never fires)
+        self._block_len = int(getattr(self.replicas[0], "block_len", 0) or 0)
+        # router state: one lock covers the rotation counter, the intent
+        # map, the counters, and the placement log. Held only for host-side
+        # bookkeeping — never across a replica submit (which blocks).
+        self._lock = threading.Lock()
+        self._rr_seq = 0
+        # prefix intent map: block key -> replica index it was last routed
+        # to (LRU-bounded). Covers queued-but-unprefilled prefixes that the
+        # replicas' caches cannot know about yet.
+        self._prefix_home: "OrderedDict[bytes, int]" = OrderedDict()
+        self._prefix_cap = max(0, int(prefix_home_capacity))
+        self._counters: Dict[str, int] = {k: 0 for k in self.ROUTER_COUNTERS}
+        # bounded decision log: (replica index, reason) per placement, in
+        # placement order — what the determinism tests replay against
+        self._placements: "deque[Tuple[int, str]]" = deque(maxlen=4096)
+
+    # ---------------------------------------------------------------- routing
+
+    def _keys(self, prompt_ids: Sequence[int]) -> List[bytes]:
+        if self._block_len <= 0:
+            return []
+        return prefix_block_keys(prompt_ids, self._block_len)
+
+    def _home_run(self, keys: List[bytes], index: int) -> int:
+        """Leading keys whose last routing intent points at ``index``
+        (caller holds the lock)."""
+        n = 0
+        for key in keys:
+            if self._prefix_home.get(key) != index:
+                break
+            n += 1
+        return n
+
+    def _route(
+        self, keys: List[bytes], excluded: frozenset
+    ) -> Optional[Placement]:
+        """One placement decision: snapshot views, score, commit router
+        state (rotation, intent map, counters, log). Commits at DECISION
+        time, not completion time — a same-prefix burst must see the first
+        request's intent while it is still queued."""
+        views = []
+        for i, rep in enumerate(self.replicas):
+            if i in excluded:
+                continue
+            views.append(
+                ReplicaView(
+                    index=i,
+                    healthy=rep.healthy,
+                    draining=rep.draining,
+                    recovering=rep.recovering,
+                    queue_depth=rep.queue_depth,
+                    live_slots=rep.live_slots,
+                    slots=rep.slot_count,
+                    prefix_hits=max(
+                        rep.prefix_match_len(keys) if keys else 0,
+                        self._home_run(keys, i),
+                    ),
+                )
+            )
+        with self._lock:
+            placement = choose_replica(self.routing, views, self._rr_seq)
+            if placement is None:
+                return None
+            self._rr_seq += 1
+            self._counters[f"requests_routed_{placement.reason}"] += 1
+            self._placements.append((placement.index, placement.reason))
+            for key in keys:
+                self._prefix_home[key] = placement.index
+                self._prefix_home.move_to_end(key)
+            while len(self._prefix_home) > self._prefix_cap:
+                self._prefix_home.popitem(last=False)
+        return placement
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def recent_placements(self) -> List[Tuple[int, str]]:
+        """The last placements as (replica index, reason) — test surface."""
+        with self._lock:
+            return list(self._placements)
+
+    # -------------------------------------------------------------- dispatch
+
+    def _exhausted_error(
+        self,
+        overflowed: Dict[int, QueueOverflowError],
+        last_err: Optional[BaseException],
+    ) -> BaseException:
+        """No candidate left: decide what the FLEET's answer is."""
+        if not any(rep.healthy for rep in self.replicas):
+            err: ServingError = NoHealthyReplicaError(
+                f"all {len(self.replicas)} replicas are terminally dead "
+                "(circuit open or fatal); the pod needs a recycle"
+            )
+            err.__cause__ = last_err
+            return err
+        admitting = {
+            i
+            for i, rep in enumerate(self.replicas)
+            if rep.healthy and not rep.draining
+        }
+        # minimum predicted drain across still-serving replicas: the
+        # soonest ANY replica can absorb the retry (a per-replica hint
+        # would quote the rejecting replica's backlog even when a sibling
+        # drains sooner)
+        retry_after = min(
+            (self.replicas[i].predicted_drain_s() for i in admitting),
+            default=None,
+        )
+        if admitting and admitting <= set(overflowed):
+            self._count("requests_shed_fleet_saturated")
+            return QueueOverflowError(
+                f"all {len(admitting)} serving replicas are saturated "
+                "(every admission queue full)",
+                retry_after_s=retry_after,
+            )
+        if not admitting:
+            return DrainingError(
+                "fleet draining; admission closed on every replica",
+                retry_after_s=last_err.retry_after_s
+                if isinstance(last_err, ServingError)
+                else None,
+            )
+        if last_err is not None:
+            return last_err
+        # candidates exist but none is available (e.g. every serving
+        # replica is mid-recovery): transient by construction
+        return RetryableEngineError(
+            "no replica available (all mid-recovery); safe to retry",
+            retry_after_s=retry_after,
+        )
+
+    def _dispatch(
+        self,
+        method: str,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int,
+        timeout: Optional[float],
+    ):
+        """Route, call the replica, and fail over until success or the
+        candidate set is exhausted. Each replica is tried at most once per
+        request; ``timeout`` spans ALL attempts."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        keys = self._keys(prompt_ids)
+        excluded: set = set()
+        overflowed: Dict[int, QueueOverflowError] = {}
+        last_err: Optional[BaseException] = None
+        while True:
+            placement = self._route(keys, frozenset(excluded))
+            if placement is None:
+                raise self._exhausted_error(overflowed, last_err)
+            replica = self.replicas[placement.index]
+            remaining: Optional[float] = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"fleet request not served within {timeout}s "
+                        f"({len(excluded)} replica(s) tried)"
+                    )
+            try:
+                return getattr(replica, method)(
+                    prompt_ids, gen, seed=seed, timeout=remaining
+                )
+            except QueueOverflowError as e:
+                overflowed[placement.index] = e
+                excluded.add(placement.index)
+                last_err = e
+                self._count("requests_rerouted_overflow")
+            except _FAILOVER_ERRORS as e:
+                excluded.add(placement.index)
+                last_err = e
+                self._count("requests_failed_over")
+
+    # ------------------------------------------------------- engine surface
+
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ) -> List[int]:
+        return self.submit_full(prompt_ids, gen, seed, timeout).result
+
+    def submit_full(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ):
+        """Blocking request with placement + failover (engine parity)."""
+        return self._dispatch("submit_full", prompt_ids, gen, seed, timeout)
+
+    def stream(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ) -> Iterator[int]:
+        """Streaming request. Admission-time rejections (overflow, drain,
+        replica terminal) fail over exactly like ``submit``; once the
+        iterator is handed out, a mid-stream failure surfaces to the
+        caller — tokens may already be with the client, and replaying on a
+        sibling would emit them twice."""
+        return self._dispatch("stream", prompt_ids, gen, seed, timeout)
+
+    def begin_drain(self) -> None:
+        for rep in self.replicas:
+            rep.begin_drain()
+
+    def wait_drained(self, timeout_s: float, poll_s: float = 0.05) -> bool:
+        """True when EVERY replica drained inside the shared timeout.
+        Replicas drain concurrently — serial waits would stack timeouts."""
+        results: List[bool] = []
+        threads = [
+            threading.Thread(
+                target=lambda r=rep: results.append(
+                    r.wait_drained(timeout_s, poll_s)
+                ),
+                daemon=True,
+            )
+            for rep in self.replicas
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return len(results) == len(self.replicas) and all(results)
+
+    @property
+    def healthy(self) -> bool:
+        """The fleet serves while ANY replica serves; unhealthy only when
+        every replica is terminally dead (the pod-recycle signal)."""
+        return any(rep.healthy for rep in self.replicas)
+
+    @property
+    def draining(self) -> bool:
+        return all(rep.draining for rep in self.replicas)
+
+    @property
+    def circuit_state(self) -> str:
+        """"closed" while any replica serves; else the worst terminal kind."""
+        states = [rep.circuit_state for rep in self.replicas]
+        if "closed" in states:
+            return "closed"
+        return "open" if "open" in states else "fatal"
+
+    @property
+    def terminal_error(self) -> Optional[ServingError]:
+        if self.healthy:
+            return None
+        for rep in self.replicas:
+            if rep.terminal_error is not None:
+                return rep.terminal_error
+        return None
+
+    # ----------------------------------------------------------------- stats
+
+    def merged_histograms(self) -> Dict[str, Histogram]:
+        """Fleet-wide latency histograms: exact merges of the replicas'
+        (identical fixed buckets — the property they were designed for)."""
+        out: Dict[str, Histogram] = {}
+        for name in ServingStats.HISTOGRAM_SPECS:
+            hists = [rep.stats.hist[name] for rep in self.replicas]
+            merged = Histogram(hists[0].bounds)
+            for h in hists:
+                merged.merge(h)
+            out[name] = merged
+        return out
+
+    def stats_snapshot(self) -> dict:
+        """Fleet-aggregated view + ``per_replica`` map (``/v1/stats``).
+
+        Counters sum; occupancy gauges sum; ``engine_generation`` is the
+        max restart epoch; derived rates are RECOMPUTED from the summed
+        counters (a mean of ratios would weight idle replicas equally
+        with loaded ones); histograms merge exactly.
+        """
+        per = {
+            str(i): {"replica": i, **rep.stats_snapshot()}
+            for i, rep in enumerate(self.replicas)
+        }
+        snaps = list(per.values())
+        agg: dict = {}
+        for key in ServingStats.COUNTERS:
+            agg[key] = sum(s[key] for s in snaps)
+        for key in ServingStats.GAUGES:
+            vals = [s[key] for s in snaps]
+            agg[key] = max(vals) if key == "engine_generation" else sum(vals)
+        agg["tokens_per_s_1m"] = sum(s["tokens_per_s_1m"] for s in snaps)
+        agg["uptime_s"] = max(s["uptime_s"] for s in snaps)
+        agg["slots"] = sum(s["slots"] for s in snaps)
+        agg["slot_occupancy"] = (
+            agg["live_slots"] / agg["slots"] if agg["slots"] else 0.0
+        )
+        if all("total_blocks" in s for s in snaps):
+            agg["total_blocks"] = sum(s["total_blocks"] for s in snaps)
+            agg["block_pool_occupancy"] = (
+                agg["blocks_in_use"] / agg["total_blocks"]
+                if agg["total_blocks"]
+                else 0.0
+            )
+            agg["peak_block_pool_occupancy"] = (
+                agg["peak_blocks_in_use"] / agg["total_blocks"]
+                if agg["total_blocks"]
+                else 0.0
+            )
+        agg["prefix_hit_rate"] = (
+            agg["prefix_tokens_reused"] / agg["prompt_tokens"]
+            if agg["prompt_tokens"]
+            else 0.0
+        )
+        agg["draft_acceptance_rate"] = (
+            agg["draft_tokens_accepted"] / agg["draft_tokens_proposed"]
+            if agg["draft_tokens_proposed"]
+            else 0.0
+        )
+        agg["mean_tokens_per_step"] = (
+            agg["tokens_served"] / agg["decode_steps"]
+            if agg["decode_steps"]
+            else 0.0
+        )
+        agg["histograms"] = {
+            name: h.summary() for name, h in self.merged_histograms().items()
+        }
+        agg["circuit_state"] = self.circuit_state
+        agg["draining"] = self.draining
+        agg["replicas"] = len(self.replicas)
+        agg["routing"] = self.routing
+        agg["healthy_replicas"] = sum(
+            1 for rep in self.replicas if rep.healthy
+        )
+        agg["available_replicas"] = sum(
+            1
+            for rep in self.replicas
+            if rep.healthy and not rep.draining and not rep.recovering
+        )
+        with self._lock:
+            agg.update(self._counters)
+        agg["per_replica"] = per
+        return agg

@@ -20,7 +20,7 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import List, Optional, Sequence
 
-import numpy as np
+from llm_fine_tune_distributed_tpu.infer.routing import prefix_block_keys
 
 NULL_BLOCK = 0
 
@@ -114,11 +114,26 @@ class PrefixCache:
         return len(self._entries)
 
     def block_keys(self, prompt: Sequence[int]) -> List[bytes]:
-        """One key per FULL prompt block (cumulative token bytes)."""
-        L = self.block_len
-        n = len(prompt) // L
-        arr = np.asarray(list(prompt[: n * L]), np.int32)
-        return [arr[: (i + 1) * L].tobytes() for i in range(n)]
+        """One key per FULL prompt block (cumulative token bytes). Delegates
+        to the shared helper the fleet router also scores affinity with
+        (infer/routing.py), so cache index and router affinity use the
+        SAME keys by construction."""
+        return prefix_block_keys(prompt, self.block_len)
+
+    def resident_run(self, keys: Sequence[bytes]) -> int:
+        """How many LEADING keys are currently cached — a read-only probe
+        for the fleet router's affinity scoring. Unlike ``match`` it takes
+        no references and does not touch LRU order (routing must not pin
+        blocks or distort eviction), and it may be called from router
+        threads while the engine worker mutates the cache: each lookup is
+        one GIL-atomic dict read, and a stale answer only costs placement
+        quality, never correctness."""
+        n = 0
+        for key in keys:
+            if key not in self._entries:
+                break
+            n += 1
+        return n
 
     def match(self, keys: Sequence[bytes], limit: int) -> List[int]:
         """Block ids for the longest cached run of leading keys (at most

@@ -1,0 +1,121 @@
+"""Fleet routing policy: pure placement scoring over replica snapshots.
+
+The front-door router (infer/fleet.py) places each request on one of N
+engine replicas. Everything decision-shaped lives HERE, device-free and
+side-effect-free, so placement is unit-testable and — given the same
+request stream — deterministic (tests/test_fleet.py pins that):
+
+- ``prefix_block_keys`` is the ONE implementation of the cumulative-token
+  block keys the paged engine's prefix cache indexes by
+  (infer/paged.PrefixCache delegates to it). The router scores affinity
+  with the exact keys admission will look up, so router affinity and
+  cache keys can never drift.
+- ``choose_replica`` scores a candidate set of ``ReplicaView`` snapshots
+  under one of three policies:
+
+  * ``prefix`` — longest resident prompt-prefix run wins (the replica
+    already holding the prompt's leading blocks skips their prefill);
+    zero-hit requests and ties fall through to least-loaded;
+  * ``least-loaded`` — smallest (queued + decoding) / slots, the same
+    queue-depth pressure the admission EWMA's Retry-After is built from;
+  * ``round-robin`` — strict rotation over available replicas (baseline).
+
+  Load ties break by rotation (not by lowest index) so equally idle
+  replicas share first-touch traffic instead of piling onto replica 0.
+
+Degraded replicas are EXCLUDED before scoring: a replica that is terminal
+(circuit open / fatal), draining, or mid-recovery is not a candidate. The
+fleet, not this module, decides what that means end-to-end (failover,
+fleet-wide 429); this module only answers "given these views, who gets
+the next request?".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+ROUTING_POLICIES = ("prefix", "least-loaded", "round-robin")
+
+
+def prefix_block_keys(prompt: Sequence[int], block_len: int) -> List[bytes]:
+    """One key per FULL leading prompt block: the raw bytes of the prompt's
+    first ``(i+1) * block_len`` tokens as int32 (cumulative, so key i
+    matches iff every token through the end of block i matches — exact
+    match, never a hash). Shared by PrefixCache (cache index) and the
+    fleet router (affinity scoring); a partial trailing block gets no key.
+    """
+    L = int(block_len)
+    if L <= 0:
+        raise ValueError(f"block_len must be positive, got {block_len}")
+    n = len(prompt) // L
+    arr = np.asarray(list(prompt[: n * L]), np.int32)
+    return [arr[: (i + 1) * L].tobytes() for i in range(n)]
+
+
+@dataclass
+class ReplicaView:
+    """Point-in-time routing snapshot of one replica (plain ints/bools read
+    off the engine under the GIL — no locks, no device state)."""
+
+    index: int
+    healthy: bool = True
+    draining: bool = False
+    recovering: bool = False
+    queue_depth: int = 0
+    live_slots: int = 0
+    slots: int = 1
+    prefix_hits: int = 0  # leading full prompt blocks resident on this replica
+
+    @property
+    def available(self) -> bool:
+        """In the candidate set: serving, admitting, not mid-restart."""
+        return self.healthy and not self.draining and not self.recovering
+
+    @property
+    def load(self) -> float:
+        """Backlog pressure normalized by capacity: (queued + decoding) per
+        slot — the quantity the admission Retry-After estimate scales by."""
+        return (self.queue_depth + self.live_slots) / max(1, self.slots)
+
+
+@dataclass(frozen=True)
+class Placement:
+    """A routing decision: which replica, and which rule decided."""
+
+    index: int
+    reason: str  # "prefix_affinity" | "least_loaded" | "round_robin"
+
+
+def choose_replica(
+    policy: str,
+    views: Sequence[ReplicaView],
+    rr_seq: int = 0,
+) -> Optional[Placement]:
+    """Deterministic placement over the available views; None if none are.
+
+    ``rr_seq`` is the router's monotonically increasing placement counter;
+    it drives the round-robin rotation AND breaks exact load ties under
+    the other policies, so the decision is a pure function of
+    (policy, views, rr_seq).
+    """
+    if policy not in ROUTING_POLICIES:
+        raise ValueError(
+            f"unknown routing policy {policy!r}; choose from {ROUTING_POLICIES}"
+        )
+    cands = [v for v in views if v.available]
+    if not cands:
+        return None
+    if policy == "round-robin":
+        return Placement(cands[rr_seq % len(cands)].index, "round_robin")
+    reason = "least_loaded"
+    if policy == "prefix":
+        best_hits = max(v.prefix_hits for v in cands)
+        if best_hits > 0:
+            cands = [v for v in cands if v.prefix_hits == best_hits]
+            reason = "prefix_affinity"
+    min_load = min(v.load for v in cands)
+    tied = [v for v in cands if v.load == min_load]
+    return Placement(tied[rr_seq % len(tied)].index, reason)

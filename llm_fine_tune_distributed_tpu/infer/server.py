@@ -47,6 +47,13 @@ Handlers run on threads; a single worker owns the TPU. Three engines
   multi-host path, and the fallback when per-step host scheduling is
   unwanted. ``--max-batch 1`` restores strict serialization.
 
+``--replicas N`` (continuous/paged, single-host) runs N supervised engine
+replicas behind the in-process fleet router (infer/fleet.py): params are
+shared read-only, placement follows ``--routing`` (prefix-cache affinity
+by default), replica failures fail over to siblings, and ``/v1/stats`` +
+``/metrics`` report fleet aggregates plus per-replica series labelled
+``replica="i"``.
+
 Run: ``python -m llm_fine_tune_distributed_tpu.infer.server --model-dir ...``
 or ``ask_tuned_model.py --serve``.
 """
@@ -76,6 +83,8 @@ def serve(
     draft_dir: Optional[str] = None,
     speculative_k: int = 0,
     engine_kind: str = "continuous",
+    replicas: int = 1,
+    routing: str = "prefix",
     slots: int = 8,
     kv_buf_len: int = 4096,
     kv_block_len: int = 256,
@@ -111,6 +120,8 @@ def serve(
         error_payload,
     )
 
+    from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
+    from llm_fine_tune_distributed_tpu.infer.routing import ROUTING_POLICIES
     from llm_fine_tune_distributed_tpu.observe.metrics import (
         PROMETHEUS_CONTENT_TYPE,
         prometheus_exposition,
@@ -136,6 +147,19 @@ def serve(
             "(engine-level fused draft+verify ticks); the window engine "
             "instead takes per-request speculation via POST /v1/generate "
             "with 'speculative': K — drop --speculative or pick "
+            "--engine continuous|paged"
+        )
+    replicas = max(1, int(replicas or 1))
+    if routing not in ROUTING_POLICIES:
+        raise ValueError(
+            f"unknown --routing {routing!r} (expected one of "
+            f"{ROUTING_POLICIES})"
+        )
+    if replicas > 1 and engine_kind == "window":
+        raise ValueError(
+            "--replicas N needs a continuous/paged engine (the fleet "
+            "router places by queue depth and prefix residency, which the "
+            "window batcher does not expose); drop --replicas or pick "
             "--engine continuous|paged"
         )
     print(f"Loading model from {model_dir} ...")
@@ -211,30 +235,54 @@ def serve(
     if engine_kind in ("continuous", "paged"):
         if coordinator is not None:
             print(f"[serve] multi-host: {engine_kind} engine unavailable, using window")
-        elif engine_kind == "paged":
-            from llm_fine_tune_distributed_tpu.infer.engine import (
-                PagedContinuousBatchingEngine,
-            )
-
-            cont_engine = PagedContinuousBatchingEngine(
-                generator, slots=slots, buf_len=kv_buf_len,
-                block_len=kv_block_len, prefill_chunk=prefill_chunk,
-                **engine_kwargs,
-            )
-            cont_kind = "paged"
+            if replicas > 1:
+                print(
+                    "[serve] multi-host: --replicas ignored (replica "
+                    "scale-out is per-host; run one server per slice "
+                    "behind an external balancer instead)"
+                )
         else:
             from llm_fine_tune_distributed_tpu.infer.engine import (
                 ContinuousBatchingEngine,
+                PagedContinuousBatchingEngine,
             )
 
-            cont_engine = ContinuousBatchingEngine(
-                generator, slots=slots, buf_len=kv_buf_len, **engine_kwargs
-            )
-            cont_kind = "continuous"
+            def _make_replica(i: int):
+                # every replica wraps the SAME generator — params resident
+                # once, jitted programs shared — but owns its own KV pool,
+                # supervisor, and stats. Crash artifacts get per-replica
+                # paths so two replicas' dumps cannot clobber each other.
+                kw = dict(engine_kwargs)
+                if replicas > 1:
+                    if kw.get("flight_dir"):
+                        kw["flight_dir"] = os.path.join(
+                            kw["flight_dir"], f"replica{i}"
+                        )
+                    if kw.get("trace_log"):
+                        kw["trace_log"] = f"{kw['trace_log']}.replica{i}"
+                if engine_kind == "paged":
+                    return PagedContinuousBatchingEngine(
+                        generator, slots=slots, buf_len=kv_buf_len,
+                        block_len=kv_block_len, prefill_chunk=prefill_chunk,
+                        **kw,
+                    )
+                return ContinuousBatchingEngine(
+                    generator, slots=slots, buf_len=kv_buf_len, **kw
+                )
+
+            if replicas > 1:
+                cont_engine = EngineFleet(
+                    [_make_replica(i) for i in range(replicas)],
+                    routing=routing,
+                )
+            else:
+                cont_engine = _make_replica(0)
+            cont_kind = engine_kind
     drain_state = {"draining": False}
     print(
         f"Model ready (engine={cont_kind}, "
-        f"slots={slots}, max_batch={max_batch}, quantize={quantize})."
+        + (f"replicas={replicas}, routing={routing}, " if replicas > 1 else "")
+        + f"slots={slots}, max_batch={max_batch}, quantize={quantize})."
     )
 
     class Handler(BaseHTTPRequestHandler):
@@ -316,8 +364,24 @@ def serve(
                 self._send(200, stats)
             elif self.path == "/metrics":
                 # Prometheus text exposition: every ServingStats counter/
-                # gauge/histogram plus per-device HBM gauges, scrape-ready
-                if cont_engine is not None:
+                # gauge/histogram plus per-device HBM gauges, scrape-ready.
+                # A fleet emits the aggregate series (unlabelled) followed
+                # by the same metrics labelled replica="i", all under one
+                # TYPE per name.
+                replica_series = None
+                if isinstance(cont_engine, EngineFleet):
+                    snap = {"engine": cont_kind, **cont_engine.stats_snapshot()}
+                    per = snap.pop("per_replica")
+                    replica_series = [
+                        (
+                            label,
+                            per[label],
+                            cont_engine.replicas[int(label)].stats.hist,
+                        )
+                        for label in sorted(per, key=int)
+                    ]
+                    hists = cont_engine.merged_histograms()
+                elif cont_engine is not None:
                     snap = {"engine": cont_kind, **cont_engine.stats_snapshot()}
                     hists = cont_engine.stats.hist
                 else:
@@ -328,7 +392,8 @@ def serve(
                     }
                     hists = None
                 text = prometheus_exposition(
-                    snap, hists, memory=device_memory_report()
+                    snap, hists, memory=device_memory_report(),
+                    replicas=replica_series,
                 )
                 self._send(200, text, content_type=PROMETHEUS_CONTENT_TYPE)
             else:
@@ -667,6 +732,20 @@ def main(argv: Optional[list] = None) -> int:
              "this automatically)",
     )
     parser.add_argument(
+        "--replicas", type=int, default=1, metavar="N",
+        help="continuous/paged engines: run N supervised engine replicas "
+             "behind the in-process fleet router (params shared read-only; "
+             "each replica owns its KV pool, supervisor, and stats). "
+             "1 = single engine, no router",
+    )
+    parser.add_argument(
+        "--routing", choices=["prefix", "least-loaded", "round-robin"],
+        default="prefix",
+        help="fleet placement policy (--replicas > 1): prefix = prompt-"
+             "prefix cache affinity, ties least-loaded; least-loaded = "
+             "smallest backlog per slot; round-robin = strict rotation",
+    )
+    parser.add_argument(
         "--slots", type=int, default=8,
         help="continuous engine: persistent decode slots (the max live batch)",
     )
@@ -776,7 +855,8 @@ def main(argv: Optional[list] = None) -> int:
           args.batch_window_ms, args.quantize,
           request_timeout_s=args.request_timeout_s or None, tp=args.tp,
           draft_dir=args.draft_dir, speculative_k=args.speculative,
-          engine_kind=args.engine, slots=args.slots,
+          engine_kind=args.engine, replicas=args.replicas,
+          routing=args.routing, slots=args.slots,
           kv_buf_len=args.kv_buf_len, kv_block_len=args.kv_block_len,
           prefill_chunk=args.prefill_chunk,
           max_queue_depth=args.max_queue_depth,

@@ -57,6 +57,11 @@ class EngineSupervisor:
         self.flight_dir = flight_dir
         self.generation = 0
         self.circuit_open = False
+        # True from the moment a restart is decided until the worker is
+        # serving again — the fleet router (infer/fleet.py) reads it to
+        # drop a mid-recovery replica from the candidate set (single-word
+        # read, safe under the GIL like generation/circuit_open)
+        self.recovering = False
         self._failures: "deque[float]" = deque()
         self._dump_seq = 0
 
@@ -79,9 +84,15 @@ class EngineSupervisor:
         n = max(0, len(self._failures) - 1)
         return min(self.restart_backoff_s * (2.0 ** n), self.restart_backoff_max_s)
 
+    def begin_recovery(self) -> None:
+        """The worker decided to restart: backoff + rebuild are imminent.
+        Routers should place elsewhere until ``restarted()``."""
+        self.recovering = True
+
     def restarted(self) -> None:
         """The worker rebuilt device state and is serving again."""
         self.generation += 1
+        self.recovering = False
 
     @property
     def failure_count(self) -> int:

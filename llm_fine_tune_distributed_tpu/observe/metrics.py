@@ -21,7 +21,7 @@ import math
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from llm_fine_tune_distributed_tpu.observe.tracing import Histogram
 from llm_fine_tune_distributed_tpu.runtime.distributed import is_primary_host
@@ -183,24 +183,48 @@ def _prom_name(key: str, prefix: str) -> str:
     return f"{prefix}_{base}"
 
 
+# Router-level monotonic counters the fleet snapshot adds on top of
+# ServingStats.COUNTERS (infer/fleet.EngineFleet.ROUTER_COUNTERS mirrors
+# this list); the exposition must type them ``counter``, not gauge.
+FLEET_COUNTERS = (
+    "requests_routed_prefix_affinity",
+    "requests_routed_least_loaded",
+    "requests_routed_round_robin",
+    "requests_failed_over",
+    "requests_rerouted_overflow",
+    "requests_shed_fleet_saturated",
+)
+
+
 def prometheus_exposition(
     snap: Dict[str, Any],
     histograms: Optional[Dict[str, Histogram]] = None,
     memory: Optional[Dict[str, Dict[str, Optional[int]]]] = None,
     prefix: str = "serving",
+    replicas: Optional[
+        List[Tuple[str, Dict[str, Any], Optional[Dict[str, Histogram]]]]
+    ] = None,
 ) -> str:
     """Render a ``ServingStats.snapshot()`` (plus the live histogram
     objects and an optional ``device_memory_report()``) as Prometheus text
     exposition (format version 0.0.4).
 
-    Counter keys (``ServingStats.COUNTERS``) get the ``_total`` suffix and
-    ``# TYPE counter``; every other numeric value is a gauge; string
-    values (engine kind, circuit state) collapse into one
+    Counter keys (``ServingStats.COUNTERS`` + ``FLEET_COUNTERS``) get the
+    ``_total`` suffix and ``# TYPE counter``; every other numeric value is
+    a gauge; string values (engine kind, circuit state) collapse into one
     ``<prefix>_info{...} 1`` info-style line; trailing ``_s`` becomes
     ``_seconds``. Histograms emit cumulative ``le`` buckets straight from
     the live ``Histogram`` objects, not the snapshot summaries.
+
+    ``replicas`` — a fleet's per-replica view: ``(label, snapshot,
+    histograms)`` triples. Each aggregate sample is followed by the same
+    metric with a ``replica="<label>"`` label per replica (ONE ``# TYPE``
+    per metric name, all samples grouped under it, as the format
+    requires); per-replica string values collapse into one
+    ``<prefix>_replica_info{replica=...} 1`` line each.
     """
-    counters = set(ServingStats.COUNTERS)
+    counters = set(ServingStats.COUNTERS) | set(FLEET_COUNTERS)
+    replicas = replicas or []
     lines: List[str] = []
     labels = []
     for key in sorted(snap):
@@ -211,21 +235,45 @@ def prometheus_exposition(
         name = f"{prefix}_info"
         lines.append(f"# TYPE {name} gauge")
         lines.append(f'{name}{{{",".join(labels)}}} 1')
+    if replicas:
+        name = f"{prefix}_replica_info"
+        lines.append(f"# TYPE {name} gauge")
+        for label, rsnap, _ in replicas:
+            rlabels = [f'replica="{label}"'] + [
+                f'{key}="{rsnap[key]}"'
+                for key in sorted(rsnap)
+                if isinstance(rsnap[key], str)
+            ]
+            lines.append(f'{name}{{{",".join(rlabels)}}} 1')
     for key in snap:
         value = snap[key]
         if isinstance(value, bool):
             value = int(value)
         if not isinstance(value, (int, float)):
             continue
+        name = _prom_name(key, prefix)
         if key in counters:
-            name = _prom_name(key, prefix) + "_total"
+            name += "_total"
             lines.append(f"# TYPE {name} counter")
         else:
-            name = _prom_name(key, prefix)
             lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {value:.10g}")
+        for label, rsnap, _ in replicas:
+            rvalue = rsnap.get(key)
+            if isinstance(rvalue, bool):
+                rvalue = int(rvalue)
+            if isinstance(rvalue, (int, float)):
+                lines.append(f'{name}{{replica="{label}"}} {rvalue:.10g}')
     for key in histograms or {}:
-        lines.extend(histograms[key].prometheus_lines(_prom_name(key, prefix)))
+        name = _prom_name(key, prefix)
+        lines.extend(histograms[key].prometheus_lines(name))
+        for label, _, rhists in replicas:
+            if rhists and key in rhists:
+                lines.extend(
+                    rhists[key].prometheus_lines(
+                        name, labels=f'replica="{label}"', include_type=False
+                    )
+                )
     if memory:
         by_field = {
             "bytes_in_use": "device_hbm_bytes_in_use",

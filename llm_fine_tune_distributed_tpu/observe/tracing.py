@@ -137,18 +137,26 @@ class Histogram:
             "p99": self.percentile(99),
         }
 
-    def prometheus_lines(self, name: str) -> List[str]:
+    def prometheus_lines(
+        self, name: str, labels: str = "", include_type: bool = True
+    ) -> List[str]:
         """Prometheus text exposition: cumulative ``_bucket{le=...}`` lines
-        plus ``_sum`` and ``_count``."""
+        plus ``_sum`` and ``_count``. ``labels`` (e.g. ``replica="0"``)
+        joins each sample's label set; pass ``include_type=False`` for
+        additional labelled series of a metric whose ``# TYPE`` line was
+        already emitted (one TYPE per metric name, samples grouped under
+        it — the fleet's per-replica view)."""
         counts, total, s = self._state()
-        lines = [f"# TYPE {name} histogram"]
+        pre = f"{labels}," if labels else ""
+        sfx = f"{{{labels}}}" if labels else ""
+        lines = [f"# TYPE {name} histogram"] if include_type else []
         cum = 0
         for bound, c in zip(self.bounds, counts):
             cum += c
-            lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
-        lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{name}_sum {_fmt(s)}")
-        lines.append(f"{name}_count {total}")
+            lines.append(f'{name}_bucket{{{pre}le="{_fmt(bound)}"}} {cum}')
+        lines.append(f'{name}_bucket{{{pre}le="+Inf"}} {total}')
+        lines.append(f"{name}_sum{sfx} {_fmt(s)}")
+        lines.append(f"{name}_count{sfx} {total}")
         return lines
 
 

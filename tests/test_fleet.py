@@ -1,0 +1,515 @@
+"""Fleet router + replica-set semantics (infer/fleet.py, infer/routing.py).
+
+What this file pins, layer by layer:
+
+- ``prefix_block_keys`` is the ONE prefix-key implementation: the paged
+  engine's PrefixCache delegates to it, so router affinity and cache
+  index can never drift;
+- ``choose_replica`` is a pure function of (policy, views, rr_seq):
+  prefix affinity wins, ties fall to least-loaded, load ties rotate, and
+  degraded replicas never enter the candidate set;
+- admission economics on scripted fake replicas: a 2-replica fleet with
+  one idle replica NEVER 429s (the overflow reroutes), the fleet-wide
+  429 carries Retry-After = the MINIMUM predicted drain across serving
+  replicas, and total loss of replicas maps to the right taxonomy error;
+- on the real tiny model: identical fleets fed the same request stream
+  make identical placements (routing determinism), killing a replica
+  mid-load sheds its queue to the survivor with zero hung waiters and
+  greedy output bit-identical to solo ``generate_ids``, prefix affinity
+  routes repeats back to the replica holding the cached blocks, and
+  drain fans out across replicas.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import (
+    EngineFleet,
+    GenerationConfig,
+    Generator,
+)
+from llm_fine_tune_distributed_tpu.infer.engine import (
+    PagedContinuousBatchingEngine,
+)
+from llm_fine_tune_distributed_tpu.infer.errors import (
+    DrainingError,
+    NoHealthyReplicaError,
+    QueueOverflowError,
+    RetryableEngineError,
+)
+from llm_fine_tune_distributed_tpu.infer.paged import BlockAllocator, PrefixCache
+from llm_fine_tune_distributed_tpu.infer.routing import (
+    ROUTING_POLICIES,
+    ReplicaView,
+    choose_replica,
+    prefix_block_keys,
+)
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+
+GREEDY = GenerationConfig(max_new_tokens=6, do_sample=False)
+
+
+@pytest.fixture(scope="module")
+def generator():
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    return Generator(
+        params, mc, ByteChatMLTokenizer(), compute_dtype=jnp.float32, eos_token_ids=[]
+    )
+
+
+def _prompts():
+    tok = ByteChatMLTokenizer()
+    return [tok.encode(t) for t in ("alpha", "beta bravo", "the quick brown fox")]
+
+
+def _fleet(generator, n=2, routing="prefix", **kw):
+    """Fleet of fresh paged replicas with test-speed supervision, all
+    wrapping the SAME generator (the shared-params property the fleet is
+    built around)."""
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("restart_backoff_max_s", 0.02)
+    return EngineFleet(
+        [
+            PagedContinuousBatchingEngine(
+                generator, slots=4, buf_len=96, prompt_bucket=16,
+                block_len=16, prefill_chunk=32, **kw,
+            )
+            for _ in range(n)
+        ],
+        routing=routing,
+    )
+
+
+# ------------------------------------------------- shared prefix-key helper
+
+
+def test_prefix_block_keys_shared_with_prefix_cache():
+    """PrefixCache.block_keys IS prefix_block_keys: same keys for the same
+    prompt and block size, partial trailing block excluded, and keys are
+    cumulative (key i embeds every token through block i)."""
+    cache = PrefixCache(BlockAllocator(8), block_len=4)
+    prompt = list(range(11))  # two full blocks + a 3-token tail
+    keys = prefix_block_keys(prompt, 4)
+    assert cache.block_keys(prompt) == keys
+    assert len(keys) == 2  # the partial block gets NO key
+    assert keys[1].startswith(keys[0])  # cumulative, exact-match bytes
+    # one token changed inside block 0 changes EVERY key from there on
+    other = prefix_block_keys([99] + prompt[1:], 4)
+    assert other[0] != keys[0] and other[1] != keys[1]
+    # shorter than one block -> no keys at all
+    assert prefix_block_keys(prompt[:3], 4) == []
+
+
+def test_prefix_block_keys_rejects_nonpositive_block_len():
+    with pytest.raises(ValueError):
+        prefix_block_keys([1, 2, 3], 0)
+    with pytest.raises(ValueError):
+        prefix_block_keys([1, 2, 3], -4)
+
+
+def test_prefix_cache_resident_run_is_read_only():
+    """resident_run counts leading cached keys without taking references
+    or touching LRU order (a router probe must not pin blocks)."""
+    alloc = BlockAllocator(8)
+    cache = PrefixCache(alloc, block_len=2)
+    keys = prefix_block_keys([1, 2, 3, 4, 5, 6], 2)
+    blocks = alloc.alloc(2)
+    cache.insert(keys[:2], blocks)
+    before = {bid: alloc.refcount(bid) for bid in blocks}
+    assert cache.resident_run(keys) == 2  # key 2 was never inserted
+    assert cache.resident_run(keys[:1]) == 1
+    assert cache.resident_run([b"missing"] + keys) == 0  # LEADING run only
+    assert {bid: alloc.refcount(bid) for bid in blocks} == before
+
+
+# ---------------------------------------------------- pure placement policy
+
+
+def _views(**overrides):
+    base = [
+        ReplicaView(index=0, slots=4),
+        ReplicaView(index=1, slots=4),
+        ReplicaView(index=2, slots=4),
+    ]
+    for i, kw in overrides.items():
+        for k, v in kw.items():
+            setattr(base[int(i)], k, v)
+    return base
+
+
+def test_choose_replica_prefix_affinity_wins():
+    views = _views(**{"1": {"prefix_hits": 3}, "2": {"prefix_hits": 1}})
+    p = choose_replica("prefix", views)
+    assert (p.index, p.reason) == (1, "prefix_affinity")
+    # zero hits everywhere falls through to least-loaded
+    p = choose_replica("prefix", _views(**{"0": {"queue_depth": 5}}))
+    assert p.reason == "least_loaded" and p.index in (1, 2)
+
+
+def test_choose_replica_least_loaded_uses_queue_and_slots():
+    views = _views(
+        **{
+            "0": {"queue_depth": 2, "live_slots": 2},
+            "1": {"queue_depth": 0, "live_slots": 3},
+            "2": {"queue_depth": 4, "live_slots": 4},
+        }
+    )
+    assert choose_replica("least-loaded", views).index == 1
+    # prefix policy ignores affinity when scoring load-only candidates
+    assert choose_replica("prefix", views).index == 1
+
+
+def test_choose_replica_round_robin_rotates():
+    views = _views()
+    order = [choose_replica("round-robin", views, rr_seq=s).index for s in range(6)]
+    assert order == [0, 1, 2, 0, 1, 2]
+    assert choose_replica("round-robin", views, 1).reason == "round_robin"
+
+
+def test_choose_replica_load_ties_rotate():
+    """Equally idle replicas share first-touch traffic by rotation instead
+    of piling onto replica 0."""
+    views = _views()
+    picks = {choose_replica("least-loaded", views, rr_seq=s).index for s in range(3)}
+    assert picks == {0, 1, 2}
+
+
+def test_choose_replica_excludes_degraded():
+    views = _views(
+        **{
+            "0": {"healthy": False},
+            "1": {"recovering": True},
+            "2": {"draining": True},
+        }
+    )
+    assert choose_replica("prefix", views) is None
+    views[2].draining = False
+    assert choose_replica("prefix", views).index == 2
+    # pure function: same inputs, same answer, no hidden state
+    assert choose_replica("prefix", views, 5) == choose_replica("prefix", views, 5)
+
+
+def test_choose_replica_unknown_policy_raises():
+    with pytest.raises(ValueError):
+        choose_replica("random", _views())
+    assert set(ROUTING_POLICIES) == {"prefix", "least-loaded", "round-robin"}
+
+
+# ------------------------------------------- scripted-replica fleet dispatch
+
+
+class _FakeResult:
+    def __init__(self, result):
+        self.result = result
+
+
+class _FakeReplica:
+    """The exact surface EngineFleet reads off a replica, with scripted
+    failure behaviour — admission/failover economics without a device."""
+
+    block_len = 0
+
+    def __init__(self, index, slots=2, drain_s=1.0, raises=None):
+        self.index = index
+        self.slot_count = slots
+        self.drain_s = drain_s
+        self.raises = raises  # exception instance raised on every submit
+        self.healthy = True
+        self.draining = False
+        self.recovering = False
+        self.queue_depth = 0
+        self.live_slots = 0
+        self.calls = 0
+        self.circuit_state = "closed"
+        self.stats = ServingStats(slots=slots)  # fleet aggregation reads it
+
+    def predicted_drain_s(self):
+        return self.drain_s
+
+    def prefix_match_len(self, keys):
+        return 0
+
+    def stats_snapshot(self):
+        return {
+            **self.stats.snapshot(),
+            "circuit_state": self.circuit_state,
+            "draining": self.draining,
+        }
+
+    def submit_full(self, prompt_ids, gen, seed=0, timeout=None):
+        self.calls += 1
+        if self.raises is not None:
+            raise self.raises
+        return _FakeResult(list(prompt_ids) + [self.index])
+
+
+def test_idle_sibling_absorbs_overflow_never_429():
+    """THE regression the fleet exists for: one saturated replica's 429
+    reroutes to the idle sibling — the client never sees it."""
+    full = _FakeReplica(0, raises=QueueOverflowError("full", retry_after_s=9.0))
+    idle = _FakeReplica(1)
+    # round-robin with rr_seq=0 targets the saturated replica FIRST
+    fleet = EngineFleet([full, idle], routing="round-robin")
+    out = fleet.submit([1, 2, 3], GREEDY, timeout=5)
+    assert out == [1, 2, 3, 1]  # served by the sibling
+    assert full.calls == 1 and idle.calls == 1
+    snap_counters = fleet.stats_snapshot()
+    assert snap_counters["requests_rerouted_overflow"] == 1
+    assert snap_counters["requests_shed_fleet_saturated"] == 0
+
+
+def test_all_saturated_429_quotes_minimum_drain():
+    """Only when EVERY serving replica rejects does the fleet 429, and the
+    Retry-After is the soonest ANY replica can absorb the retry — not
+    whichever replica happened to reject last."""
+    slow = _FakeReplica(0, drain_s=7.0,
+                        raises=QueueOverflowError("full", retry_after_s=7.0))
+    fast = _FakeReplica(1, drain_s=2.0,
+                        raises=QueueOverflowError("full", retry_after_s=2.0))
+    fleet = EngineFleet([slow, fast], routing="round-robin")
+    with pytest.raises(QueueOverflowError) as ei:
+        fleet.submit([1, 2, 3], GREEDY, timeout=5)
+    assert ei.value.retry_after_s == 2.0
+    assert slow.calls == 1 and fast.calls == 1  # each tried at most once
+    assert fleet.stats_snapshot()["requests_shed_fleet_saturated"] == 1
+
+
+def test_failover_resettles_on_sibling():
+    dead = _FakeReplica(0, raises=RetryableEngineError("restart casualty"))
+    ok = _FakeReplica(1)
+    fleet = EngineFleet([dead, ok], routing="round-robin")
+    assert fleet.submit([5], GREEDY) == [5, 1]
+    assert fleet.stats_snapshot()["requests_failed_over"] == 1
+
+
+def test_timeout_never_fails_over():
+    """Client-deadline errors implicate the REQUEST, not the replica:
+    replaying elsewhere would double the client's wait."""
+    slow = _FakeReplica(0, raises=TimeoutError("deadline"))
+    sibling = _FakeReplica(1)
+    fleet = EngineFleet([slow, sibling], routing="round-robin")
+    with pytest.raises(TimeoutError):
+        fleet.submit([5], GREEDY, timeout=5)
+    assert sibling.calls == 0
+
+
+def test_all_replicas_terminal_maps_to_no_healthy_replica():
+    fleet = EngineFleet([_FakeReplica(0), _FakeReplica(1)])
+    for rep in fleet.replicas:
+        rep.healthy = False
+    with pytest.raises(NoHealthyReplicaError):
+        fleet.submit([5], GREEDY)
+    assert not fleet.healthy
+
+
+def test_all_replicas_draining_maps_to_draining_error():
+    fleet = EngineFleet([_FakeReplica(0), _FakeReplica(1)])
+    for rep in fleet.replicas:
+        rep.draining = True
+    with pytest.raises(DrainingError):
+        fleet.submit([5], GREEDY)
+    assert fleet.draining
+
+
+def test_all_replicas_recovering_is_retryable():
+    fleet = EngineFleet([_FakeReplica(0), _FakeReplica(1)])
+    for rep in fleet.replicas:
+        rep.recovering = True
+    with pytest.raises(RetryableEngineError) as ei:
+        fleet.submit([5], GREEDY)
+    assert ei.value.retry_after_s is not None  # min predicted drain
+
+
+def test_router_intent_map_groups_same_prefix_bursts():
+    """The intent map commits at DECISION time: with every replica cache
+    still cold (prefix_match_len == 0 forever on the fakes), repeats of a
+    routed prefix still follow the first placement."""
+    reps = [_FakeReplica(0), _FakeReplica(1)]
+    for rep in reps:
+        rep.block_len = 4  # keys exist; caches never warm
+    fleet = EngineFleet(reps, routing="prefix")
+    a, b = [1, 2, 3, 4, 9], [7, 7, 7, 7, 9]
+    fleet.submit(a, GREEDY)  # least-loaded tie, rotation -> replica 0
+    fleet.submit(b, GREEDY)  # rotation -> replica 1
+    for _ in range(3):
+        fleet.submit(a, GREEDY)
+        fleet.submit(b, GREEDY)
+    placements = fleet.recent_placements()
+    assert [i for i, _ in placements] == [0, 1, 0, 1, 0, 1, 0, 1]
+    assert [r for _, r in placements[2:]] == ["prefix_affinity"] * 6
+    snap = fleet.stats_snapshot()
+    assert snap["requests_routed_prefix_affinity"] == 6
+    assert snap["requests_routed_least_loaded"] == 2
+
+
+def test_fleet_rejects_bad_config():
+    with pytest.raises(ValueError):
+        EngineFleet([], routing="prefix")
+    with pytest.raises(ValueError):
+        EngineFleet([_FakeReplica(0)], routing="hash-ring")
+
+
+# ------------------------------------------------- real-model fleet behavior
+
+
+def _settled(fleet, timeout_s=10.0):
+    """Wait until no replica has queued or decoding work — so the next
+    routing decision sees the same (idle) views on every run."""
+    deadline = time.monotonic() + timeout_s
+    while any(r.queue_depth or r.live_slots for r in fleet.replicas):
+        assert time.monotonic() < deadline, "fleet never went idle"
+        time.sleep(0.005)
+
+
+def test_routing_determinism_same_stream_same_placements(generator):
+    """Two identically built fleets fed the same sequential request stream
+    place every request identically — placement is a pure function of the
+    stream, not of timing."""
+    tok = ByteChatMLTokenizer()
+    stream = [
+        tok.encode(t)
+        for t in (
+            # two fresh prefixes first (rotation spreads them), then
+            # repeats and extensions (affinity follows the blocks)
+            "the quick brown fox jumps over the lazy dog",
+            "pack my box with five dozen liquor jugs",
+            "the quick brown fox jumps over the sleeping cat",
+            "pack my box with five dozen jars",
+            "the quick brown fox jumps over the lazy dog again",
+        )
+    ]
+    fleets = [_fleet(generator), _fleet(generator)]
+
+    def run(fleet):
+        outs = []
+        for p in stream:
+            _settled(fleet)  # sequential, settled stream: views reproducible
+            outs.append(fleet.submit(p, GREEDY, timeout=240))
+        return outs
+
+    outs = [run(f) for f in fleets]
+    assert outs[0] == outs[1]
+    placements = [f.recent_placements() for f in fleets]
+    assert placements[0] == placements[1]
+    # and the stream actually exercised both replicas and both reasons
+    assert {i for i, _ in placements[0]} == {0, 1}
+    assert "prefix_affinity" in {r for _, r in placements[0]}
+    # greedy decode through the fleet is bit-identical to solo decode
+    solo = [generator.generate_ids(p, GREEDY) for p in stream]
+    assert outs[0] == solo
+
+
+def test_prefix_affinity_follows_replica_cache(generator):
+    """With the intent map disabled, affinity is driven purely by the
+    replicas' REAL prefix caches: a repeat routes back to the replica that
+    prefilled the blocks, and reads them as a cache hit."""
+    tok = ByteChatMLTokenizer()
+    prompt = tok.encode("the quick brown fox jumps over the lazy dog")
+    fleet = _fleet(generator)
+    fleet._prefix_cap = 0  # kill the intent map; only real residency scores
+    first = fleet.submit(prompt, GREEDY, timeout=240)
+    home = fleet.recent_placements()[0][0]
+    for _ in range(2):
+        assert fleet.submit(prompt, GREEDY, timeout=240) == first
+    placements = fleet.recent_placements()
+    assert [i for i, _ in placements] == [home] * 3
+    assert [r for _, r in placements[1:]] == ["prefix_affinity"] * 2
+    snap = fleet.stats_snapshot()
+    assert snap["prefix_tokens_reused"] > 0
+    assert snap["per_replica"][str(home)]["prefix_tokens_reused"] > 0
+    assert snap["per_replica"][str(1 - home)]["prefix_tokens_reused"] == 0
+
+
+def test_replica_crash_sheds_queue_to_survivor(generator):
+    """Kill one replica mid-load (terminal: circuit threshold 1): its
+    queued requests resettle on the sibling, every waiter resolves, and
+    every greedy result is bit-identical to solo ``generate_ids``. The
+    fleet stays healthy on the survivor."""
+    prompts = _prompts()
+    solo = [generator.generate_ids(p, GREEDY) for p in prompts]
+    fleet = _fleet(generator, routing="round-robin",
+                   circuit_threshold=1, circuit_window_s=60.0)
+    victim, survivor = fleet.replicas
+    # first decode tick on the victim dies, and keeps dying if it restarts
+    victim.faults.fail_decode_next(10)
+
+    outcomes = [None] * len(prompts)
+
+    def ask(i):
+        try:
+            outcomes[i] = ("ok", fleet.submit(prompts[i], GREEDY, timeout=240))
+        except BaseException as e:  # noqa: BLE001 - recording outcome
+            outcomes[i] = ("err", e)
+
+    threads = [threading.Thread(target=ask, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    assert all(not t.is_alive() for t in threads), "a waiter hung"
+    assert [o[0] for o in outcomes] == ["ok"] * len(prompts), outcomes
+    assert [o[1] for o in outcomes] == solo  # bit-identical despite the crash
+    # zero hung waiters on EITHER replica's settle ledger
+    assert victim._pending == 0 and survivor._pending == 0
+    assert not victim.healthy and survivor.healthy and fleet.healthy
+    assert fleet.circuit_state == "closed"  # fleet view: still serving
+    snap = fleet.stats_snapshot()
+    assert snap["requests_failed_over"] >= 1
+    assert snap["healthy_replicas"] == 1
+    # the victim stays out of the candidate set for NEW work
+    fleet.submit(prompts[0], GREEDY, timeout=240)
+    assert fleet.recent_placements()[-1][0] == fleet.replicas.index(survivor)
+
+
+def test_fleet_drain_fans_out(generator):
+    fleet = _fleet(generator)
+    prompts = _prompts()
+    assert fleet.submit(prompts[0], GREEDY, timeout=240) is not None  # warm
+    fleet.begin_drain()
+    assert fleet.draining
+    with pytest.raises(DrainingError):
+        fleet.submit(prompts[1], GREEDY, timeout=5)
+    assert fleet.wait_drained(timeout_s=30.0)
+
+
+def test_fleet_stats_aggregate_math(generator):
+    """Counters sum, generation is the max, rates are recomputed from the
+    summed counters, and merged histogram counts equal the per-replica
+    totals (exact merge, same fixed buckets)."""
+    fleet = _fleet(generator)
+    prompts = _prompts()
+    for p in prompts:
+        fleet.submit(p, GREEDY, timeout=240)
+    snap = fleet.stats_snapshot()
+    per = snap["per_replica"]
+    assert set(per) == {"0", "1"}
+    for key in ("tokens_served", "requests_completed", "prompt_tokens"):
+        assert snap[key] == per["0"][key] + per["1"][key]
+    assert snap["tokens_served"] == len(prompts) * GREEDY.max_new_tokens
+    assert snap["slots"] == per["0"]["slots"] + per["1"]["slots"]
+    assert snap["engine_generation"] == max(
+        per["0"]["engine_generation"], per["1"]["engine_generation"]
+    )
+    assert snap["histograms"]["ttft_s"]["count"] == (
+        per["0"]["histograms"]["ttft_s"]["count"]
+        + per["1"]["histograms"]["ttft_s"]["count"]
+    )
+    assert snap["replicas"] == 2 and snap["routing"] == "prefix"
+    assert snap["healthy_replicas"] == 2 and snap["available_replicas"] == 2
+    total_routed = sum(
+        snap[k]
+        for k in (
+            "requests_routed_prefix_affinity",
+            "requests_routed_least_loaded",
+            "requests_routed_round_robin",
+        )
+    )
+    assert total_routed == len(prompts)

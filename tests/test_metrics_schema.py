@@ -16,7 +16,9 @@ from llm_fine_tune_distributed_tpu.infer.engine import (
     ContinuousBatchingEngine,
     PagedContinuousBatchingEngine,
 )
+from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
 from llm_fine_tune_distributed_tpu.observe.metrics import (
+    FLEET_COUNTERS,
     PROMETHEUS_CONTENT_TYPE,
     ServingStats,
     prometheus_exposition,
@@ -181,6 +183,93 @@ def test_metrics_exposition_well_formed():
     assert re.search(r'serving_ttft_seconds_bucket\{le="0\.1024"\} 0', text)
     assert re.search(r'serving_ttft_seconds_bucket\{le="0\.2048"\} 1', text)
     assert "serving_ttft_seconds_count 1" in text
+
+
+# The fleet /v1/stats contract: everything a single paged engine reports,
+# aggregated, plus the router-level keys and the per-replica map.
+FLEET_EXTRA_KEYS = {
+    "replicas", "routing", "healthy_replicas", "available_replicas",
+    "per_replica",
+    # router counters (EngineFleet.ROUTER_COUNTERS == metrics.FLEET_COUNTERS)
+    "requests_routed_prefix_affinity", "requests_routed_least_loaded",
+    "requests_routed_round_robin", "requests_failed_over",
+    "requests_rerouted_overflow", "requests_shed_fleet_saturated",
+}
+
+# The fleet /metrics contract: the single-engine TYPE set plus the router
+# counters, the replica-count gauges, and the per-replica info line. The
+# per-replica samples reuse the SAME metric names with a replica label, so
+# they add no TYPE lines beyond these.
+FLEET_EXPECTED_METRICS = EXPECTED_METRICS | {
+    ("serving_replica_info", "gauge"),
+    ("serving_replicas", "gauge"),
+    ("serving_healthy_replicas", "gauge"),
+    ("serving_available_replicas", "gauge"),
+    ("serving_requests_routed_prefix_affinity_total", "counter"),
+    ("serving_requests_routed_least_loaded_total", "counter"),
+    ("serving_requests_routed_round_robin_total", "counter"),
+    ("serving_requests_failed_over_total", "counter"),
+    ("serving_requests_rerouted_overflow_total", "counter"),
+    ("serving_requests_shed_fleet_saturated_total", "counter"),
+}
+
+
+def test_fleet_counter_lists_agree():
+    """The router counters live in two modules by design (the fleet owns
+    them, the exposition types them); they must never drift."""
+    assert set(FLEET_COUNTERS) == set(EngineFleet.ROUTER_COUNTERS)
+
+
+def test_fleet_stats_snapshot_key_schema():
+    fleet = EngineFleet([_make("paged"), _make("paged")], routing="prefix")
+    snap = fleet.stats_snapshot()
+    single = SNAPSHOT_KEYS | PAGED_ONLY_KEYS
+    assert set(snap) == single | FLEET_EXTRA_KEYS
+    assert set(snap["per_replica"]) == {"0", "1"}
+    # each per-replica entry is EXACTLY a single-engine snapshot + its label
+    for label, rsnap in snap["per_replica"].items():
+        assert set(rsnap) == single | {"replica"}
+        assert rsnap["replica"] == int(label)
+    assert set(snap["histograms"]) == HISTOGRAM_KEYS
+
+
+def test_fleet_metrics_exposition_replica_labels():
+    """Fleet /metrics: one TYPE line per metric name, an aggregate sample,
+    then the same metric with replica="i" per replica — counters, gauges,
+    and histogram buckets alike."""
+    fleet = EngineFleet([_make("paged"), _make("paged")], routing="prefix")
+    fleet.replicas[0].stats.incr("tokens_served", 3)
+    fleet.replicas[1].stats.incr("tokens_served", 4)
+    fleet.replicas[0].stats.observe("ttft_s", 0.12)
+    snap = {"engine": "paged", **fleet.stats_snapshot()}
+    per = snap.pop("per_replica")  # mirrors the infer/server.py handler
+    series = [
+        (label, per[label], fleet.replicas[int(label)].stats.hist)
+        for label in sorted(per, key=int)
+    ]
+    text = prometheus_exposition(
+        snap, fleet.merged_histograms(), memory=FAKE_MEMORY, replicas=series
+    )
+    typed = {
+        (m.group(1), m.group(2))
+        for m in re.finditer(r"^# TYPE (\S+) (\S+)$", text, re.M)
+    }
+    assert typed == FLEET_EXPECTED_METRICS
+    # aggregate sample + one labelled sample per replica, counters...
+    assert "serving_tokens_served_total 7" in text
+    assert 'serving_tokens_served_total{replica="0"} 3' in text
+    assert 'serving_tokens_served_total{replica="1"} 4' in text
+    # ...gauges, histogram buckets/sums, and the per-replica info line
+    assert 'serving_slots{replica="0"} 2' in text
+    assert re.search(
+        r'serving_ttft_seconds_bucket\{replica="0",le="0\.2048"\} 1', text
+    )
+    assert 'serving_ttft_seconds_count{replica="1"} 0' in text
+    assert "serving_ttft_seconds_count 1" in text  # merged aggregate
+    assert 'serving_replica_info{replica="0",circuit_state="closed"' in text
+    # exactly one TYPE line per metric name (the format forbids repeats)
+    names = re.findall(r"^# TYPE (\S+) ", text, re.M)
+    assert len(names) == len(set(names))
 
 
 def test_window_fallback_exposition():
