@@ -40,6 +40,16 @@ round-robin scatters every prefix across all replicas (each replica pays
 its own first-touch prefill), so the JSON lines carry the fleet
 prefix-hit-rate per policy — the number affinity routing exists to raise.
 
+The multi-tenant arm runs a FIFTH workload — N tenants, each a distinct
+LoRA adapter and its own all-greedy request stream — two ways at equal
+total slot capacity: co-batched on ONE engine through the pooled per-slot
+adapter gather (infer/adapters.py), and sequentially on per-tenant
+merged-weight engines (the swap-per-tenant pattern the pool replaces).
+Each tenant's trickle can't fill the slots alone; the pool fills them
+across tenants, and the JSON lines carry the ratio, per-tenant TTFT
+p50/p99, and a check that the engine's per-tenant token ledger matches
+what the clients counted.
+
 Usage: python benchmarks/serve_bench.py   (CPU ok: defaults to the tiny
 preset off-accelerator). Env: SERVE_PRESET, SERVE_CLIENTS=1,8,32,
 SERVE_REQS_PER_CLIENT (default 4), SERVE_SLOTS (default 8),
@@ -47,7 +57,8 @@ SERVE_ENGINES=continuous,paged,window, SERVE_CHAOS=1 (chaos arm: inject one
 retryable decode failure mid-workload and report recovery wall time plus
 TTFT after recovery; SERVE_CHAOS_CLIENTS=8), SERVE_SPEC=1 (speculative arm;
 SERVE_SPEC_K=4, SERVE_SPEC_CLIENTS=16), SERVE_FLEET=1 (fleet arm;
-SERVE_FLEET_CLIENTS=8).
+SERVE_FLEET_CLIENTS=8), SERVE_TENANTS=4 (multi-tenant arm tenant count; 0
+disables; SERVE_TENANT_REQS=8 requests per tenant).
 """
 
 import json
@@ -124,6 +135,20 @@ def _multi_prefix_workload(rng, vocab, n, prefixes=8, prefix_len=160):
         gen = GenerationConfig(max_new_tokens=max_new, do_sample=False)
         suffix = rng.randint(0, min(vocab, 256), (slen,)).tolist()
         out.append((systems[i % prefixes] + suffix, gen, i))
+    return out
+
+
+def _tenant_workload(rng, vocab, n, max_new=16):
+    """Per-tenant pool: short random prompts, all-greedy, FIXED budget so
+    the co-batched and sequential arms serve identical token counts and the
+    tokens/sec ratio is a pure scheduling comparison."""
+    from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+
+    gen = GenerationConfig(max_new_tokens=max_new, do_sample=False)
+    out = []
+    for i in range(n):
+        plen = int(rng.choice([8, 24, 48]))
+        out.append((rng.randint(0, min(vocab, 256), (plen,)).tolist(), gen, i))
     return out
 
 
@@ -564,6 +589,202 @@ def main():
                 "tokens_per_sec_round_robin": round(two_rr[0], 2),
                 "clients": fleet_clients,
             }), flush=True)
+
+    # multi-tenant arm: N tenants' LoRA adapters co-batched on ONE engine via
+    # the pooled per-slot gather (infer/adapters.py) vs serving the same
+    # tenants SEQUENTIALLY on merged-weight engines (the swap-per-tenant
+    # pattern multi-tenant serving replaces) at equal total slot capacity.
+    # Co-batching wins because each tenant's trickle of traffic can't fill
+    # the slots alone — the pool lets the slots fill ACROSS tenants while
+    # the sequential baseline decodes one tenant's near-empty batch at a
+    # time (plus a weight merge per swap, reported separately).
+    n_tenants = int(os.environ.get("SERVE_TENANTS", "4"))
+    if n_tenants > 0 and "continuous" in engines:
+        import shutil
+        import tempfile
+
+        from llm_fine_tune_distributed_tpu.config import TrainConfig
+        from llm_fine_tune_distributed_tpu.infer.adapters import AdapterRegistry
+        from llm_fine_tune_distributed_tpu.parallel.lora import (
+            add_lora_params,
+            load_lora_adapter,
+            merge_lora,
+            save_lora_adapter,
+        )
+
+        tenant_reqs = int(os.environ.get("SERVE_TENANT_REQS", "8"))
+        names = [f"tenant{i}" for i in range(n_tenants)]
+        adapter_root = tempfile.mkdtemp(prefix="serve_bench_adapters_")
+        for i, name in enumerate(names):
+            lp = add_lora_params(
+                params, jax.random.PRNGKey(100 + i), rank=8, alpha=16.0
+            )
+
+            def _bump(node, rs=np.random.RandomState(100 + i)):
+                # fresh-init B is zero (identity adapter); give each tenant
+                # a distinct non-trivial delta so the arm exercises real
+                # per-slot divergence, not N copies of the base model
+                if isinstance(node, dict):
+                    if "lora_b" in node:
+                        node = dict(node)
+                        node["lora_b"] = jnp.asarray(
+                            rs.normal(0, 0.02, node["lora_b"].shape),
+                            node["lora_b"].dtype,
+                        )
+                        return node
+                    return {k: _bump(v) for k, v in node.items()}
+                return node
+
+            save_lora_adapter(
+                _bump(lp), os.path.join(adapter_root, name),
+                TrainConfig(
+                    freeze_strategy="lora", lora_rank=8, lora_alpha=16.0
+                ),
+            )
+        loads = {
+            name: _tenant_workload(
+                np.random.RandomState(200 + i), mc.vocab_size, tenant_reqs
+            )
+            for i, name in enumerate(names)
+        }
+
+        def run_tenant_clients(engine, tenant_loads, with_adapter):
+            """One client thread per tenant, streaming so TTFT is measured
+            client-side per tenant. Returns (tokens, wall_s, ttfts, tokens
+            per tenant, errors)."""
+            ttfts = {name: [] for name in tenant_loads}
+            toks = {name: 0 for name in tenant_loads}
+            errors = []
+
+            def client(name, load):
+                for prompt, gen, seed in load:
+                    kw = {"adapter": name} if with_adapter else {}
+                    t_req = time.perf_counter()
+                    try:
+                        it = engine.stream(
+                            prompt, gen, seed=seed, timeout=600, **kw
+                        )
+                        next(it)
+                        ttfts[name].append(time.perf_counter() - t_req)
+                        toks[name] += 1 + sum(1 for _ in it)
+                    except Exception as e:  # pragma: no cover
+                        errors.append(repr(e))
+
+            threads = [
+                threading.Thread(target=client, args=(name, load))
+                for name, load in tenant_loads.items()
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            return sum(toks.values()), dt, ttfts, toks, errors
+
+        # --- co-batched: one engine, one adapter pool, all tenants at once
+        registry = AdapterRegistry(
+            params, adapter_root, max_adapters=n_tenants + 1
+        )
+        engine = ContinuousBatchingEngine(
+            generator, slots=slots, buf_len=256, prompt_bucket=32,
+            adapters=registry,
+        )
+        run_tenant_clients(  # warm the jit caches off the clock
+            engine, {n: l[:2] for n, l in loads.items()}, True
+        )
+        total, dt, ttfts, toks, errors = run_tenant_clients(
+            engine, loads, True
+        )
+        co_tps = total / dt if dt > 0 else 0.0
+        snap = engine.stats_snapshot()
+        # the engine's per-tenant ledger must agree with what clients counted
+        tenants_verified = all(
+            snap["per_tenant"].get(n, {}).get("tokens", -1) >= toks[n]
+            for n in names
+        )
+        print(json.dumps({
+            "metric": f"serve_tokens_per_sec_multitenant_cobatched_t{n_tenants}",
+            "value": round(co_tps, 2),
+            "unit": "tokens/sec",
+            "engine": "continuous",
+            "workload": "multi_tenant",
+            "tenants": n_tenants,
+            "requests": n_tenants * tenant_reqs,
+            "tokens_served": total,
+            "wall_seconds": round(dt, 2),
+            "adapters_resident": snap["adapters_resident"],
+            "adapter_loads": snap["adapter_loads"],
+            "per_tenant_tokens_verified": tenants_verified,
+            "per_tenant_ttft_ms": {
+                n: {
+                    "p50": round(_pctl(sorted(v), 0.50) * 1e3, 2),
+                    "p99": round(_pctl(sorted(v), 0.99) * 1e3, 2),
+                }
+                for n, v in ttfts.items()
+            },
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+            "slots": slots,
+            "errors": errors,
+        }), flush=True)
+
+        # --- sequential baseline: per tenant, merge the adapter into the
+        # weights (the swap) and serve that tenant alone on a full-slot
+        # engine; total wall is the sum of per-tenant runs. Each engine is
+        # warmed off the clock so the comparison is scheduling, not
+        # compilation; the merge cost is reported on its own.
+        seq_wall = 0.0
+        seq_total = 0
+        merge_wall = 0.0
+        seq_errors = []
+        for name in names:
+            t_m = time.perf_counter()
+            merged = merge_lora(
+                load_lora_adapter(params, os.path.join(adapter_root, name))
+            )
+            merge_wall += time.perf_counter() - t_m
+            m_gen = Generator(
+                merged, mc, ByteChatMLTokenizer(), compute_dtype=dtype,
+                eos_token_ids=[],
+            )
+            m_engine = ContinuousBatchingEngine(
+                m_gen, slots=slots, buf_len=256, prompt_bucket=32
+            )
+            run_tenant_clients(m_engine, {name: loads[name][:2]}, False)
+            n_toks, n_dt, _, _, errs = run_tenant_clients(
+                m_engine, {name: loads[name]}, False
+            )
+            seq_wall += n_dt
+            seq_total += n_toks
+            seq_errors.extend(errs)
+        seq_tps = seq_total / seq_wall if seq_wall > 0 else 0.0
+        print(json.dumps({
+            "metric": f"serve_tokens_per_sec_multitenant_sequential_t{n_tenants}",
+            "value": round(seq_tps, 2),
+            "unit": "tokens/sec",
+            "engine": "continuous",
+            "workload": "multi_tenant",
+            "tenants": n_tenants,
+            "requests": n_tenants * tenant_reqs,
+            "tokens_served": seq_total,
+            "wall_seconds": round(seq_wall, 2),
+            "merge_swap_seconds_total": round(merge_wall, 4),
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+            "slots": slots,
+            "errors": seq_errors,
+        }), flush=True)
+        if seq_tps:
+            print(json.dumps({
+                "metric": f"serve_multitenant_cobatch_speedup_t{n_tenants}",
+                "value": round(co_tps / seq_tps, 2),
+                "unit": "x over sequential merged-weight swaps "
+                        "(equal total slots)",
+                "tenants": n_tenants,
+                "per_tenant_tokens_verified": tenants_verified,
+            }), flush=True)
+        shutil.rmtree(adapter_root, ignore_errors=True)
 
     # chaos arm: one injected decode failure mid-workload; reports recovery
     # wall time and post-recovery TTFT per supervised engine

@@ -1,0 +1,318 @@
+"""Multi-tenant LoRA serving: the adapter registry and its stacked pools.
+
+The paper's end product is a LoRA fine-tune (r=16, alpha=8, seven
+projection targets) of a shared base model. One merged checkpoint per
+process means one fleet per tenant; this module turns one deployment into
+a platform: many adapters, one base model, ONE fused batch. Batched TPU
+decode is weight-bandwidth-bound, so the throughput-correct shape is to
+co-batch every tenant's requests into the same dispatch and let each row
+gather its own low-rank delta — not to context-switch merged weights.
+
+``AdapterRegistry`` owns a POOLED VIEW of the generator's params: beside
+every target kernel it attaches three stacked leaves
+
+    lora_a_pool     [max_adapters, in, rank]
+    lora_b_pool     [max_adapters, rank, out]
+    lora_scale_pool [max_adapters]
+
+with **slot 0 reserved as the identity adapter** (all-zero A/B — an
+exactly-zero delta, so base-model rows co-batch bit-identically). The
+engines pass ``registry.params`` instead of ``generator.params`` to every
+jitted program and thread a per-slot ``adapter_idx`` vector through decode
+and chunked prefill; ``models/transformer._linear`` batch-gathers each
+row's (A, B, scale) from the pools. The pool arrays are SHAPE-STABLE:
+hot-loading or evicting an adapter is a value update on the same leaves,
+never a retrace or recompile.
+
+Lifecycle is refcount + LRU:
+
+- ``acquire(name)`` resolves a tenant to a pool slot, hot-loading the
+  PEFT-layout directory ``<adapter_dir>/<name>`` (validated import via
+  ``parallel/lora.peft_adapter_state`` — mismatched configs fail with a
+  ValueError naming the field, unknown names with a 404-mapped
+  ``UnknownAdapterError`` carrying the known list) and pins it for the
+  request's lifetime.
+- ``release(name)`` unpins; idle adapters stay RESIDENT (warm) in LRU
+  order and are evicted only when a load needs their slot. An adapter
+  pinned by any live request is NEVER evicted; if every slot is pinned the
+  load fails with a 429-mapped ``AdapterPoolFullError``.
+- ``rebuild()`` re-uploads every resident adapter from host-side copies —
+  the engines call it from their supervised ``_startup`` path so crash
+  recovery restores the resident set before any request is re-admitted.
+
+Host copies are numpy (tiny: rank-16 factors); device pools are rebuilt
+functionally with ``.at[slot].set``. All mutation is lock-serialized;
+engines read ``registry.params`` between updates safely because replacing
+a dict value is atomic under the GIL and a loading slot is only referenced
+by the request that triggered the load.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.infer.errors import (
+    AdapterPoolFullError,
+    UnknownAdapterError,
+)
+from llm_fine_tune_distributed_tpu.parallel.lora import peft_adapter_state
+
+# Pools are attached to the paper's seven projection targets (the modules
+# `add_lora_params` defaults to). Adapters targeting anything else (e.g.
+# lm_head) are rejected at load with a clear error rather than silently
+# dropping part of their delta.
+POOL_TARGET_MODULES = (
+    "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj",
+)
+
+
+class AdapterRegistry:
+    """Fixed-capacity stacked adapter pool over a shared base model.
+
+    ``max_adapters`` is the pool DEPTH: slot 0 is the reserved identity
+    adapter, so up to ``max_adapters - 1`` tenants are resident at once.
+    ``rank`` is the pool's rank ceiling; adapters with smaller rank are
+    zero-padded (an exact no-op on their delta), larger ranks are rejected.
+    """
+
+    def __init__(
+        self,
+        base_params,
+        adapter_dir: str,
+        *,
+        max_adapters: int = 8,
+        rank: Optional[int] = None,
+        stats=None,
+    ):
+        if max_adapters < 2:
+            raise ValueError(
+                f"max_adapters must be >= 2 (slot 0 is the identity adapter), "
+                f"got {max_adapters}"
+            )
+        self.adapter_dir = adapter_dir
+        self.max_adapters = int(max_adapters)
+        self.stats = stats
+        self._lock = threading.RLock()
+        self._names: List[Optional[str]] = [None] * self.max_adapters
+        self._idx: Dict[str, int] = {}
+        self._refs: Dict[str, int] = {}
+        self._lru: "OrderedDict[str, None]" = OrderedDict()  # idle residents
+        # host-side padded copies per resident adapter, for crash rebuild:
+        # name -> (entries {path_tuple: (A [in, rank], B [rank, out])}, scale)
+        self._host: Dict[str, Tuple[dict, float]] = {}
+        self.rank = int(rank) if rank else self._scan_rank()
+        # Pooled view: same spine as base_params, pool leaves attached
+        # beside every target kernel. Module dicts holding pools are kept in
+        # _sites for in-place slot updates.
+        self._sites: Dict[tuple, dict] = {}
+        self.params = self._build_view(base_params)
+        if not self._sites:
+            raise ValueError(
+                "the model has no linear module matching the adapter pool "
+                f"targets {POOL_TARGET_MODULES}"
+            )
+
+    # ----------------------------------------------------------- construction
+
+    def _scan_rank(self) -> int:
+        """Pool rank = max ``r`` across the adapters on disk (default 16)."""
+        import json
+
+        best = 0
+        for name in self.known():
+            try:
+                with open(os.path.join(
+                    self.adapter_dir, name, "adapter_config.json"
+                )) as f:
+                    best = max(best, int(json.load(f).get("r", 0)))
+            except (OSError, ValueError, TypeError):
+                continue
+        return best or 16
+
+    def _build_view(self, base_params):
+        def walk(node, prefix):
+            if not isinstance(node, dict):
+                return node
+            if "kernel" in node:
+                name = prefix[-1] if prefix else ""
+                kernel = node["kernel"]
+                if name in POOL_TARGET_MODULES and getattr(kernel, "ndim", 0) == 2:
+                    d_in, d_out = kernel.shape
+                    out = dict(node)
+                    out["lora_a_pool"] = jnp.zeros(
+                        (self.max_adapters, d_in, self.rank), jnp.float32
+                    )
+                    out["lora_b_pool"] = jnp.zeros(
+                        (self.max_adapters, self.rank, d_out), jnp.float32
+                    )
+                    out["lora_scale_pool"] = jnp.zeros(
+                        (self.max_adapters,), jnp.float32
+                    )
+                    self._sites[tuple(prefix)] = out
+                    return out
+                return node
+            return {k: walk(v, prefix + (k,)) for k, v in node.items()}
+
+        return walk(base_params, ())
+
+    # ---------------------------------------------------------------- surface
+
+    def known(self) -> List[str]:
+        """Adapter names on disk (subdirectories with an adapter_config.json)."""
+        try:
+            return sorted(
+                d for d in os.listdir(self.adapter_dir)
+                if os.path.exists(
+                    os.path.join(self.adapter_dir, d, "adapter_config.json")
+                )
+            )
+        except OSError:
+            return []
+
+    def resident(self) -> List[str]:
+        with self._lock:
+            return [n for n in self._names if n is not None]
+
+    def is_resident(self, name: str) -> bool:
+        with self._lock:
+            return name in self._idx
+
+    def slot_of(self, name: str) -> Optional[int]:
+        with self._lock:
+            return self._idx.get(name)
+
+    def refcount(self, name: str) -> int:
+        with self._lock:
+            return self._refs.get(name, 0)
+
+    def acquire(self, name: str) -> int:
+        """Resolve ``name`` to a pool slot and pin it (refcount++). Loads
+        from disk on first touch, evicting the least-recently-used IDLE
+        resident if the pool is full. Raises ``UnknownAdapterError`` (404)
+        for unresolvable names, ``AdapterPoolFullError`` (429) when every
+        slot is pinned, and ``ValueError`` for adapters that do not fit the
+        model or pool rank."""
+        with self._lock:
+            if name in self._idx:
+                self._refs[name] += 1
+                self._lru.pop(name, None)
+                return self._idx[name]
+            path = os.path.join(self.adapter_dir, name)
+            if (
+                not name
+                or os.sep in name
+                or not os.path.exists(os.path.join(path, "adapter_config.json"))
+            ):
+                raise UnknownAdapterError(
+                    f"unknown adapter {name!r}: no such adapter under "
+                    f"{self.adapter_dir}",
+                    known=tuple(self.known()),
+                )
+            slot = self._free_slot()
+            entries, scale, _ = peft_adapter_state(self.params, path)
+            padded = self._pad(name, entries)
+            self._write_slot(slot, padded, float(scale))
+            self._host[name] = (padded, float(scale))
+            self._names[slot] = name
+            self._idx[name] = slot
+            self._refs[name] = 1
+            if self.stats is not None:
+                self.stats.incr("adapter_loads")
+            return slot
+
+    def release(self, name: str) -> None:
+        """Unpin one request's hold. At refcount 0 the adapter stays warm
+        but becomes evictable (joins the LRU tail)."""
+        with self._lock:
+            if name not in self._refs:
+                return
+            self._refs[name] -= 1
+            if self._refs[name] <= 0:
+                self._refs[name] = 0
+                self._lru[name] = None
+                self._lru.move_to_end(name)
+
+    def rebuild(self) -> None:
+        """Re-upload every resident adapter from the host copies — the
+        engines' supervised ``_startup`` calls this so an in-process crash
+        recovery restores the resident set (and slot assignments) exactly."""
+        with self._lock:
+            for slot, name in enumerate(self._names):
+                if name is None:
+                    continue
+                padded, scale = self._host[name]
+                self._write_slot(slot, padded, scale)
+
+    # -------------------------------------------------------------- internals
+
+    def _free_slot(self) -> int:
+        """A free pool slot (never 0), evicting the LRU idle resident when
+        none is free. Caller holds the lock."""
+        for i in range(1, self.max_adapters):
+            if self._names[i] is None:
+                return i
+        while self._lru:
+            victim, _ = self._lru.popitem(last=False)
+            if self._refs.get(victim, 0) == 0 and victim in self._idx:
+                slot = self._idx.pop(victim)
+                self._names[slot] = None
+                self._refs.pop(victim, None)
+                self._host.pop(victim, None)
+                if self.stats is not None:
+                    self.stats.incr("adapter_evictions")
+                return slot
+        raise AdapterPoolFullError(
+            f"all {self.max_adapters - 1} adapter slots are pinned by live "
+            "requests; retry when a tenant drains"
+        )
+
+    def _pad(self, name: str, entries: dict) -> dict:
+        """Zero-pad (A, B) to the pool rank and zero-fill untargeted sites.
+        Padding columns of A / rows of B are zero, so the padded delta is
+        exactly the adapter's own."""
+        out = {}
+        for pth in entries:
+            if pth not in self._sites:
+                raise ValueError(
+                    f"adapter {name!r} targets module "
+                    f"{'.'.join(pth)} which has no pool (pooled targets: "
+                    f"{POOL_TARGET_MODULES})"
+                )
+        for pth, site in self._sites.items():
+            d_in = site["lora_a_pool"].shape[1]
+            d_out = site["lora_b_pool"].shape[2]
+            a = np.zeros((d_in, self.rank), np.float32)
+            b = np.zeros((self.rank, d_out), np.float32)
+            if pth in entries:
+                ea, eb = entries[pth]
+                r = ea.shape[1]
+                if r > self.rank:
+                    raise ValueError(
+                        f"adapter {name!r} has rank {r} > pool rank "
+                        f"{self.rank} (fixed at startup from the adapters "
+                        "then on disk); restart the server so the pool "
+                        "rescans, or retrain the adapter at a smaller rank"
+                    )
+                a[:, :r] = ea
+                b[:r, :] = eb
+            out[pth] = (a, b)
+        return out
+
+    def _write_slot(self, slot: int, padded: dict, scale: float) -> None:
+        for pth, site in self._sites.items():
+            a, b = padded[pth]
+            site["lora_a_pool"] = site["lora_a_pool"].at[slot].set(
+                jnp.asarray(a)
+            )
+            site["lora_b_pool"] = site["lora_b_pool"].at[slot].set(
+                jnp.asarray(b)
+            )
+            site["lora_scale_pool"] = site["lora_scale_pool"].at[slot].set(
+                jnp.float32(scale)
+            )

@@ -55,6 +55,12 @@ class Request:
     # request instead of decoding for nobody (a recovered device would
     # otherwise burn minutes on dead work before serving live traffic)
     abandoned: bool = False
+    # multi-tenant LoRA serving (continuous engines only): the tenant's
+    # adapter name and its pool slot in the engine's AdapterRegistry
+    # (infer/adapters.py). 0 = identity (base model). The registry pin taken
+    # at admission is released at the request's single _settle point.
+    adapter: Optional[str] = None
+    adapter_idx: int = 0
     # speculative-decoding telemetry, PER REQUEST: this row's/slot's own
     # proposed and accepted draft-token counts, and its acceptance rate
     # (spec_acceptance = accepted / proposed; None unless the request asked

@@ -76,12 +76,13 @@ import queue
 import threading
 import time
 from collections import deque
-from typing import Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from llm_fine_tune_distributed_tpu.infer.batching import Request
 from llm_fine_tune_distributed_tpu.infer.errors import (
+    AdapterPoolFullError,
     CircuitOpenError,
     DrainingError,
     FatalEngineError,
@@ -89,6 +90,8 @@ from llm_fine_tune_distributed_tpu.infer.errors import (
     QueueOverflowError,
     RetryableEngineError,
     ServingError,
+    TenantQuotaError,
+    UnknownAdapterError,
     is_retryable_failure,
 )
 from llm_fine_tune_distributed_tpu.infer.paged import (
@@ -162,6 +165,8 @@ class ContinuousBatchingEngine:
         flight_dir: Optional[str] = None,
         flight_capacity: int = 1024,
         trace_log: Optional[str] = None,
+        adapters=None,
+        adapter_quota: int = 0,
     ):
         if getattr(generator, "_multihost", False):
             raise ValueError(
@@ -170,10 +175,28 @@ class ContinuousBatchingEngine:
                 "window BatchingEngine behind a MultihostCoordinator"
             )
         self._generator = generator
+        # multi-tenant LoRA serving (infer/adapters.py): with a registry
+        # attached every jitted program runs over its POOLED params view
+        # (base leaves shared; stacked per-module adapter pools beside each
+        # target kernel) and each slot carries its request's adapter_idx —
+        # tenants co-batch in the same dispatch. adapter_quota bounds each
+        # tenant's concurrently-admitted requests (0 = unbounded).
+        self._mt = adapters
+        self._params = (
+            adapters.params
+            if adapters is not None
+            # getattr: schema tests construct idle engines over a stub
+            # generator with no params (the worker never dispatches)
+            else getattr(generator, "params", None)
+        )
+        self._adapter_quota = max(0, int(adapter_quota))
+        self._tenant_inflight: Dict[str, int] = {}
         self._slots = max(1, int(slots))
         self._buf_len = int(buf_len)
         self._bucket = max(1, int(prompt_bucket))
         self.stats = stats or ServingStats(self._slots)
+        if self._mt is not None and self._mt.stats is None:
+            self._mt.stats = self.stats  # adapter load/evict counters
         self._q: "queue.Queue[Request]" = queue.Queue()
         # admission policy (read on submit threads, set once here)
         self._max_queue_depth = max(0, int(max_queue_depth))  # 0 = unbounded
@@ -249,9 +272,10 @@ class ContinuousBatchingEngine:
         gen: GenerationConfig,
         seed: int = 0,
         timeout: Optional[float] = None,
+        adapter: Optional[str] = None,
     ) -> List[int]:
         """Blocking: enqueue one request, wait for its full token list."""
-        return self.submit_full(prompt_ids, gen, seed, timeout).result
+        return self.submit_full(prompt_ids, gen, seed, timeout, adapter).result
 
     def submit_full(
         self,
@@ -259,10 +283,13 @@ class ContinuousBatchingEngine:
         gen: GenerationConfig,
         seed: int = 0,
         timeout: Optional[float] = None,
+        adapter: Optional[str] = None,
     ) -> Request:
         """``submit`` returning the whole request record (window-engine
-        parity, so the server can swap engines behind one call shape)."""
-        req = self._make_request(prompt_ids, gen, seed)
+        parity, so the server can swap engines behind one call shape).
+        ``adapter`` names the tenant's LoRA adapter (AdapterRegistry slot);
+        None serves the base model."""
+        req = self._make_request(prompt_ids, gen, seed, adapter=adapter)
         self._q.put(req)
         if not req.done.wait(timeout):
             req.abandoned = True  # the worker sheds it un-decoded
@@ -280,6 +307,7 @@ class ContinuousBatchingEngine:
         gen: GenerationConfig,
         seed: int = 0,
         timeout: Optional[float] = None,
+        adapter: Optional[str] = None,
     ) -> Iterator[int]:
         """Yield the request's tokens one at a time AS THEY DECODE, while the
         request shares the slot batch with everything else in flight — the
@@ -290,7 +318,9 @@ class ContinuousBatchingEngine:
         Admission (overflow/drain/circuit) is checked HERE, not at first
         iteration, so the server can return a real status code before
         committing to an SSE response."""
-        req = self._make_request(prompt_ids, gen, seed, tokens_q=queue.Queue())
+        req = self._make_request(
+            prompt_ids, gen, seed, tokens_q=queue.Queue(), adapter=adapter
+        )
         self._q.put(req)
 
         def _tokens() -> Iterator[int]:
@@ -388,11 +418,23 @@ class ContinuousBatchingEngine:
         routing.prefix_block_keys — the same keys paged admission matches."""
         return 0
 
+    def adapter_resident(self, name: Optional[str]) -> bool:
+        """True when the named tenant's adapter is already resident in this
+        replica's pool — the router's adapter-affinity signal (a resident
+        hit skips the hot-load and cannot evict another tenant)."""
+        if name is None or self._mt is None:
+            return False
+        return self._mt.is_resident(name)
+
     def stats_snapshot(self) -> dict:
         """Current counters + freshly-read gauges (``GET /v1/stats``)."""
         self.stats.gauge("queue_depth", self._queue_len())
         self.stats.gauge("live_slots", int(self._live.sum()))
         self.stats.gauge("engine_generation", self.supervisor.generation)
+        self.stats.gauge(
+            "adapters_resident",
+            len(self._mt.resident()) if self._mt is not None else 0,
+        )
         snap = self.stats.snapshot()
         snap["circuit_state"] = self.circuit_state
         snap["draining"] = self._draining
@@ -417,11 +459,13 @@ class ContinuousBatchingEngine:
         gen: GenerationConfig,
         seed: int,
         tokens_q: Optional["queue.Queue"] = None,
+        adapter: Optional[str] = None,
     ) -> Request:
         """Admission gate, shared by submit and stream: reject terminal /
         draining / overflow states BEFORE the request enters the queue, and
         stamp the queue-wait deadline. Registers the request in the pending
-        ledger — from here on, exactly one ``_settle`` resolves it."""
+        ledger — from here on, exactly one ``_settle`` resolves it (which
+        also releases the adapter pin and tenant bookkeeping taken here)."""
         if self._terminal is not None:
             raise self._terminal
         if self._draining:
@@ -438,7 +482,43 @@ class ContinuousBatchingEngine:
                 f"max_queue_depth {self._max_queue_depth})",
                 retry_after_s=self._retry_after(),
             )
+        adapter_idx = 0
+        if adapter is not None:
+            if self._mt is None:
+                raise UnknownAdapterError(
+                    f"adapter {adapter!r} requested but this engine has no "
+                    "adapter registry (start the server with --adapter-dir)"
+                )
+            with self._plock:
+                over_quota = (
+                    self._adapter_quota > 0
+                    and self._tenant_inflight.get(adapter, 0)
+                    >= self._adapter_quota
+                )
+            if over_quota:
+                self.stats.incr("requests_shed_tenant_quota")
+                self.recorder.record("shed_tenant_quota", tenant=adapter)
+                raise TenantQuotaError(
+                    f"tenant {adapter!r} already has {self._adapter_quota} "
+                    "request(s) in flight (--adapter-capacity); retry when "
+                    "one completes",
+                    retry_after_s=self._retry_after(),
+                )
+            try:
+                adapter_idx = self._mt.acquire(adapter)
+            except AdapterPoolFullError as e:
+                e.retry_after_s = self._retry_after()
+                raise
         req = Request(list(prompt_ids), gen, seed, tokens_q=tokens_q)
+        req.adapter = adapter
+        req.adapter_idx = int(adapter_idx)
+        if adapter is not None:
+            with self._plock:
+                self._tenant_inflight[adapter] = (
+                    self._tenant_inflight.get(adapter, 0) + 1
+                )
+            self.stats.tenant_incr(adapter, "requests")
+            self.stats.tenant_incr(adapter, "queue_depth")
         req.id = next(self._req_seq)
         req.enqueued_at = time.monotonic()
         req.trace = RequestTrace(req.id, t0=req.enqueued_at)
@@ -466,6 +546,16 @@ class ContinuousBatchingEngine:
         already marked its terminal span by the time it settles."""
         with self._plock:
             self._pending -= 1
+            if req.adapter is not None:
+                n = self._tenant_inflight.get(req.adapter, 1) - 1
+                if n <= 0:
+                    self._tenant_inflight.pop(req.adapter, None)
+                else:
+                    self._tenant_inflight[req.adapter] = n
+        if req.adapter is not None:
+            self.stats.tenant_incr(req.adapter, "queue_depth", -1)
+            if self._mt is not None:
+                self._mt.release(req.adapter)
         if self._trace_writer is not None and req.trace is not None:
             self._trace_writer.write(
                 {
@@ -536,6 +626,11 @@ class ContinuousBatchingEngine:
         is an allocation + a couple of dispatches — not a recompilation."""
         gen = self._generator
         self._cache, self._state = gen.init_slot_state(self._slots, self._buf_len)
+        if self._mt is not None:
+            # restore every resident adapter into the pooled view, so
+            # post-recovery multi-tenant decode picks up exactly where the
+            # crashed generation left off (slot assignments included)
+            self._mt.rebuild()
         self._startup_draft()
 
     def _startup_draft(self) -> None:
@@ -705,6 +800,7 @@ class ContinuousBatchingEngine:
             "top_k": np.int32(raw["top_k"]),
             "repetition_penalty": np.float32(raw["repetition_penalty"]),
             "do_sample": np.bool_(raw["do_sample"]),
+            "adapter_idx": np.int32(req.adapter_idx),
         }
 
     def _insert(self, req: Request) -> None:
@@ -733,7 +829,7 @@ class ContinuousBatchingEngine:
         import jax
 
         self._cache, self._state, first = prefill(
-            gen.params, self._cache, self._state, padded, np.int32(plen),
+            self._params, self._cache, self._state, padded, np.int32(plen),
             np.int32(slot), knobs, jax.random.PRNGKey(req.seed),
         )
         first = int(first)  # host sync: the prefill really ran to completion
@@ -782,7 +878,7 @@ class ContinuousBatchingEngine:
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         self._cache, self._state, toks = step(
-            gen.params, self._cache, self._state, self._live.copy()
+            self._params, self._cache, self._state, self._live.copy()
         )
         toks = np.asarray(toks)  # the host sync a wedged link would hang
         self._tick_done(t0)
@@ -864,7 +960,7 @@ class ContinuousBatchingEngine:
         self.faults.maybe_fail_decode(self._decode_index)
         drafts, n_draft = self._propose_drafts()
         self._cache, self._state, toks, n_emit = step(
-            gen.params, self._cache, self._state, self._live.copy(),
+            self._params, self._cache, self._state, self._live.copy(),
             drafts, n_draft,
         )
         toks = np.asarray(toks)  # the host sync a wedged link would hang
@@ -918,6 +1014,8 @@ class ContinuousBatchingEngine:
             return
         self._slot_tokens[slot].append(tok)
         self.stats.incr("tokens_served")
+        if req.adapter is not None:
+            self.stats.tenant_incr(req.adapter, "tokens")
         # latency accounting against the tick clock stamped in _tick_done /
         # the prefill epilogue — no clock read per token. Tokens emitted on
         # the same tick (speculation) land 0 apart, which is the truth: the
@@ -1082,6 +1180,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._cache, self._state = gen.init_paged_state(
             self._slots, self._num_blocks, self._block_len
         )
+        if self._mt is not None:
+            self._mt.rebuild()  # resident adapters survive the crash
         self._startup_draft()
 
     def _serve_loop(self) -> None:
@@ -1289,7 +1389,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 req.prompt[task.next : task.next + C], np.int32
             )[None, :]
             self._cache = ingest(
-                gen.params, self._cache, table, chunk, np.int32(task.next)
+                self._params, self._cache, table, chunk, np.int32(task.next),
+                np.int32(req.adapter_idx),
             )
             # sync before timing: the single device stream serializes this
             # against the next decode dispatch anyway, so blocking here only
@@ -1313,7 +1414,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         seen_row = np.zeros((1, gen.config.vocab_size), bool)
         seen_row[0, np.asarray(req.prompt, np.intp)] = True
         self._cache, self._state, first = final(
-            gen.params, self._cache, self._state, table, padded,
+            self._params, self._cache, self._state, table, padded,
             np.int32(task.next), np.int32(task.plen), seen_row,
             np.int32(task.slot), self._knob_arrays(req),
             jax.random.PRNGKey(req.seed),
@@ -1381,7 +1482,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         self._cache, self._state, toks = step(
-            gen.params, self._cache, self._state, self._live.copy(), tables
+            self._params, self._cache, self._state, self._live.copy(), tables
         )
         toks = np.asarray(toks)
         self._tick_done(t0)
@@ -1410,7 +1511,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         drafts, n_draft = self._propose_drafts()
         step = gen.spec_paged_step(self._slots, nb, self._block_len, self._spec_k)
         self._cache, self._state, toks, n_emit = step(
-            gen.params, self._cache, self._state, self._live.copy(), tables,
+            self._params, self._cache, self._state, self._live.copy(), tables,
             drafts, n_draft,
         )
         toks = np.asarray(toks)

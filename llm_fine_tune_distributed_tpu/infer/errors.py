@@ -116,6 +116,52 @@ class DrainingError(ServingError):
     retryable = True
 
 
+class UnknownAdapterError(ServingError):
+    """The request named an adapter the registry cannot resolve (no such
+    directory under ``--adapter-dir``, or no registry configured at all).
+    Carries the known-adapter list so the 404 body tells the client what IS
+    servable."""
+
+    kind = "unknown_adapter"
+    status = 404
+    retryable = False
+
+    def __init__(
+        self,
+        message: str,
+        known: Optional[Tuple[str, ...]] = None,
+        retry_after_s: Optional[float] = None,
+        generation: Optional[int] = None,
+    ):
+        super().__init__(message, retry_after_s, generation)
+        self.known = tuple(known) if known else ()
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["known_adapters"] = list(self.known)
+        return d
+
+
+class AdapterPoolFullError(ServingError):
+    """Every adapter pool slot is pinned by live requests: the named adapter
+    cannot be hot-loaded right now. Retry when a resident tenant's requests
+    drain (Retry-After from observed service time)."""
+
+    kind = "adapter_pool_full"
+    status = 429
+    retryable = True
+
+
+class TenantQuotaError(ServingError):
+    """The tenant already has its quota of admitted requests in flight;
+    shed at submit with a per-tenant 429 + Retry-After so one tenant cannot
+    monopolize the co-batched decode."""
+
+    kind = "tenant_quota"
+    status = 429
+    retryable = True
+
+
 class NoHealthyReplicaError(ServingError):
     """Every replica in the fleet is terminally dead (circuit open or
     fatal): the front-door router has nowhere to place the request. The

@@ -18,7 +18,10 @@ replica-local event.
 
 **Placement** (infer/routing.py does the scoring): per request the router
 snapshots each replica (health, queue depth, live slots, prompt-prefix
-residency) and picks by policy — prefix-cache affinity first (the replica
+residency, LoRA-adapter residency) and picks by policy — adapter
+residency first when the request names a tenant adapter (a replica
+already holding it skips the disk hot-load and cannot force an eviction
+on a neighbor tenant's pool slot), then prefix-cache affinity (the replica
 already holding the prompt's leading blocks via the EXACT cumulative-token
 keys paged admission matches), ties broken least-loaded, load ties broken
 by rotation. Affinity reads two signals: the replica's actual prefix cache
@@ -98,6 +101,7 @@ class EngineFleet:
 
     ROUTER_COUNTERS = (
         "requests_routed_prefix_affinity",
+        "requests_routed_adapter_affinity",
         "requests_routed_least_loaded",
         "requests_routed_round_robin",
         "requests_failed_over",
@@ -156,7 +160,10 @@ class EngineFleet:
         return n
 
     def _route(
-        self, keys: List[bytes], excluded: frozenset
+        self,
+        keys: List[bytes],
+        excluded: frozenset,
+        adapter: Optional[str] = None,
     ) -> Optional[Placement]:
         """One placement decision: snapshot views, score, commit router
         state (rotation, intent map, counters, log). Commits at DECISION
@@ -178,6 +185,17 @@ class EngineFleet:
                     prefix_hits=max(
                         rep.prefix_match_len(keys) if keys else 0,
                         self._home_run(keys, i),
+                    ),
+                    # multi-tenant LoRA: a replica already holding the
+                    # tenant's adapter skips the hot-load (and cannot evict
+                    # a neighbor tenant's slot) — residency outranks prefix
+                    # affinity in choose_replica
+                    adapter_hits=(
+                        1
+                        if adapter is not None
+                        and getattr(rep, "adapter_resident", None) is not None
+                        and rep.adapter_resident(adapter)
+                        else 0
                     ),
                 )
             )
@@ -262,6 +280,7 @@ class EngineFleet:
         gen: GenerationConfig,
         seed: int,
         timeout: Optional[float],
+        adapter: Optional[str] = None,
     ):
         """Route, call the replica, and fail over until success or the
         candidate set is exhausted. Each replica is tried at most once per
@@ -274,7 +293,7 @@ class EngineFleet:
         overflowed: Dict[int, QueueOverflowError] = {}
         last_err: Optional[BaseException] = None
         while True:
-            placement = self._route(keys, frozenset(excluded))
+            placement = self._route(keys, frozenset(excluded), adapter)
             if placement is None:
                 raise self._exhausted_error(overflowed, last_err)
             replica = self.replicas[placement.index]
@@ -286,10 +305,14 @@ class EngineFleet:
                         f"fleet request not served within {timeout}s "
                         f"({len(excluded)} replica(s) tried)"
                     )
+            # pass the adapter only when the request names one: replicas
+            # without a registry (and the plain stubs the routing tests
+            # drive the fleet with) keep their adapter-free signature
+            kwargs = dict(seed=seed, timeout=remaining)
+            if adapter is not None:
+                kwargs["adapter"] = adapter
             try:
-                return getattr(replica, method)(
-                    prompt_ids, gen, seed=seed, timeout=remaining
-                )
+                return getattr(replica, method)(prompt_ids, gen, **kwargs)
             except QueueOverflowError as e:
                 overflowed[placement.index] = e
                 excluded.add(placement.index)
@@ -308,8 +331,9 @@ class EngineFleet:
         gen: GenerationConfig,
         seed: int = 0,
         timeout: Optional[float] = None,
+        adapter: Optional[str] = None,
     ) -> List[int]:
-        return self.submit_full(prompt_ids, gen, seed, timeout).result
+        return self.submit_full(prompt_ids, gen, seed, timeout, adapter).result
 
     def submit_full(
         self,
@@ -317,9 +341,12 @@ class EngineFleet:
         gen: GenerationConfig,
         seed: int = 0,
         timeout: Optional[float] = None,
+        adapter: Optional[str] = None,
     ):
         """Blocking request with placement + failover (engine parity)."""
-        return self._dispatch("submit_full", prompt_ids, gen, seed, timeout)
+        return self._dispatch(
+            "submit_full", prompt_ids, gen, seed, timeout, adapter
+        )
 
     def stream(
         self,
@@ -327,13 +354,14 @@ class EngineFleet:
         gen: GenerationConfig,
         seed: int = 0,
         timeout: Optional[float] = None,
+        adapter: Optional[str] = None,
     ) -> Iterator[int]:
         """Streaming request. Admission-time rejections (overflow, drain,
         replica terminal) fail over exactly like ``submit``; once the
         iterator is handed out, a mid-stream failure surfaces to the
         caller — tokens may already be with the client, and replaying on a
         sibling would emit them twice."""
-        return self._dispatch("stream", prompt_ids, gen, seed, timeout)
+        return self._dispatch("stream", prompt_ids, gen, seed, timeout, adapter)
 
     def begin_drain(self) -> None:
         for rep in self.replicas:
@@ -451,6 +479,17 @@ class EngineFleet:
             if agg["decode_steps"]
             else 0.0
         )
+        # per-tenant maps merge by summing each tenant's keys across
+        # replicas (a tenant's traffic may land on several replicas)
+        tenants: Dict[str, Dict[str, int]] = {}
+        for s in snaps:
+            for tenant, rec in (s.get("per_tenant") or {}).items():
+                mine = tenants.setdefault(
+                    tenant, {k: 0 for k in ServingStats.TENANT_KEYS}
+                )
+                for k in ServingStats.TENANT_KEYS:
+                    mine[k] += int(rec.get(k, 0))
+        agg["per_tenant"] = tenants
         agg["histograms"] = {
             name: h.summary() for name, h in self.merged_histograms().items()
         }

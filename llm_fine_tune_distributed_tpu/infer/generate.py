@@ -555,6 +555,10 @@ class Generator:
     #   rng  [S, 2] u32  per-slot PRNG key chain, seeded from the REQUEST's
     #                    seed at insert — sampling is deterministic in
     #                    (request, seed) regardless of slot index/co-residents
+    #   adapter_idx [S] i32  pool slot of the request's LoRA adapter
+    #                    (infer/adapters.py; 0 = identity/base model) — the
+    #                    forward batch-gathers each row's low-rank delta, so
+    #                    tenants co-batch in ONE dispatch
     #   + one [S] array per traced sampling knob (sample_token_traced), so
     #     mixed-config traffic co-batches in one compiled step.
     # Liveness stays HOST-side (the engine passes a [S] bool mask): freeing a
@@ -576,6 +580,7 @@ class Generator:
             "top_k": jnp.full((slots,), mc.vocab_size, jnp.int32),
             "repetition_penalty": jnp.ones((slots,), jnp.float32),
             "do_sample": jnp.zeros((slots,), bool),
+            "adapter_idx": jnp.zeros((slots,), jnp.int32),
         }
 
     def init_slot_state(self, slots: int, buf_len: int):
@@ -615,6 +620,7 @@ class Generator:
             hidden, cache = forward(
                 params, last[:, None], mc, cache=cache, cache_pos=pos,
                 compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+                adapter_idx=state["adapter_idx"],
             )
             logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype, mesh=mesh)
             split = jax.vmap(jax.random.split)(state["rng"])  # [S, 2, 2]
@@ -659,6 +665,7 @@ class Generator:
             hidden, small = forward(
                 params, prompt_ids, mc, cache=small, cache_pos=0,
                 compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+                adapter_idx=knobs["adapter_idx"][None],
             )
             lens = prompt_len[None]  # [1]
             last_h = jnp.take_along_axis(
@@ -692,6 +699,7 @@ class Generator:
                     knobs["repetition_penalty"]
                 ),
                 do_sample=state["do_sample"].at[slot].set(knobs["do_sample"]),
+                adapter_idx=state["adapter_idx"].at[slot].set(knobs["adapter_idx"]),
             )
             return cache, state, first[0]
 
@@ -761,7 +769,7 @@ class Generator:
             hidden, pool = forward(
                 params, last[:, None], mc, cache=pool, cache_pos=pos,
                 block_tables=tables, compute_dtype=dtype, output_hidden=True,
-                activation_sharding=act,
+                activation_sharding=act, adapter_idx=state["adapter_idx"],
             )
             logits = unembed(params, hidden[:, -1], mc, compute_dtype=dtype, mesh=mesh)
             split = jax.vmap(jax.random.split)(state["rng"])  # [S, 2, 2]
@@ -813,11 +821,11 @@ class Generator:
         if not final:
 
             @jax.jit
-            def ingest(params, pool, table, chunk_ids, chunk_start):
+            def ingest(params, pool, table, chunk_ids, chunk_start, adapter_idx):
                 _, pool = forward(
                     params, chunk_ids, mc, cache=pool, cache_pos=chunk_start,
                     block_tables=table, compute_dtype=dtype, output_hidden=True,
-                    activation_sharding=act,
+                    activation_sharding=act, adapter_idx=adapter_idx[None],
                 )
                 return pool
 
@@ -831,7 +839,7 @@ class Generator:
             hidden, pool = forward(
                 params, chunk_ids, mc, cache=pool, cache_pos=chunk_start,
                 block_tables=table, compute_dtype=dtype, output_hidden=True,
-                activation_sharding=act,
+                activation_sharding=act, adapter_idx=knobs["adapter_idx"][None],
             )
             idx = prompt_len - 1 - chunk_start  # last prompt token, in-chunk
             last_h = jnp.take_along_axis(
@@ -861,6 +869,7 @@ class Generator:
                     knobs["repetition_penalty"]
                 ),
                 do_sample=state["do_sample"].at[slot].set(knobs["do_sample"]),
+                adapter_idx=state["adapter_idx"].at[slot].set(knobs["adapter_idx"]),
             )
             return pool, state, first[0]
 
@@ -982,6 +991,7 @@ class Generator:
             hidden, cache = forward(
                 params, inputs, mc, cache=cache, cache_pos=pos,
                 compute_dtype=dtype, output_hidden=True, activation_sharding=act,
+                adapter_idx=state["adapter_idx"],
             )
             logits_all = unembed(params, hidden, mc, compute_dtype=dtype, mesh=mesh)
             splits = jax.vmap(lambda r: jax.random.split(r, K + 2))(state["rng"])
@@ -1020,7 +1030,7 @@ class Generator:
             hidden, pool = forward(
                 params, inputs, mc, cache=pool, cache_pos=pos,
                 block_tables=tables, compute_dtype=dtype, output_hidden=True,
-                activation_sharding=act,
+                activation_sharding=act, adapter_idx=state["adapter_idx"],
             )
             logits_all = unembed(params, hidden, mc, compute_dtype=dtype, mesh=mesh)
             splits = jax.vmap(lambda r: jax.random.split(r, K + 2))(state["rng"])

@@ -13,9 +13,12 @@ request stream — deterministic (tests/test_fleet.py pins that):
 - ``choose_replica`` scores a candidate set of ``ReplicaView`` snapshots
   under one of three policies:
 
-  * ``prefix`` — longest resident prompt-prefix run wins (the replica
-    already holding the prompt's leading blocks skips their prefill);
-    zero-hit requests and ties fall through to least-loaded;
+  * ``prefix`` — adapter residency first (a replica already holding the
+    request's LoRA adapter skips the hot-load and cannot force an
+    eviction on a neighbor's pool — the costlier miss), then longest
+    resident prompt-prefix run (the replica already holding the prompt's
+    leading blocks skips their prefill); zero-hit requests and ties fall
+    through to least-loaded;
   * ``least-loaded`` — smallest (queued + decoding) / slots, the same
     queue-depth pressure the admission EWMA's Retry-After is built from;
   * ``round-robin`` — strict rotation over available replicas (baseline).
@@ -68,6 +71,7 @@ class ReplicaView:
     live_slots: int = 0
     slots: int = 1
     prefix_hits: int = 0  # leading full prompt blocks resident on this replica
+    adapter_hits: int = 0  # 1 if the request's adapter is resident here
 
     @property
     def available(self) -> bool:
@@ -86,7 +90,8 @@ class Placement:
     """A routing decision: which replica, and which rule decided."""
 
     index: int
-    reason: str  # "prefix_affinity" | "least_loaded" | "round_robin"
+    # "adapter_affinity" | "prefix_affinity" | "least_loaded" | "round_robin"
+    reason: str
 
 
 def choose_replica(
@@ -112,10 +117,16 @@ def choose_replica(
         return Placement(cands[rr_seq % len(cands)].index, "round_robin")
     reason = "least_loaded"
     if policy == "prefix":
+        if any(v.adapter_hits > 0 for v in cands):
+            # adapter residency outranks prefix residency: an adapter miss
+            # pays a disk hot-load and may evict a neighbor tenant's slot
+            cands = [v for v in cands if v.adapter_hits > 0]
+            reason = "adapter_affinity"
         best_hits = max(v.prefix_hits for v in cands)
         if best_hits > 0:
             cands = [v for v in cands if v.prefix_hits == best_hits]
-            reason = "prefix_affinity"
+            if reason == "least_loaded":
+                reason = "prefix_affinity"
     min_load = min(v.load for v in cands)
     tied = [v for v in cands if v.load == min_load]
     return Placement(tied[rr_seq % len(tied)].index, reason)

@@ -16,6 +16,9 @@ closes that gap with a dependency-free stdlib server exposing:
   POST /v1/generate {"question": .., -> {"answer": ..}
         optional: "max_new_tokens", "temperature", "top_p", "top_k",
                   "repetition_penalty", "greedy", "seed", "system_prompt",
+                  "adapter" (tenant LoRA adapter name under --adapter-dir;
+                  continuous/paged engines — the request's rows gather
+                  that adapter's delta inside the shared batch),
                   "trace" (true -> response carries the request's
                   lifecycle span timeline)}
 
@@ -82,6 +85,9 @@ def serve(
     tp: int = 1,
     draft_dir: Optional[str] = None,
     speculative_k: int = 0,
+    adapter_dir: Optional[str] = None,
+    max_adapters: int = 8,
+    adapter_capacity: int = 0,
     engine_kind: str = "continuous",
     replicas: int = 1,
     routing: str = "prefix",
@@ -149,6 +155,18 @@ def serve(
             "with 'speculative': K — drop --speculative or pick "
             "--engine continuous|paged"
         )
+    if adapter_dir and engine_kind == "window":
+        raise ValueError(
+            "--adapter-dir (multi-tenant LoRA serving) needs a continuous/"
+            "paged engine (per-request adapter deltas are gathered inside "
+            "the fused slot batch, which the window batcher does not run); "
+            "drop --adapter-dir or pick --engine continuous|paged"
+        )
+    if adapter_dir and not os.path.isdir(adapter_dir):
+        raise ValueError(
+            f"--adapter-dir not found: {adapter_dir!r} (expected a "
+            "directory of PEFT-layout adapter subdirectories)"
+        )
     replicas = max(1, int(replicas or 1))
     if routing not in ROUTING_POLICIES:
         raise ValueError(
@@ -207,6 +225,15 @@ def serve(
                 "window engine (per-request 'speculative': K on "
                 "POST /v1/generate still works there)"
             )
+        if adapter_dir:
+            raise ValueError(
+                "--adapter-dir needs a continuous/paged engine, which is "
+                "single-host only; multi-host serving falls back to the "
+                "window engine. Alternatives: serve adapters from a "
+                "single-host deployment, or merge ONE adapter into the "
+                "weights (parallel/lora.merge_lora) and serve that "
+                "checkpoint multi-host"
+            )
     if engine_kind not in ("continuous", "paged", "window"):
         raise ValueError(
             f"unknown engine {engine_kind!r} (expected 'continuous', 'paged' "
@@ -247,12 +274,30 @@ def serve(
                 PagedContinuousBatchingEngine,
             )
 
+            if adapter_dir:
+                from llm_fine_tune_distributed_tpu.infer.adapters import (
+                    AdapterRegistry,
+                )
+
             def _make_replica(i: int):
                 # every replica wraps the SAME generator — params resident
                 # once, jitted programs shared — but owns its own KV pool,
                 # supervisor, and stats. Crash artifacts get per-replica
                 # paths so two replicas' dumps cannot clobber each other.
                 kw = dict(engine_kwargs)
+                if adapter_dir:
+                    # per-replica registry: pool residency is a replica-
+                    # local property (the fleet routes tenants to the
+                    # replica already holding their adapter), and pool
+                    # leaves are value-updated in place — sharing one
+                    # across replicas would let replica A's eviction yank
+                    # a slot replica B is decoding with
+                    kw["adapters"] = AdapterRegistry(
+                        generator.params,
+                        adapter_dir,
+                        max_adapters=max_adapters,
+                    )
+                    kw["adapter_quota"] = adapter_capacity
                 if replicas > 1:
                     if kw.get("flight_dir"):
                         kw["flight_dir"] = os.path.join(
@@ -282,6 +327,11 @@ def serve(
     print(
         f"Model ready (engine={cont_kind}, "
         + (f"replicas={replicas}, routing={routing}, " if replicas > 1 else "")
+        + (
+            f"adapter_dir={adapter_dir}, max_adapters={max_adapters}, "
+            if adapter_dir and cont_engine is not None
+            else ""
+        )
         + f"slots={slots}, max_batch={max_batch}, quantize={quantize})."
     )
 
@@ -443,6 +493,22 @@ def serve(
                         "decode), or /v1/stream without 'speculative' "
                         "(plain streaming)"
                     )
+                adapter = req.get("adapter") or None
+                if adapter is not None and not isinstance(adapter, str):
+                    raise ValueError(
+                        "'adapter' must be a string adapter name"
+                    )
+                if adapter and cont_engine is None:
+                    # window engine (explicit or multi-host fallback) has
+                    # no adapter pool: per-request deltas ride the slot
+                    # batch only
+                    raise ValueError(
+                        "'adapter' needs a continuous/paged engine started "
+                        "with --adapter-dir; this server runs the window "
+                        "engine — supported: requests without 'adapter' "
+                        "(base model), or a server started with "
+                        "--engine continuous|paged --adapter-dir DIR"
+                    )
                 gen_kwargs = {
                     k: cast(req[k])
                     for k, cast in self._FIELD_CASTS.items()
@@ -481,7 +547,11 @@ def serve(
                 # status code + Retry-After instead of an empty SSE body
                 try:
                     token_iter = cont_engine.stream(
-                        prompt_ids, gen, seed=seed, timeout=request_timeout_s
+                        prompt_ids,
+                        gen,
+                        seed=seed,
+                        timeout=request_timeout_s,
+                        adapter=adapter,
                     )
                 except (ServingError, TimeoutError) as e:
                     self._send_error(e)
@@ -587,6 +657,32 @@ def serve(
                     gen_kwargs["speculative_lookup"] = int(req["speculative"])
                 seed = int(req.get("seed", 0))
                 want_trace = bool(req.get("trace", False))
+                adapter = req.get("adapter") or None
+                if adapter is not None and not isinstance(adapter, str):
+                    raise ValueError("'adapter' must be a string adapter name")
+                if adapter and cont_engine is None:
+                    raise ValueError(
+                        "'adapter' needs a continuous/paged engine started "
+                        "with --adapter-dir; this server runs the window "
+                        "engine — supported: requests without 'adapter' "
+                        "(base model), or a server started with "
+                        "--engine continuous|paged --adapter-dir DIR"
+                    )
+                if (
+                    adapter
+                    and gen_kwargs.get("speculative_lookup", 0) > 0
+                    and not speculative_k
+                ):
+                    # a speculative request on a K=0 slot engine falls back
+                    # to the window engine's solo program, which has no
+                    # adapter pool — refuse the combination up front
+                    raise ValueError(
+                        "'adapter' with 'speculative' needs the server "
+                        "started with --speculative K (on a K=0 engine "
+                        "speculative requests fall back to the window "
+                        "engine, which has no adapter pool); drop "
+                        "'speculative' or restart with --speculative K"
+                    )
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"bad request: {e}"})
                 return
@@ -611,7 +707,11 @@ def serve(
                     gen.speculative_lookup == 0 or speculative_k > 0
                 ):
                     pending = cont_engine.submit_full(
-                        prompt_ids, gen, seed=seed, timeout=request_timeout_s
+                        prompt_ids,
+                        gen,
+                        seed=seed,
+                        timeout=request_timeout_s,
+                        adapter=adapter,
                     )
                 else:
                     pending = engine.submit_full(
@@ -777,6 +877,25 @@ def main(argv: Optional[list] = None) -> int:
              "(requires --speculative K)",
     )
     parser.add_argument(
+        "--adapter-dir", default=None,
+        help="continuous/paged engines: directory of PEFT-layout LoRA "
+             "adapter subdirectories for multi-tenant serving — requests "
+             "name one with 'adapter' and co-batch against the shared "
+             "base model (infer/adapters.py)",
+    )
+    parser.add_argument(
+        "--max-adapters", type=int, default=8,
+        help="adapter pool depth: up to N-1 adapters resident at once "
+             "(slot 0 is the reserved identity adapter); idle adapters "
+             "evict LRU, pinned ones never",
+    )
+    parser.add_argument(
+        "--adapter-capacity", type=int, default=0, metavar="N",
+        help="per-tenant admission quota: max in-flight requests per "
+             "adapter name before a tenant-scoped 429 + Retry-After "
+             "(0 = unlimited)",
+    )
+    parser.add_argument(
         "--max-batch", type=int, default=8,
         help="window engine: max concurrent requests grouped into one device "
              "batch (1 = serialize)",
@@ -855,6 +974,8 @@ def main(argv: Optional[list] = None) -> int:
           args.batch_window_ms, args.quantize,
           request_timeout_s=args.request_timeout_s or None, tp=args.tp,
           draft_dir=args.draft_dir, speculative_k=args.speculative,
+          adapter_dir=args.adapter_dir, max_adapters=args.max_adapters,
+          adapter_capacity=args.adapter_capacity,
           engine_kind=args.engine, replicas=args.replicas,
           routing=args.routing, slots=args.slots,
           kv_buf_len=args.kv_buf_len, kv_block_len=args.kv_block_len,

@@ -126,12 +126,22 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def _linear(x, p, compute_dtype, quant_impl: str = "auto"):
+def _linear(x, p, compute_dtype, quant_impl: str = "auto", adapter_idx=None):
     """x @ kernel (+ bias), with optional additive LoRA branch.
 
     LoRA params, when present (parallel/lora.py), live beside the kernel as
     ``lora_a [in, r]`` / ``lora_b [r, out]`` and contribute
     ``(alpha/r) * x @ A @ B`` (external-doc LoRA config: r=16, alpha=8).
+
+    Multi-tenant POOLED adapters (infer/adapters.py) instead store stacked
+    leaves ``lora_a_pool [max_adapters, in, r]`` / ``lora_b_pool
+    [max_adapters, r, out]`` / ``lora_scale_pool [max_adapters]`` beside the
+    kernel, and ``adapter_idx`` ([batch] int32) selects each row's adapter
+    with a batched gather — different tenants co-batch in ONE dispatch.
+    Pool row 0 is the identity adapter (all-zero A and B), so rows with
+    idx 0 contribute an exactly-zero delta and stay bit-identical to the
+    base model. The pool arrays are shape-stable: hot-loading or evicting
+    an adapter is a value update, never a recompile.
 
     NF4-quantized kernels (QLoRA frozen base, ops/nf4.py) replace ``kernel``
     with sibling leaves ``kernel_nf4`` (+ absmax scales); the matmul then
@@ -160,6 +170,15 @@ def _linear(x, p, compute_dtype, quant_impl: str = "auto"):
         a = p["lora_a"].astype(compute_dtype)
         b = p["lora_b"].astype(compute_dtype)
         y = y + (x @ a) @ b * p["lora_scale"].astype(compute_dtype)
+    if adapter_idx is not None and "lora_a_pool" in p:
+        # Batched gather: row i computes with adapter adapter_idx[i]'s A/B.
+        # Mirrors the single-adapter branch's arithmetic ((x @ A) @ B * s)
+        # so a pooled row matches the same adapter served via lora leaves.
+        a = jnp.take(p["lora_a_pool"], adapter_idx, axis=0).astype(compute_dtype)
+        bp = jnp.take(p["lora_b_pool"], adapter_idx, axis=0).astype(compute_dtype)
+        sc = jnp.take(p["lora_scale_pool"], adapter_idx, axis=0).astype(compute_dtype)
+        delta = jnp.einsum("bsr,bro->bso", jnp.einsum("bsi,bir->bsr", x, a), bp)
+        y = y + delta * sc[:, None, None]
     if "bias" in p:
         y = y + p["bias"].astype(compute_dtype)
     return y
@@ -185,6 +204,7 @@ def _block(
     rope_flag=None,
     windowed_mask=None,
     block_tables=None,
+    adapter_idx=None,
 ):
     """One transformer block. Returns (x, new_cache_entry, moe_aux).
 
@@ -204,9 +224,9 @@ def _block(
     attn_p = lp["self_attn"]
 
     hid = rms_norm(x, lp["input_layernorm"]["weight"], eps, zero_centered=zc)
-    q = _linear(hid, attn_p["q_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_heads, d)
-    k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
-    v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl).reshape(b, s, config.num_kv_heads, d)
+    q = _linear(hid, attn_p["q_proj"], compute_dtype, quant_impl, adapter_idx).reshape(b, s, config.num_heads, d)
+    k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl, adapter_idx).reshape(b, s, config.num_kv_heads, d)
+    v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl, adapter_idx).reshape(b, s, config.num_kv_heads, d)
 
     if config.qk_norm:
         # Qwen3: per-head RMSNorm over head_dim, before RoPE (HF Qwen3Attention)
@@ -310,7 +330,7 @@ def _block(
         )
 
     out = out.reshape(b, s, config.num_heads * d)
-    attn_out = _linear(out, attn_p["o_proj"], compute_dtype, quant_impl)
+    attn_out = _linear(out, attn_p["o_proj"], compute_dtype, quant_impl, adapter_idx)
     if config.sandwich_norms:
         # Gemma2: post_attention_layernorm norms the attention OUTPUT
         attn_out = rms_norm(
@@ -349,8 +369,8 @@ def _block(
             )
         x = x + moe_out
     else:
-        gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl)
-        up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype, quant_impl)
+        gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl, adapter_idx)
+        up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype, quant_impl, adapter_idx)
         # Named so remat_policy="mlp" can save JUST this [b, s, f] product: the
         # gate/up matmuls are ~58% of a block's param FLOPs, so saving their
         # fused output avoids most of full-remat's recompute at one tensor per
@@ -362,7 +382,7 @@ def _block(
         else:
             act = jax.nn.silu(gate)
         prod = checkpoint_name(act * up, "mlp_act")
-        mlp_out = _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl)
+        mlp_out = _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl, adapter_idx)
         if config.sandwich_norms:
             mlp_out = rms_norm(
                 mlp_out, lp["post_feedforward_layernorm"]["weight"], eps, zero_centered=zc
@@ -391,6 +411,7 @@ def forward(
     output_hidden: bool = False,
     quant_impl: str = "auto",
     return_aux: bool = False,
+    adapter_idx=None,
 ) -> (
     Tuple[jax.Array, Optional[Dict[str, Any]]]
     | Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]
@@ -412,6 +433,11 @@ def forward(
         all rows, each row's table mapping logical position p to pool cell
         (table[p // block_len], p % block_len). The attention view per row is
         the gathered nb*block_len positions its table exposes.
+      adapter_idx: optional [batch] int32 — per-row slot into the stacked
+        multi-tenant LoRA pools (infer/adapters.py) attached beside target
+        kernels. Row i's projections add adapter adapter_idx[i]'s low-rank
+        delta; index 0 is the identity (zero) adapter. Ignored when the
+        params tree carries no ``lora_*_pool`` leaves.
       remat: rematerialize each block on backward
         (analog of reference ``gradient_checkpointing=True``, training.py:280).
       output_hidden: return the final-norm hidden states [batch, seq, hidden]
@@ -538,6 +564,7 @@ def forward(
             quant_impl=quant_impl,
             windowed_mask=windowed_mask,
             block_tables=block_tables,
+            adapter_idx=adapter_idx,
         )
         if remat and cache is None:
             if remat_policy in (None, "full"):

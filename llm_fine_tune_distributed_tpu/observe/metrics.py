@@ -49,9 +49,15 @@ class ServingStats:
     requests); the snapshot derives ``draft_acceptance_rate`` =
     accepted / proposed and ``mean_tokens_per_step`` = tokens_served /
     decode_steps — the verified-tokens-per-forward number speculation
-    exists to raise above 1.0.
+    exists to raise above 1.0. Multi-tenant LoRA serving adds
+    ``adapter_loads`` (hot-loads from disk), ``adapter_evictions`` (LRU
+    slot reclaims) and ``requests_shed_tenant_quota`` (per-tenant 429s),
+    plus a ``per_tenant`` map in the snapshot — one
+    ``{requests, tokens, queue_depth}`` record per tenant that has ever
+    been admitted (``tenant_incr``).
     Gauges (instantaneous): ``queue_depth``, ``live_slots``,
-    ``engine_generation`` (restart epoch), plus paged
+    ``engine_generation`` (restart epoch), ``adapters_resident``
+    (tenant adapters warm in the pool), plus paged
     ``blocks_in_use`` / ``peak_blocks_in_use`` / ``prefix_cache_blocks``.
     ``slots`` is the engine's capacity and ``total_blocks`` the usable pool
     size; the snapshot derives ``slot_occupancy`` = live_slots / slots —
@@ -68,11 +74,16 @@ class ServingStats:
         "engine_restarts", "requests_failed",
         "requests_shed_overflow", "requests_shed_deadline",
         "draft_tokens_proposed", "draft_tokens_accepted",
+        "adapter_loads", "adapter_evictions", "requests_shed_tenant_quota",
     )
     GAUGES = (
         "queue_depth", "live_slots", "engine_generation",
         "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
+        "adapters_resident",
     )
+    # the per-tenant record's exact key set (pinned by
+    # tests/test_metrics_schema.py so the /v1/stats schema cannot drift)
+    TENANT_KEYS = ("requests", "tokens", "queue_depth")
     # latency/shape histograms owned alongside the counters — fixed log
     # buckets so restart generations and fleet replicas stay mergeable.
     # spec_run_len is the accepted-run length per drafting slot per tick
@@ -89,6 +100,8 @@ class ServingStats:
         self._values: Dict[str, int] = {
             k: 0 for k in self.COUNTERS + self.GAUGES
         }
+        # per-tenant multi-tenant counters: tenant -> {TENANT_KEYS: int}
+        self._tenants: Dict[str, Dict[str, int]] = {}
         self.hist: Dict[str, Histogram] = {
             name: (
                 Histogram.linear(0.0, 16.0, 1.0)
@@ -116,6 +129,27 @@ class ServingStats:
         """Ratcheting gauge: keep the high-water mark (peak pool pressure)."""
         with self._lock:
             self._values[name] = max(self._values[name], int(value))
+
+    def tenant_incr(self, tenant: str, name: str, n: int = 1) -> None:
+        """Bump one tenant's counter (``TENANT_KEYS``). queue_depth is the
+        only key that also decrements (-1 at settle); it is floored at 0 so
+        a double-release can never report negative depth."""
+        with self._lock:
+            rec = self._tenants.setdefault(
+                tenant, {k: 0 for k in self.TENANT_KEYS}
+            )
+            rec[name] = max(rec[name] + n, 0)
+
+    def tenant_merge(self, per_tenant: Dict[str, Dict[str, int]]) -> None:
+        """Fold another snapshot's ``per_tenant`` map into this one (fleet
+        aggregation: replica tenant counts sum)."""
+        with self._lock:
+            for tenant, rec in per_tenant.items():
+                mine = self._tenants.setdefault(
+                    tenant, {k: 0 for k in self.TENANT_KEYS}
+                )
+                for k in self.TENANT_KEYS:
+                    mine[k] += int(rec.get(k, 0))
 
     def observe(self, name: str, value: float) -> None:
         """Record one histogram observation (histograms carry their own
@@ -145,6 +179,9 @@ class ServingStats:
         with self._lock:
             out: Dict[str, Any] = dict(self._values)
             out["tokens_per_s_1m"] = self._tokens_rate(now, out["tokens_served"])
+            out["per_tenant"] = {
+                tenant: dict(rec) for tenant, rec in self._tenants.items()
+            }
         out["uptime_s"] = now - self.started_at
         out["slots"] = self.slots
         out["slot_occupancy"] = (
@@ -188,6 +225,7 @@ def _prom_name(key: str, prefix: str) -> str:
 # this list); the exposition must type them ``counter``, not gauge.
 FLEET_COUNTERS = (
     "requests_routed_prefix_affinity",
+    "requests_routed_adapter_affinity",
     "requests_routed_least_loaded",
     "requests_routed_round_robin",
     "requests_failed_over",
@@ -264,6 +302,24 @@ def prometheus_exposition(
                 rvalue = int(rvalue)
             if isinstance(rvalue, (int, float)):
                 lines.append(f'{name}{{replica="{label}"}} {rvalue:.10g}')
+    # multi-tenant samples: ``per_tenant`` is a dict value (skipped by the
+    # numeric loop above), so its metrics are emitted explicitly with a
+    # ``tenant`` label. TYPE lines are UNCONDITIONAL so the exposition
+    # schema is identical with zero tenants (tests/test_metrics_schema.py).
+    per_tenant = snap.get("per_tenant") or {}
+    for key, kind in (
+        ("requests", "counter"), ("tokens", "counter"),
+        ("queue_depth", "gauge"),
+    ):
+        name = f"{prefix}_tenant_{key}"
+        if kind == "counter":
+            name += "_total"
+        lines.append(f"# TYPE {name} {kind}")
+        for tenant in sorted(per_tenant):
+            lines.append(
+                f'{name}{{tenant="{tenant}"}} '
+                f"{int(per_tenant[tenant].get(key, 0))}"
+            )
     for key in histograms or {}:
         name = _prom_name(key, prefix)
         lines.extend(histograms[key].prometheus_lines(name))

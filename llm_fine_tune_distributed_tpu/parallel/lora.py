@@ -180,12 +180,70 @@ def save_lora_adapter(params: Params, path: str, train: TrainConfig) -> None:
         )
 
 
-def load_lora_adapter(params: Params, path: str, train: TrainConfig = None) -> Params:
-    """Attach adapters from a PEFT directory onto a base params pytree.
+def _kernel_module_names(params: Params) -> set:
+    """Names of every linear module in the model (dicts holding a kernel)."""
+    names = set()
 
-    The scale comes from the directory's own ``adapter_config.json`` (the
-    adapter is self-describing); ``train`` is only a fallback for bare
-    directories without a config file."""
+    def walk(node, name):
+        if not isinstance(node, dict):
+            return
+        if "kernel" in node:
+            names.add(name)
+            return
+        for k, v in node.items():
+            walk(v, k)
+
+    walk(params, "")
+    names.discard("")
+    return names
+
+
+def validate_adapter_config(acfg: dict, params: Params, path: str = "") -> None:
+    """Validate an ``adapter_config.json`` against the model BEFORE any
+    tensor is attached, so a mismatched adapter fails with a ValueError that
+    names the offending field instead of a shape error deep inside the tree
+    merge. Checks: ``r`` (positive int), ``lora_alpha`` (positive number),
+    ``target_modules`` (non-empty, every name a linear module the model
+    actually has)."""
+    where = f" ({path})" if path else ""
+    r = acfg.get("r")
+    if not isinstance(r, int) or isinstance(r, bool) or r < 1:
+        raise ValueError(
+            f"adapter_config.json{where}: field 'r' must be a positive "
+            f"integer, got {r!r}"
+        )
+    alpha = acfg.get("lora_alpha")
+    if not isinstance(alpha, (int, float)) or isinstance(alpha, bool) or alpha <= 0:
+        raise ValueError(
+            f"adapter_config.json{where}: field 'lora_alpha' must be a "
+            f"positive number, got {alpha!r}"
+        )
+    targets = acfg.get("target_modules")
+    if not targets or not isinstance(targets, (list, tuple)):
+        raise ValueError(
+            f"adapter_config.json{where}: field 'target_modules' must be a "
+            f"non-empty list of module names, got {targets!r}"
+        )
+    known = _kernel_module_names(params)
+    unknown = sorted(t for t in targets if t not in known)
+    if unknown:
+        raise ValueError(
+            f"adapter_config.json{where}: field 'target_modules' names "
+            f"modules the model does not have: {unknown} (model linear "
+            f"modules: {sorted(known)})"
+        )
+
+
+def peft_adapter_state(params: Params, path: str, train: TrainConfig = None):
+    """Load AND validate a PEFT adapter directory against ``params``.
+
+    Returns ``(entries, scale, acfg)``: ``entries`` maps each adapted
+    module's tree path (tuple of keys ending at the dict holding
+    ``kernel``) to ``(A [in, r], B [r, out])`` float32 numpy arrays already
+    transposed to JAX kernel layout; ``scale`` is ``alpha / r``. Every
+    tensor's rank and in/out dims are checked against ``acfg`` and the
+    model's kernels here, with errors that name the mismatched field or
+    module — the import path never dies inside the tree merge."""
     import json
     import os
 
@@ -193,28 +251,81 @@ def load_lora_adapter(params: Params, path: str, train: TrainConfig = None) -> P
 
     state = load_file(os.path.join(path, "adapter_model.safetensors"))
     cfg_path = os.path.join(path, "adapter_config.json")
+    acfg = None
     if os.path.exists(cfg_path):
         with open(cfg_path) as f:
             acfg = json.load(f)
-        scale = np.float32(acfg["lora_alpha"] / acfg["r"])
+        validate_adapter_config(acfg, params, path)
+        rank = int(acfg["r"])
+        scale = float(acfg["lora_alpha"]) / rank
     elif train is not None:
-        scale = np.float32(train.lora_alpha / train.lora_rank)
+        rank = int(train.lora_rank)
+        scale = float(train.lora_alpha) / rank
     else:
         raise ValueError(f"{path} has no adapter_config.json and no TrainConfig given")
+
+    entries: Dict[tuple, tuple] = {}
+
+    def walk(node, prefix):
+        if not isinstance(node, dict):
+            return
+        base = "base_model.model." + ".".join(prefix) if prefix else "base_model.model"
+        a_name = f"{base}.lora_A.weight"
+        if "kernel" in node:
+            if a_name not in state:
+                return
+            a_t, b_t = state[a_name], state[f"{base}.lora_B.weight"]
+            module = ".".join(prefix)
+            # torch layout: lora_A.weight [r, in], lora_B.weight [out, r]
+            if a_t.shape[0] != rank or b_t.shape[-1] != rank:
+                raise ValueError(
+                    f"adapter_config.json ({path}): field 'r' = {rank} does "
+                    f"not match the saved tensors for {module} "
+                    f"(lora_A {tuple(a_t.shape)}, lora_B {tuple(b_t.shape)})"
+                )
+            d_in, d_out = node["kernel"].shape
+            if a_t.shape[1] != d_in or b_t.shape[0] != d_out:
+                raise ValueError(
+                    f"adapter ({path}) does not fit the model: {module} has "
+                    f"kernel [in={d_in}, out={d_out}] but the adapter was "
+                    f"trained for [in={a_t.shape[1]}, out={b_t.shape[0]}]"
+                )
+            entries[tuple(prefix)] = (
+                np.ascontiguousarray(a_t.T.astype(np.float32)),
+                np.ascontiguousarray(b_t.T.astype(np.float32)),
+            )
+            return
+        for k, v in node.items():
+            walk(v, prefix + (k,))
+
+    walk(params, ())
+    if not entries:
+        raise ValueError(
+            f"adapter ({path}) matched no module of the model: its tensor "
+            "names do not line up with any kernel path"
+        )
+    return entries, np.float32(scale), acfg
+
+
+def load_lora_adapter(params: Params, path: str, train: TrainConfig = None) -> Params:
+    """Attach adapters from a PEFT directory onto a base params pytree.
+
+    The scale comes from the directory's own ``adapter_config.json`` (the
+    adapter is self-describing); ``train`` is only a fallback for bare
+    directories without a config file. The config and every tensor are
+    validated against the model first (``peft_adapter_state``)."""
+    entries, scale, _ = peft_adapter_state(params, path, train)
 
     def walk(node, prefix):
         if not isinstance(node, dict):
             return node
-        base = f"base_model.model.{prefix}" if prefix else "base_model.model"
-        a_name = f"{base}.lora_A.weight"
-        if "kernel" in node and a_name in state:
+        if tuple(prefix) in entries:
+            a, b = entries[tuple(prefix)]
             out = dict(node)
-            out["lora_a"] = jnp.asarray(np.ascontiguousarray(state[a_name].T))
-            out["lora_b"] = jnp.asarray(
-                np.ascontiguousarray(state[f"{base}.lora_B.weight"].T)
-            )
+            out["lora_a"] = jnp.asarray(a)
+            out["lora_b"] = jnp.asarray(b)
             out["lora_scale"] = jnp.asarray(scale)
             return out
-        return {k: walk(v, f"{prefix}.{k}" if prefix else k) for k, v in node.items()}
+        return {k: walk(v, prefix + [k]) for k, v in node.items()}
 
-    return walk(params, "")
+    return walk(params, [])

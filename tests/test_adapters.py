@@ -1,0 +1,434 @@
+"""Multi-tenant LoRA serving (infer/adapters.py + the pooled-gather branch
+in models/transformer._linear, threaded through infer/engine.py).
+
+Pins the tentpole contracts: slot 0 of the pool is an EXACT identity (base
+rows co-batch bit-identically); each tenant's greedy tokens under
+co-batched decode are bit-identical to serving that tenant's adapter
+MERGED into the weights solo (``merge_lora``), with live neighbors, on
+both slot engines, including speculative ticks; registry lifecycle is
+refcount + LRU with pinned slots never evicted; adapter imports validate
+``adapter_config.json`` against the model with errors naming the field;
+tenant admission quotas shed with a tenant-scoped 429."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_fine_tune_distributed_tpu.config import TrainConfig
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
+from llm_fine_tune_distributed_tpu.infer.adapters import AdapterRegistry
+from llm_fine_tune_distributed_tpu.infer.engine import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
+from llm_fine_tune_distributed_tpu.infer.errors import (
+    AdapterPoolFullError,
+    TenantQuotaError,
+    UnknownAdapterError,
+)
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import forward, init_params
+from llm_fine_tune_distributed_tpu.parallel.lora import (
+    add_lora_params,
+    load_lora_adapter,
+    merge_lora,
+    save_lora_adapter,
+    validate_adapter_config,
+)
+
+CFG = get_preset("tiny")
+GREEDY = GenerationConfig(max_new_tokens=6, do_sample=False)
+SAMPLED = GenerationConfig(max_new_tokens=24, do_sample=True, temperature=1.0)
+
+
+def _make_adapter(base, outdir, seed, rank=4, alpha=8.0):
+    """A PEFT-layout adapter directory with NON-ZERO B (fresh LoRA init has
+    B=0, which would make every tenant's delta trivially identical)."""
+    params = add_lora_params(base, jax.random.PRNGKey(seed), rank=rank, alpha=alpha)
+    counter = [seed]
+
+    def bump(node):
+        if isinstance(node, dict):
+            if "lora_b" in node:
+                node = dict(node)
+                rs = np.random.RandomState(counter[0])
+                counter[0] += 1
+                node["lora_b"] = jnp.asarray(
+                    rs.normal(0.0, 0.02, node["lora_b"].shape), jnp.float32
+                )
+                return node
+            return {k: bump(v) for k, v in node.items()}
+        return node
+
+    params = bump(params)
+    cfg = TrainConfig(freeze_strategy="lora", lora_rank=rank, lora_alpha=alpha)
+    save_lora_adapter(params, outdir, cfg)
+    return params
+
+
+@pytest.fixture(scope="module")
+def base_params():
+    return init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def adapter_dir(base_params, tmp_path_factory):
+    """Three tenants: t1/t2 at rank 4, t3 at rank 2 (exercises pool-rank
+    zero-padding on the same pool)."""
+    root = tmp_path_factory.mktemp("adapters")
+    _make_adapter(base_params, str(root / "t1"), seed=1, rank=4)
+    _make_adapter(base_params, str(root / "t2"), seed=2, rank=4)
+    _make_adapter(base_params, str(root / "t3"), seed=3, rank=2)
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def generator(base_params):
+    return Generator(
+        base_params, CFG, ByteChatMLTokenizer(),
+        compute_dtype=jnp.float32, eos_token_ids=[],
+    )
+
+
+@pytest.fixture(scope="module")
+def merged_refs(base_params, adapter_dir):
+    """Per-tenant merged-weight solo generators — THE baseline co-batched
+    serving must reproduce bit-for-bit."""
+    tok = ByteChatMLTokenizer()
+    out = {}
+    for name in ("t1", "t2", "t3"):
+        merged = merge_lora(
+            load_lora_adapter(base_params, os.path.join(adapter_dir, name))
+        )
+        out[name] = Generator(
+            merged, CFG, tok, compute_dtype=jnp.float32, eos_token_ids=[]
+        )
+    return out
+
+
+def _prompts():
+    tok = ByteChatMLTokenizer()
+    return [tok.encode(t) for t in ("alpha", "beta bravo", "the quick brown fox")]
+
+
+def _ids():
+    return jnp.asarray(
+        np.random.RandomState(1).randint(0, CFG.vocab_size, (2, 16)), jnp.int32
+    )
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_pool_view_shapes_and_identity_slot(base_params, adapter_dir):
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4)
+    q = reg.params["model"]["layers"]["0"]["self_attn"]["q_proj"]
+    assert q["lora_a_pool"].shape == (4, CFG.hidden_size, reg.rank)
+    assert q["lora_b_pool"].shape[0] == 4 and q["lora_b_pool"].shape[1] == reg.rank
+    assert q["lora_scale_pool"].shape == (4,)
+    # pool rank = max rank across the adapters on disk
+    assert reg.rank == 4
+    # slot 0 (identity) produces EXACTLY the base forward — not approximately
+    ids = _ids()
+    ref, _ = forward(base_params, ids, CFG, compute_dtype=jnp.float32)
+    idx0 = jnp.zeros((ids.shape[0],), jnp.int32)
+    out, _ = forward(
+        reg.params, ids, CFG, compute_dtype=jnp.float32, adapter_idx=idx0
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_resident_adapter_matches_merged_forward(base_params, adapter_dir):
+    """A loaded slot's pooled-gather forward equals the merged-weight
+    forward — including the rank-2 adapter zero-padded into the rank-4
+    pool (padding must be an exact no-op on the delta)."""
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4)
+    ids = _ids()
+    for name in ("t1", "t3"):
+        slot = reg.acquire(name)
+        merged = merge_lora(
+            load_lora_adapter(base_params, os.path.join(adapter_dir, name))
+        )
+        ref, _ = forward(merged, ids, CFG, compute_dtype=jnp.float32)
+        idx = jnp.full((ids.shape[0],), slot, jnp.int32)
+        out, _ = forward(
+            reg.params, ids, CFG, compute_dtype=jnp.float32, adapter_idx=idx
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5
+        )
+        # and the delta is non-trivial (the fixture bumped B)
+        base_out, _ = forward(base_params, ids, CFG, compute_dtype=jnp.float32)
+        assert np.abs(np.asarray(out) - np.asarray(base_out)).max() > 1e-4
+        reg.release(name)
+
+
+def test_acquire_release_refcount_and_lru_eviction(base_params, adapter_dir):
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=3)  # 2 slots
+    s1 = reg.acquire("t1")
+    assert s1 != 0 and reg.slot_of("t1") == s1 and reg.refcount("t1") == 1
+    assert reg.acquire("t1") == s1 and reg.refcount("t1") == 2
+    s2 = reg.acquire("t2")
+    assert s2 not in (0, s1)
+    # both pinned: a third tenant cannot load
+    with pytest.raises(AdapterPoolFullError) as ei:
+        reg.acquire("t3")
+    assert ei.value.status == 429
+    # released-but-resident adapters stay warm...
+    reg.release("t2")
+    assert reg.is_resident("t2") and reg.refcount("t2") == 0
+    assert reg.acquire("t2") == s2  # re-acquire hits the warm slot, no load
+    reg.release("t2")
+    # ...and only the IDLE one is evicted when t3 needs a slot (t1 is
+    # still pinned twice)
+    s3 = reg.acquire("t3")
+    assert s3 == s2
+    assert not reg.is_resident("t2")
+    assert reg.is_resident("t1") and reg.refcount("t1") == 2
+    snap_resident = sorted(reg.resident())
+    assert snap_resident == ["t1", "t3"]
+
+
+def test_unknown_adapter_rejected_with_known_list(base_params, adapter_dir):
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4)
+    with pytest.raises(UnknownAdapterError) as ei:
+        reg.acquire("nope")
+    assert ei.value.status == 404
+    assert set(ei.value.known) == {"t1", "t2", "t3"}
+    assert set(ei.value.to_dict()["known_adapters"]) == {"t1", "t2", "t3"}
+    # path traversal is an unknown name, not a filesystem walk
+    with pytest.raises(UnknownAdapterError):
+        reg.acquire(f"..{os.sep}t1")
+
+
+def test_rebuild_restores_resident_slots(base_params, adapter_dir):
+    """The crash-recovery path: after the pools are clobbered (what a
+    fresh-state restart simulates), ``rebuild()`` restores every resident
+    adapter's slot values exactly from the host copies."""
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4)
+    slot = reg.acquire("t1")
+    site = reg.params["model"]["layers"]["0"]["self_attn"]["q_proj"]
+    before = np.asarray(site["lora_a_pool"])
+    assert np.abs(before[slot]).max() > 0
+    for s in reg._sites.values():
+        s["lora_a_pool"] = jnp.zeros_like(s["lora_a_pool"])
+        s["lora_b_pool"] = jnp.zeros_like(s["lora_b_pool"])
+        s["lora_scale_pool"] = jnp.zeros_like(s["lora_scale_pool"])
+    reg.rebuild()
+    np.testing.assert_array_equal(np.asarray(site["lora_a_pool"]), before)
+    assert reg.slot_of("t1") == slot
+
+
+# ------------------------------------------- adapter_config.json validation
+
+
+def _valid_acfg(rank=4):
+    return {
+        "r": rank,
+        "lora_alpha": 8.0,
+        "target_modules": ["q_proj", "v_proj"],
+    }
+
+
+def test_validate_config_names_the_bad_field(base_params):
+    for bad, field in [
+        ({**_valid_acfg(), "r": 0}, "'r'"),
+        ({**_valid_acfg(), "r": "four"}, "'r'"),
+        ({**_valid_acfg(), "lora_alpha": -1}, "'lora_alpha'"),
+        ({**_valid_acfg(), "lora_alpha": None}, "'lora_alpha'"),
+        ({**_valid_acfg(), "target_modules": []}, "'target_modules'"),
+        ({**_valid_acfg(), "target_modules": ["made_up_proj"]}, "'target_modules'"),
+    ]:
+        with pytest.raises(ValueError) as ei:
+            validate_adapter_config(bad, base_params)
+        assert field in str(ei.value), f"{bad} -> {ei.value}"
+    # the unknown-module error lists what the model DOES have
+    with pytest.raises(ValueError, match="q_proj"):
+        validate_adapter_config(
+            {**_valid_acfg(), "target_modules": ["made_up_proj"]}, base_params
+        )
+    validate_adapter_config(_valid_acfg(), base_params)  # sanity: valid passes
+
+
+def test_config_tensor_rank_mismatch_names_r(base_params, adapter_dir, tmp_path):
+    """A config whose 'r' disagrees with the saved tensors fails naming the
+    field, not with a reshape error inside the tree merge."""
+    import shutil
+
+    bad = tmp_path / "bad_r"
+    shutil.copytree(os.path.join(adapter_dir, "t1"), bad)
+    cfg_path = bad / "adapter_config.json"
+    acfg = json.loads(cfg_path.read_text())
+    acfg["r"] = 8  # tensors were saved at rank 4
+    cfg_path.write_text(json.dumps(acfg))
+    with pytest.raises(ValueError, match="'r'"):
+        load_lora_adapter(base_params, str(bad))
+
+
+def test_registry_rejects_adapter_above_pool_rank(base_params, adapter_dir, tmp_path):
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4, rank=2)
+    with pytest.raises(ValueError, match="pool rank"):
+        reg.acquire("t1")  # rank 4 > forced pool rank 2
+
+
+# ------------------------------------------------------- engine integration
+
+
+def _engine(generator, reg, kind, **kw):
+    if kind == "paged":
+        return PagedContinuousBatchingEngine(
+            generator, slots=4, buf_len=96, prompt_bucket=16, block_len=16,
+            prefill_chunk=32, adapters=reg, **kw,
+        )
+    return ContinuousBatchingEngine(
+        generator, slots=4, buf_len=96, prompt_bucket=16, adapters=reg, **kw
+    )
+
+
+@pytest.mark.parametrize("kind", ["continuous", "paged"])
+def test_cobatched_tenants_bit_identical_to_merged_solo(
+    generator, base_params, adapter_dir, merged_refs, kind
+):
+    """THE tentpole guarantee: tenants t1/t2/base co-batched in ONE decode
+    dispatch (plus a live sampled neighbor) each produce exactly the tokens
+    of their adapter merged into the weights and served solo."""
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4)
+    eng = _engine(generator, reg, kind)
+    prompts = _prompts()
+    want = {
+        "t1": merged_refs["t1"].generate_ids(prompts[0], GREEDY),
+        "t2": merged_refs["t2"].generate_ids(prompts[1], GREEDY),
+        "base": generator.generate_ids(prompts[2], GREEDY),
+    }
+    results = {}
+
+    def occupy():  # a live sampled base-model neighbor in the same batch
+        eng.submit(prompts[0], SAMPLED, seed=11, timeout=240)
+
+    def ask(key, prompt, adapter):
+        results[key] = eng.submit(prompt, GREEDY, timeout=240, adapter=adapter)
+
+    occupier = threading.Thread(target=occupy)
+    occupier.start()
+    time.sleep(0.05)
+    threads = [
+        threading.Thread(target=ask, args=("t1", prompts[0], "t1")),
+        threading.Thread(target=ask, args=("t2", prompts[1], "t2")),
+        threading.Thread(target=ask, args=("base", prompts[2], None)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads + [occupier]:
+        t.join(timeout=240)
+    assert results == want
+    # the tenants' outputs are genuinely adapted (differ from base)
+    assert results["t1"] != generator.generate_ids(prompts[0], GREEDY)
+    # pins were released at settle; both adapters stay warm
+    assert reg.refcount("t1") == 0 and reg.refcount("t2") == 0
+    assert sorted(reg.resident()) == ["t1", "t2"]
+    # per-tenant accounting: one request and max_new_tokens tokens each
+    snap = eng.stats_snapshot()
+    for name in ("t1", "t2"):
+        assert snap["per_tenant"][name]["requests"] == 1
+        assert snap["per_tenant"][name]["tokens"] == GREEDY.max_new_tokens
+        assert snap["per_tenant"][name]["queue_depth"] == 0
+    assert snap["adapters_resident"] == 2
+    assert snap["adapter_loads"] == 2
+
+
+@pytest.mark.parametrize("kind", ["continuous", "paged"])
+def test_speculative_cobatch_bit_identical_per_tenant(
+    generator, base_params, adapter_dir, merged_refs, kind
+):
+    """Adapters compose with the fused draft+verify tick: greedy
+    speculative output per tenant equals that tenant's plain merged-solo
+    greedy decode (speculation may change step count, never tokens)."""
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4)
+    eng = _engine(generator, reg, kind, speculative_k=4)
+    tok = ByteChatMLTokenizer()
+    # repetitive prompts so prompt-lookup actually drafts (same trick as
+    # tests/test_engine_speculative.py)
+    prompts = [tok.encode("water water water water water"),
+               tok.encode("abc abc abc abc abc")]
+    cfg = GenerationConfig(
+        max_new_tokens=12, do_sample=False, speculative_lookup=4
+    )
+    plain = GenerationConfig(max_new_tokens=12, do_sample=False)
+    want = {
+        "t1": merged_refs["t1"].generate_ids(prompts[0], plain),
+        "t2": merged_refs["t2"].generate_ids(prompts[1], plain),
+    }
+    results = {}
+
+    def ask(key, prompt, adapter):
+        results[key] = eng.submit(prompt, cfg, timeout=240, adapter=adapter)
+
+    threads = [
+        threading.Thread(target=ask, args=("t1", prompts[0], "t1")),
+        threading.Thread(target=ask, args=("t2", prompts[1], "t2")),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    assert results == want
+
+
+def test_engine_without_registry_rejects_adapter(generator):
+    eng = ContinuousBatchingEngine(
+        generator, slots=2, buf_len=96, prompt_bucket=16
+    )
+    with pytest.raises(UnknownAdapterError, match="--adapter-dir"):
+        eng.submit(_prompts()[0], GREEDY, timeout=30, adapter="t1")
+
+
+def test_unknown_adapter_through_engine(generator, base_params, adapter_dir):
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4)
+    eng = ContinuousBatchingEngine(
+        generator, slots=2, buf_len=96, prompt_bucket=16, adapters=reg
+    )
+    with pytest.raises(UnknownAdapterError) as ei:
+        eng.submit(_prompts()[0], GREEDY, timeout=30, adapter="ghost")
+    assert ei.value.status == 404 and "t1" in ei.value.known
+
+
+def test_tenant_quota_sheds_with_429(generator, base_params, adapter_dir):
+    """--adapter-capacity: tenant t1's second concurrent request is shed
+    with a tenant-scoped retryable 429 while t2 is still admitted; the
+    quota slot frees at settle."""
+    reg = AdapterRegistry(base_params, adapter_dir, max_adapters=4)
+    eng = ContinuousBatchingEngine(
+        generator, slots=4, buf_len=96, prompt_bucket=16,
+        adapters=reg, adapter_quota=1,
+    )
+    prompts = _prompts()
+    long_cfg = GenerationConfig(max_new_tokens=64, do_sample=False)
+    t = threading.Thread(
+        target=lambda: eng.submit(prompts[0], long_cfg, timeout=240, adapter="t1")
+    )
+    t.start()
+    deadline = time.monotonic() + 30
+    while eng.stats_snapshot()["per_tenant"].get("t1", {}).get("requests", 0) < 1:
+        assert time.monotonic() < deadline, "t1 request never admitted"
+        time.sleep(0.01)
+    with pytest.raises(TenantQuotaError) as ei:
+        eng.submit(prompts[1], GREEDY, timeout=30, adapter="t1")
+    assert ei.value.status == 429 and ei.value.retryable
+    assert ei.value.retry_after_s is not None
+    # a DIFFERENT tenant is unaffected by t1's quota
+    assert (
+        eng.submit(prompts[1], GREEDY, timeout=240, adapter="t2") is not None
+    )
+    t.join(timeout=240)
+    # quota slot released at settle: t1 admits again
+    assert eng.submit(prompts[0], GREEDY, timeout=240, adapter="t1") is not None
+    snap = eng.stats_snapshot()
+    assert snap["requests_shed_tenant_quota"] == 1
+    assert snap["per_tenant"]["t1"]["requests"] == 2  # shed one never counted

@@ -513,3 +513,106 @@ def test_fleet_stats_aggregate_math(generator):
         )
     )
     assert total_routed == len(prompts)
+
+
+# ------------------------------------------------- adapter-affinity routing
+
+
+def test_choose_replica_adapter_affinity_outranks_prefix():
+    """A replica holding the request's LoRA adapter wins even against a
+    deeper prompt-prefix run elsewhere (an adapter miss pays a disk
+    hot-load and can evict a neighbor tenant's slot — the costlier miss);
+    prefix depth then breaks ties WITHIN the adapter-resident set."""
+    views = _views(
+        **{"1": {"prefix_hits": 3}, "2": {"adapter_hits": 1}}
+    )
+    p = choose_replica("prefix", views)
+    assert (p.index, p.reason) == (2, "adapter_affinity")
+    # within the adapter-resident set, prefix depth still orders candidates
+    views = _views(
+        **{
+            "0": {"adapter_hits": 1},
+            "1": {"adapter_hits": 1, "prefix_hits": 2},
+            "2": {"prefix_hits": 5},
+        }
+    )
+    p = choose_replica("prefix", views)
+    assert (p.index, p.reason) == (1, "adapter_affinity")
+    # adapter_hits never enter the other policies
+    p = choose_replica("least-loaded", _views(**{"2": {"adapter_hits": 1}}))
+    assert p.reason == "least_loaded"
+
+
+def test_fleet_routes_tenant_back_to_adapter_resident_replica(
+    generator, tmp_path
+):
+    """End-to-end adapter affinity: the tenant's FIRST request hot-loads
+    the adapter on whichever replica wins the load tie; every later
+    request for that tenant routes back to the SAME replica (reason
+    "adapter_affinity"), so one fleet-wide load serves the tenant's whole
+    stream — and the fleet snapshot's per-tenant map shows the merged
+    token count."""
+    from llm_fine_tune_distributed_tpu.config import TrainConfig
+    from llm_fine_tune_distributed_tpu.infer.adapters import AdapterRegistry
+    from llm_fine_tune_distributed_tpu.parallel.lora import (
+        add_lora_params,
+        save_lora_adapter,
+    )
+
+    base = generator.params
+    params = add_lora_params(base, jax.random.PRNGKey(7), rank=4, alpha=8.0)
+
+    def bump(node):
+        if isinstance(node, dict):
+            if "lora_b" in node:
+                node = dict(node)
+                node["lora_b"] = jnp.ones_like(node["lora_b"]) * 0.01
+                return node
+            return {k: bump(v) for k, v in node.items()}
+        return node
+
+    save_lora_adapter(
+        bump(params), str(tmp_path / "acme"),
+        TrainConfig(freeze_strategy="lora", lora_rank=4, lora_alpha=8.0),
+    )
+    fleet = EngineFleet(
+        [
+            PagedContinuousBatchingEngine(
+                generator, slots=4, buf_len=96, prompt_bucket=16,
+                block_len=16, prefill_chunk=32,
+                restart_backoff_s=0.01, restart_backoff_max_s=0.02,
+                adapters=AdapterRegistry(
+                    base, str(tmp_path), max_adapters=4
+                ),
+            )
+            for _ in range(2)
+        ],
+        routing="prefix",
+    )
+    prompts = _prompts()
+    fleet.submit(prompts[0], GREEDY, timeout=240, adapter="acme")
+    home = [
+        i for i, rep in enumerate(fleet.replicas)
+        if rep.adapter_resident("acme")
+    ]
+    assert len(home) == 1  # exactly one replica paid the load
+    for p in prompts[1:]:
+        fleet.submit(p, GREEDY, timeout=240, adapter="acme")
+    # repeats routed home: still one resident copy, affinity counted
+    assert [
+        i for i, rep in enumerate(fleet.replicas)
+        if rep.adapter_resident("acme")
+    ] == home
+    placements = fleet.recent_placements()
+    assert placements[0][1] in ("least_loaded", "prefix_affinity")
+    assert all(r == "adapter_affinity" for _, r in placements[1:])
+    snap = fleet.stats_snapshot()
+    assert snap["requests_routed_adapter_affinity"] == len(prompts) - 1
+    assert snap["per_tenant"]["acme"]["requests"] == len(prompts)
+    assert (
+        snap["per_tenant"]["acme"]["tokens"]
+        == len(prompts) * GREEDY.max_new_tokens
+    )
+    # base-model requests (no adapter) never see adapter affinity
+    fleet.submit(prompts[0], GREEDY, timeout=240)
+    assert fleet.recent_placements()[-1][1] != "adapter_affinity"

@@ -56,9 +56,13 @@ SNAPSHOT_KEYS = {
     "engine_restarts", "requests_failed",
     "requests_shed_overflow", "requests_shed_deadline",
     "draft_tokens_proposed", "draft_tokens_accepted",
+    "adapter_loads", "adapter_evictions", "requests_shed_tenant_quota",
     # gauges
     "queue_depth", "live_slots", "engine_generation",
     "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
+    "adapters_resident",
+    # multi-tenant LoRA: tenant -> {requests, tokens, queue_depth}
+    "per_tenant",
     # derived
     "tokens_per_s_1m", "uptime_s", "slots", "slot_occupancy",
     "prefix_hit_rate", "draft_acceptance_rate", "mean_tokens_per_step",
@@ -107,10 +111,19 @@ EXPECTED_METRICS = {
     ("serving_requests_shed_deadline_total", "counter"),
     ("serving_draft_tokens_proposed_total", "counter"),
     ("serving_draft_tokens_accepted_total", "counter"),
+    ("serving_adapter_loads_total", "counter"),
+    ("serving_adapter_evictions_total", "counter"),
+    ("serving_requests_shed_tenant_quota_total", "counter"),
+    # per-tenant series (tenant="name" labels; TYPE lines are emitted even
+    # with zero tenants so the schema is load-independent)
+    ("serving_tenant_requests_total", "counter"),
+    ("serving_tenant_tokens_total", "counter"),
+    ("serving_tenant_queue_depth", "gauge"),
     # gauges
     ("serving_queue_depth", "gauge"),
     ("serving_live_slots", "gauge"),
     ("serving_engine_generation", "gauge"),
+    ("serving_adapters_resident", "gauge"),
     ("serving_blocks_in_use", "gauge"),
     ("serving_peak_blocks_in_use", "gauge"),
     ("serving_prefix_cache_blocks", "gauge"),
@@ -191,7 +204,8 @@ FLEET_EXTRA_KEYS = {
     "replicas", "routing", "healthy_replicas", "available_replicas",
     "per_replica",
     # router counters (EngineFleet.ROUTER_COUNTERS == metrics.FLEET_COUNTERS)
-    "requests_routed_prefix_affinity", "requests_routed_least_loaded",
+    "requests_routed_prefix_affinity", "requests_routed_adapter_affinity",
+    "requests_routed_least_loaded",
     "requests_routed_round_robin", "requests_failed_over",
     "requests_rerouted_overflow", "requests_shed_fleet_saturated",
 }
@@ -206,6 +220,7 @@ FLEET_EXPECTED_METRICS = EXPECTED_METRICS | {
     ("serving_healthy_replicas", "gauge"),
     ("serving_available_replicas", "gauge"),
     ("serving_requests_routed_prefix_affinity_total", "counter"),
+    ("serving_requests_routed_adapter_affinity_total", "counter"),
     ("serving_requests_routed_least_loaded_total", "counter"),
     ("serving_requests_routed_round_robin_total", "counter"),
     ("serving_requests_failed_over_total", "counter"),
@@ -270,6 +285,49 @@ def test_fleet_metrics_exposition_replica_labels():
     # exactly one TYPE line per metric name (the format forbids repeats)
     names = re.findall(r"^# TYPE (\S+) ", text, re.M)
     assert len(names) == len(set(names))
+
+
+def test_tenant_series_schema_and_labels():
+    """Multi-tenant telemetry: the per-tenant key set is pinned
+    (ServingStats.TENANT_KEYS), tenant samples carry tenant="name" labels,
+    and the TYPE lines exist even with ZERO tenants (schema must not
+    depend on traffic)."""
+    assert ServingStats.TENANT_KEYS == ("requests", "tokens", "queue_depth")
+    engine = _make("paged")
+    # zero tenants: TYPE lines present, no samples
+    snap = {"engine": "paged", **engine.stats_snapshot()}
+    assert snap["per_tenant"] == {}
+    text = prometheus_exposition(snap, engine.stats.hist, memory=FAKE_MEMORY)
+    assert "# TYPE serving_tenant_requests_total counter" in text
+    assert "# TYPE serving_tenant_tokens_total counter" in text
+    assert "# TYPE serving_tenant_queue_depth gauge" in text
+    assert "serving_tenant_requests_total{" not in text
+    # two tenants: labelled samples under the same TYPE lines
+    engine.stats.tenant_incr("acme", "requests")
+    engine.stats.tenant_incr("acme", "tokens", 42)
+    engine.stats.tenant_incr("beta", "requests")
+    snap = {"engine": "paged", **engine.stats_snapshot()}
+    assert set(snap["per_tenant"]) == {"acme", "beta"}
+    assert set(snap["per_tenant"]["acme"]) == set(ServingStats.TENANT_KEYS)
+    text = prometheus_exposition(snap, engine.stats.hist, memory=FAKE_MEMORY)
+    assert 'serving_tenant_requests_total{tenant="acme"} 1' in text
+    assert 'serving_tenant_tokens_total{tenant="acme"} 42' in text
+    assert 'serving_tenant_queue_depth{tenant="acme"} 0' in text
+    assert 'serving_tenant_requests_total{tenant="beta"} 1' in text
+    # tenant_incr floors at zero (double-release guard)
+    engine.stats.tenant_incr("acme", "queue_depth", -5)
+    assert engine.stats_snapshot()["per_tenant"]["acme"]["queue_depth"] == 0
+
+
+def test_fleet_merges_per_tenant_across_replicas():
+    """A tenant's counters sum across the replicas its traffic landed on."""
+    fleet = EngineFleet([_make("paged"), _make("paged")], routing="prefix")
+    fleet.replicas[0].stats.tenant_incr("acme", "tokens", 3)
+    fleet.replicas[1].stats.tenant_incr("acme", "tokens", 4)
+    fleet.replicas[1].stats.tenant_incr("beta", "requests")
+    snap = fleet.stats_snapshot()
+    assert snap["per_tenant"]["acme"]["tokens"] == 7
+    assert snap["per_tenant"]["beta"]["requests"] == 1
 
 
 def test_window_fallback_exposition():

@@ -305,15 +305,23 @@ def test_queue_deadline_sheds_before_prefill(generator):
     # deadline while still queued (same trick as the abandonment test in
     # tests/test_engine.py)
     engine = ContinuousBatchingEngine(
-        generator, slots=1, buf_len=112, prompt_bucket=16, queue_deadline_s=0.05,
+        generator, slots=1, buf_len=112, prompt_bucket=16, queue_deadline_s=0.3,
     )
     long_cfg = GenerationConfig(max_new_tokens=64, do_sample=False)
     occupier = threading.Thread(
         target=lambda: engine.submit(prompts[0], long_cfg, timeout=240)
     )
     occupier.start()
-    time.sleep(0.05)  # the occupier is picked up first; its compile + decode
-    # keep the slot busy far past the waiter's 0.05s queue deadline
+    # wait for the occupier to actually be ADMITTED (not a fixed sleep: under
+    # full-suite load a slow pickup would shed the occupier on its own
+    # deadline and hand the waiter the free slot); its fresh compile + 64
+    # greedy tokens then hold the slot far past the waiter's 0.3s deadline
+    deadline = time.monotonic() + 30
+    while (
+        engine.stats_snapshot()["requests_admitted"] < 1
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.005)
     with pytest.raises(QueueDeadlineError):
         engine.submit(prompts[1], GREEDY, timeout=240)
     occupier.join(timeout=240)
@@ -417,3 +425,111 @@ def test_decode_worker_pokes_watchdog(generator):
     assert wd.pokes >= GREEDY.max_new_tokens
     time.sleep(0.2)  # worker goes idle -> watchdog paused, not poked
     assert wd.pauses >= 1
+
+
+# ------------------------------------------------------ multi-tenant recovery
+
+
+def _mk_tenant_adapter(base_params, outdir, seed):
+    """PEFT adapter dir with a non-zero, seed-distinct B so each tenant's
+    delta is non-trivial and distinguishable."""
+    from llm_fine_tune_distributed_tpu.config import TrainConfig
+    from llm_fine_tune_distributed_tpu.parallel.lora import (
+        add_lora_params,
+        save_lora_adapter,
+    )
+
+    params = add_lora_params(
+        base_params, jax.random.PRNGKey(seed), rank=4, alpha=8.0
+    )
+
+    def bump(node):
+        if isinstance(node, dict):
+            if "lora_b" in node:
+                node = dict(node)
+                node["lora_b"] = jnp.ones_like(node["lora_b"]) * (0.01 * seed)
+                return node
+            return {k: bump(v) for k, v in node.items()}
+        return node
+
+    save_lora_adapter(
+        bump(params), outdir,
+        TrainConfig(freeze_strategy="lora", lora_rank=4, lora_alpha=8.0),
+    )
+
+
+@pytest.mark.parametrize("kind", ["continuous", "paged"])
+def test_multitenant_crash_restores_residents_bit_identical(
+    generator, kind, tmp_path
+):
+    """A crash mid-multi-tenant decode keeps PR-3 recovery semantics AND
+    the adapter pool: in-flight waiters fail retryable, the supervised
+    restart restores the RESIDENT adapter set (``_startup`` ->
+    ``registry.rebuild()``), and post-recovery greedy decode per tenant is
+    bit-identical to that tenant's adapter merged into the weights solo."""
+    from llm_fine_tune_distributed_tpu.infer.adapters import AdapterRegistry
+    from llm_fine_tune_distributed_tpu.parallel.lora import (
+        load_lora_adapter,
+        merge_lora,
+    )
+
+    base = generator.params
+    tok = ByteChatMLTokenizer()
+    for name, seed in (("t1", 1), ("t2", 2)):
+        _mk_tenant_adapter(base, str(tmp_path / name), seed)
+    reg = AdapterRegistry(base, str(tmp_path), max_adapters=4)
+    engine = _make(generator, kind, adapters=reg)
+    prompts = _prompts()
+    merged = {
+        name: Generator(
+            merge_lora(load_lora_adapter(base, str(tmp_path / name))),
+            generator.config, tok,
+            compute_dtype=jnp.float32, eos_token_ids=[],
+        )
+        for name in ("t1", "t2")
+    }
+    solo = {
+        "t1": merged["t1"].generate_ids(prompts[0], GREEDY),
+        "t2": merged["t2"].generate_ids(prompts[1], GREEDY),
+    }
+    # warm both tenants: adapted decode is correct before the chaos
+    assert engine.submit(prompts[0], GREEDY, timeout=240, adapter="t1") == solo["t1"]
+    assert engine.submit(prompts[1], GREEDY, timeout=240, adapter="t2") == solo["t2"]
+    assert sorted(reg.resident()) == ["t1", "t2"]
+
+    engine.faults.fail_decode_next(1)
+    outcomes = [None, None]
+
+    def ask(i, name):
+        try:
+            outcomes[i] = (
+                "ok", engine.submit(prompts[i], GREEDY, timeout=60, adapter=name)
+            )
+        except BaseException as e:  # noqa: BLE001 - recording outcome
+            outcomes[i] = ("err", e)
+
+    threads = [
+        threading.Thread(target=ask, args=(0, "t1")),
+        threading.Thread(target=ask, args=(1, "t2")),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert all(not t.is_alive() for t in threads), "a waiter hung"
+    errs = [o[1] for o in outcomes if o[0] == "err"]
+    assert errs, outcomes
+    assert all(isinstance(e, RetryableEngineError) for e in errs)
+    # every crashed request still released its pin (the single-settle path)
+    assert reg.refcount("t1") == 0 and reg.refcount("t2") == 0
+    # the resident set SURVIVED the restart (rebuild() in _startup)
+    assert sorted(reg.resident()) == ["t1", "t2"]
+
+    # post-recovery: each tenant is bit-identical to its merged-solo run
+    assert engine.submit(prompts[0], GREEDY, timeout=240, adapter="t1") == solo["t1"]
+    assert engine.submit(prompts[1], GREEDY, timeout=240, adapter="t2") == solo["t2"]
+    snap = engine.stats_snapshot()
+    assert snap["engine_restarts"] >= 1
+    assert snap["adapters_resident"] == 2
+    assert snap["per_tenant"]["t1"]["queue_depth"] == 0
+    assert engine.healthy
