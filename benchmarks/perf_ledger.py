@@ -192,21 +192,42 @@ def main():
     del w_unembed, embed_tab, ids, w_norm, pos
     jax.clear_caches()
 
-    # whole step, measured through the bench harness (same recipe)
+    # whole step, measured through the bench harness (same recipe). The
+    # step is ledger-instrumented (observe/xla): AOT compile gives exact
+    # compile seconds plus cost_analysis() FLOPs / bytes-accessed, which
+    # the measured step time turns into roofline utilization gauges — the
+    # measured counterpart of BASELINE.md's cost_analysis() arithmetic.
     import bench
 
+    from llm_fine_tune_distributed_tpu.observe.xla import (
+        CompileLedger,
+        device_peak_specs,
+        instrument,
+        utilization_from_cost,
+    )
+
+    compile_ledger = CompileLedger()
     mesh, state, step_fn, batch, samples = bench.build(
         "smollm3_3b", MB, ACCUM, S, "flash", None
     )
+    step_fn = instrument("train_step", step_fn, compile_ledger)
     for _ in range(2):
         state, metrics = step_fn(state, batch)
     _ = float(metrics["loss"])
+    compile_ledger.mark_warm()
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
         state, metrics = step_fn(state, batch)
         _ = float(metrics["loss"])
     step_s = (time.perf_counter() - t0) / reps
+
+    comp = compile_ledger.snapshot()
+    flops, bytes_acc = compile_ledger.cost_for(("train_step",))
+    peak_flops, peak_bw = device_peak_specs()
+    mfu, bw_util = utilization_from_cost(
+        flops, bytes_acc, step_s, peak_flops, peak_bw
+    )
 
     result = {
         "metric": "perf_ledger",
@@ -216,6 +237,11 @@ def main():
         "step_ms_sum_of_parts": round(parts_ms, 1),
         "fusion_dividend_ms": round(step_s * 1e3 - parts_ms, 1),
         "samples_per_sec_per_chip": round(samples / step_s, 3),
+        "compiles_total": comp["total_compiles"],
+        "compile_seconds_total": comp["total_compile_s"],
+        "recompiles_after_warmup": comp["recompiles_after_warmup"],
+        "model_flops_utilization": round(mfu, 6),
+        "hbm_bandwidth_utilization": round(bw_util, 6),
         "ledger": ledger,
     }
     print(json.dumps(result, indent=2))

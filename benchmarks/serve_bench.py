@@ -58,7 +58,12 @@ retryable decode failure mid-workload and report recovery wall time plus
 TTFT after recovery; SERVE_CHAOS_CLIENTS=8), SERVE_SPEC=1 (speculative arm;
 SERVE_SPEC_K=4, SERVE_SPEC_CLIENTS=16), SERVE_FLEET=1 (fleet arm;
 SERVE_FLEET_CLIENTS=8), SERVE_TENANTS=4 (multi-tenant arm tenant count; 0
-disables; SERVE_TENANT_REQS=8 requests per tenant).
+disables; SERVE_TENANT_REQS=8 requests per tenant), SERVE_COMPILES=1
+(zero-recompile assertion arm: warm the full spec+adapters+paged workload,
+mark the compile ledger warm, re-run it, exit nonzero on ANY post-warmup
+recompile). Every engine-backed JSON line also carries the XLA
+introspection gauges: mfu, hbm_bw_util, compiles_total,
+compile_seconds_total.
 """
 
 import json
@@ -216,18 +221,28 @@ def _latency_fields(lats, engine):
     """Client-side request-latency percentiles plus the engine's OWN view
     (TTFT and inter-token histograms from the per-tick tracer) — the pairing
     that separates queueing delay seen by clients from decode cadence on the
-    device. Window engine has no stats_snapshot; engine fields are omitted."""
+    device — plus the XLA introspection gauges (roofline utilization from
+    cost_analysis x tick cadence, compile-ledger totals). Window engine has
+    no stats_snapshot; engine fields are omitted."""
     out = {}
     vals = sorted(lats)
     out["client_request_p50_ms"] = round(_pctl(vals, 0.50) * 1e3, 2)
     out["client_request_p99_ms"] = round(_pctl(vals, 0.99) * 1e3, 2)
     if hasattr(engine, "stats_snapshot"):
-        hists = engine.stats_snapshot().get("histograms", {})
+        snap = engine.stats_snapshot()
+        hists = snap.get("histograms", {})
         for key, tag in (("ttft_s", "ttft"), ("inter_token_s", "inter_token")):
             h = hists.get(key)
             if h and h.get("count"):
                 out[f"engine_{tag}_p50_ms"] = round(h["p50"] * 1e3, 3)
                 out[f"engine_{tag}_p99_ms"] = round(h["p99"] * 1e3, 3)
+        out["mfu"] = round(snap.get("model_flops_utilization", 0.0), 6)
+        out["hbm_bw_util"] = round(
+            snap.get("hbm_bandwidth_utilization", 0.0), 6
+        )
+        comp = snap.get("compile") or {}
+        out["compiles_total"] = comp.get("total_compiles", 0)
+        out["compile_seconds_total"] = comp.get("total_compile_s", 0.0)
     return out
 
 
@@ -316,6 +331,10 @@ def _chaos_sweep(make_engine, workload, clients, reqs_per_client, base_line):
             "requests_failed": snap["requests_failed"],
             "engine_restarts": snap["engine_restarts"],
             "errors_seen_by_clients": len(errors),
+            "mfu": round(snap.get("model_flops_utilization", 0.0), 6),
+            "hbm_bw_util": round(
+                snap.get("hbm_bandwidth_utilization", 0.0), 6
+            ),
             **base_line,
         }), flush=True)
 
@@ -715,6 +734,10 @@ def main():
             "wall_seconds": round(dt, 2),
             "adapters_resident": snap["adapters_resident"],
             "adapter_loads": snap["adapter_loads"],
+            "mfu": round(snap.get("model_flops_utilization", 0.0), 6),
+            "hbm_bw_util": round(
+                snap.get("hbm_bandwidth_utilization", 0.0), 6
+            ),
             "per_tenant_tokens_verified": tenants_verified,
             "per_tenant_ttft_ms": {
                 n: {
@@ -799,6 +822,94 @@ def main():
                 "clients": chaos_clients,
             },
         )
+
+    # zero-recompile assertion arm: the FULL mixed workload (speculative
+    # decode + two LoRA adapters + paged prefix hits AND misses) runs once
+    # to warm every program, mark_compile_warm() declares steady state, and
+    # an identical second pass must not compile anything — a post-warmup
+    # retrace on the hot path is a latency bug, so the arm exits nonzero.
+    # Fresh Generator: the sweep arms above share one ledger and their
+    # partial warmups would pollute the warm boundary.
+    if os.environ.get("SERVE_COMPILES", "1") == "1":
+        import shutil
+        import tempfile
+
+        from llm_fine_tune_distributed_tpu.config import TrainConfig
+        from llm_fine_tune_distributed_tpu.infer.adapters import AdapterRegistry
+        from llm_fine_tune_distributed_tpu.parallel.lora import (
+            add_lora_params,
+            save_lora_adapter,
+        )
+
+        spec_k = int(os.environ.get("SERVE_SPEC_K", "4"))
+        fresh_gen = Generator(
+            params, mc, ByteChatMLTokenizer(), compute_dtype=dtype,
+            eos_token_ids=[],
+        )
+        adapter_root = tempfile.mkdtemp(prefix="serve_bench_compile_")
+        tenant_names = ("acme", "globex")
+        for i, name in enumerate(tenant_names):
+            save_lora_adapter(
+                add_lora_params(
+                    params, jax.random.PRNGKey(50 + i), rank=8, alpha=16.0
+                ),
+                os.path.join(adapter_root, name),
+                TrainConfig(
+                    freeze_strategy="lora", lora_rank=8, lora_alpha=16.0
+                ),
+            )
+        registry = AdapterRegistry(
+            params, adapter_root, max_adapters=len(tenant_names) + 1
+        )
+        paged_spec = PagedContinuousBatchingEngine(
+            fresh_gen, slots=4, buf_len=256, prompt_bucket=32, block_len=32,
+            prefill_chunk=64, speculative_k=spec_k,
+        )
+        dense_adapters = ContinuousBatchingEngine(
+            fresh_gen, slots=4, buf_len=256, prompt_bucket=32,
+            adapters=registry,
+        )
+        # prefix pool repeats one system prefix (hits after first touch) and
+        # the repetitive pool drives the fused draft/verify step; sequential
+        # submits so both passes see identical shapes in identical order
+        paged_load = (
+            _prefix_workload(np.random.RandomState(5), mc.vocab_size, 8)
+            + _repetitive_workload(
+                np.random.RandomState(6), mc.vocab_size, 8, spec_k, max_new=16
+            )
+        )
+        adapter_load = _tenant_workload(
+            np.random.RandomState(7), mc.vocab_size, 8
+        )
+
+        def _compile_pass():
+            for prompt, gen, seed in paged_load:
+                paged_spec.submit(prompt, gen, seed=seed, timeout=600)
+            for j, (prompt, gen, seed) in enumerate(adapter_load):
+                dense_adapters.submit(
+                    prompt, gen, seed=seed, timeout=600,
+                    adapter=tenant_names[j % len(tenant_names)],
+                )
+
+        _compile_pass()  # warmup: every (program, shapes) compiles here
+        paged_spec.mark_compile_warm()  # shared ledger: one call marks both
+        _compile_pass()  # steady state: must not compile anything new
+        comp = paged_spec.stats_snapshot()["compile"]
+        shutil.rmtree(adapter_root, ignore_errors=True)
+        ok = comp["recompiles_after_warmup"] == 0
+        print(json.dumps({
+            "metric": "serve_zero_recompile_guard",
+            "value": 1 if ok else 0,
+            "unit": "1 = no post-warmup recompiles (spec+adapters+paged)",
+            "recompiles_after_warmup": comp["recompiles_after_warmup"],
+            "compiles_total": comp["total_compiles"],
+            "compile_seconds_total": comp["total_compile_s"],
+            "programs": sorted(comp["programs"]),
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+        if not ok:
+            sys.exit(1)
 
 
 if __name__ == "__main__":

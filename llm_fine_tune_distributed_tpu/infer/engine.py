@@ -113,6 +113,12 @@ from llm_fine_tune_distributed_tpu.observe.tracing import (
     RequestTrace,
     TraceJsonlWriter,
 )
+from llm_fine_tune_distributed_tpu.observe.xla import (
+    CompileLedger,
+    annotate,
+    device_peak_specs,
+    utilization_from_cost,
+)
 from llm_fine_tune_distributed_tpu.runtime.watchdog import StepWatchdog
 
 
@@ -144,6 +150,14 @@ def _prompt_lookup(ctx: np.ndarray, k: int) -> np.ndarray:
 
 class ContinuousBatchingEngine:
     """S-slot persistent decode loop with in-flight FIFO admission."""
+
+    # the fleet passes its request trace through kwargs only to replicas
+    # that declare they accept it (scripted test replicas do not)
+    SUPPORTS_TRACE = True
+    # ledger programs whose cost analysis feeds the utilization gauges
+    # (the per-tick decode dispatch — the program the decode_tick_s
+    # histogram times)
+    DECODE_PROGRAMS = ("slot_step", "spec_slot_step")
 
     def __init__(
         self,
@@ -228,6 +242,16 @@ class ContinuousBatchingEngine:
         # tracing adds no extra clock reads to the token hot path.
         self.recorder = FlightRecorder(flight_capacity)
         self._trace_writer = TraceJsonlWriter(trace_log) if trace_log else None
+        # XLA compile ledger (observe/xla.py): shared with the Generator so
+        # fleet replicas over one Generator count each compilation once.
+        # Stub generators (schema tests) have none — give the engine its own.
+        self.compile_ledger = (
+            getattr(generator, "compile_ledger", None) or CompileLedger()
+        )
+        # a compilation AFTER mark_compile_warm() is a steady-state retrace
+        # — always a bug; put it on the flight-recorder timeline so the next
+        # crash/circuit dump carries the evidence
+        self.compile_ledger.add_listener(self._on_recompile)
         self._req_seq = itertools.count(1)
         self._now = time.monotonic()
         # wedged-device escape hatch (runtime/watchdog.py): poked per decode
@@ -273,9 +297,12 @@ class ContinuousBatchingEngine:
         seed: int = 0,
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
     ) -> List[int]:
         """Blocking: enqueue one request, wait for its full token list."""
-        return self.submit_full(prompt_ids, gen, seed, timeout, adapter).result
+        return self.submit_full(
+            prompt_ids, gen, seed, timeout, adapter, trace=trace
+        ).result
 
     def submit_full(
         self,
@@ -284,12 +311,17 @@ class ContinuousBatchingEngine:
         seed: int = 0,
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
     ) -> Request:
         """``submit`` returning the whole request record (window-engine
         parity, so the server can swap engines behind one call shape).
         ``adapter`` names the tenant's LoRA adapter (AdapterRegistry slot);
-        None serves the base model."""
-        req = self._make_request(prompt_ids, gen, seed, adapter=adapter)
+        None serves the base model. ``trace`` is a caller-owned
+        RequestTrace (the fleet's cross-replica timeline) this engine
+        adopts instead of opening its own."""
+        req = self._make_request(
+            prompt_ids, gen, seed, adapter=adapter, trace=trace
+        )
         self._q.put(req)
         if not req.done.wait(timeout):
             req.abandoned = True  # the worker sheds it un-decoded
@@ -308,6 +340,7 @@ class ContinuousBatchingEngine:
         seed: int = 0,
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
     ) -> Iterator[int]:
         """Yield the request's tokens one at a time AS THEY DECODE, while the
         request shares the slot batch with everything else in flight — the
@@ -319,7 +352,8 @@ class ContinuousBatchingEngine:
         iteration, so the server can return a real status code before
         committing to an SSE response."""
         req = self._make_request(
-            prompt_ids, gen, seed, tokens_q=queue.Queue(), adapter=adapter
+            prompt_ids, gen, seed, tokens_q=queue.Queue(), adapter=adapter,
+            trace=trace,
         )
         self._q.put(req)
 
@@ -438,7 +472,47 @@ class ContinuousBatchingEngine:
         snap = self.stats.snapshot()
         snap["circuit_state"] = self.circuit_state
         snap["draining"] = self._draining
+        snap["compile"] = self.compile_ledger.snapshot()
+        mfu, bw = self._utilization()
+        snap["model_flops_utilization"] = mfu
+        snap["hbm_bandwidth_utilization"] = bw
         return snap
+
+    def _utilization(self) -> "tuple[float, float]":
+        """(MFU, HBM-bandwidth utilization) of the steady-state decode tick:
+        the ledger's cost analysis for the resident decode program over the
+        mean observed ``decode_tick_s``, against the device roofline. Both
+        are 0.0 until a tick has been timed or when cost/peaks are unknown
+        (CPU tests, stub generators)."""
+        hist = self.stats.hist.get("decode_tick_s")
+        total = int(getattr(hist, "total", 0) or 0) if hist is not None else 0
+        if total <= 0:
+            return 0.0, 0.0
+        mean_tick_s = float(hist.sum) / total
+        flops, nbytes = self.compile_ledger.cost_for(self.DECODE_PROGRAMS)
+        peak_flops, peak_bw = device_peak_specs()
+        return utilization_from_cost(
+            flops, nbytes, mean_tick_s, peak_flops, peak_bw
+        )
+
+    def mark_compile_warm(self) -> None:
+        """Declare jit warmup over: from here on, every compilation the
+        ledger sees counts as ``recompiles_after_warmup`` — a steady-state
+        retrace, which on the hot path is always a bug."""
+        self.compile_ledger.mark_warm()
+
+    def _on_recompile(
+        self, program: str, shapes: str, compile_s: float, generation: int
+    ) -> None:
+        """Compile-ledger listener: a post-warmup compilation goes on the
+        flight-recorder timeline so the next dump carries the evidence."""
+        self.recorder.record(
+            "recompile",
+            program=program,
+            shapes=shapes,
+            compile_s=round(compile_s, 4),
+            generation=generation,
+        )
 
     # ------------------------------------------------------------- admission
 
@@ -460,6 +534,7 @@ class ContinuousBatchingEngine:
         seed: int,
         tokens_q: Optional["queue.Queue"] = None,
         adapter: Optional[str] = None,
+        trace: Optional[RequestTrace] = None,
     ) -> Request:
         """Admission gate, shared by submit and stream: reject terminal /
         draining / overflow states BEFORE the request enters the queue, and
@@ -521,7 +596,14 @@ class ContinuousBatchingEngine:
             self.stats.tenant_incr(adapter, "queue_depth")
         req.id = next(self._req_seq)
         req.enqueued_at = time.monotonic()
-        req.trace = RequestTrace(req.id, t0=req.enqueued_at)
+        if trace is not None:
+            # adopt the fleet's cross-replica timeline: every span this
+            # engine marks lands in the SAME record as the router decision
+            # and any prior failed hop, under one propagated trace id
+            req.trace = trace
+            trace.request_id = req.id
+        else:
+            req.trace = RequestTrace(req.id, t0=req.enqueued_at)
         req.trace.mark("received", req.enqueued_at)
         if self._queue_deadline_s is not None:
             req.queue_deadline = req.enqueued_at + self._queue_deadline_s
@@ -625,6 +707,8 @@ class ContinuousBatchingEngine:
         on the Generator and the jitted programs are cached there, so this
         is an allocation + a couple of dispatches — not a recompilation."""
         gen = self._generator
+        # ledger entries compiled from here on attribute to this incarnation
+        self.compile_ledger.current_generation = self.supervisor.generation
         self._cache, self._state = gen.init_slot_state(self._slots, self._buf_len)
         if self._mt is not None:
             # restore every resident adapter into the pooled view, so
@@ -703,7 +787,8 @@ class ContinuousBatchingEngine:
             # dump AFTER recording the restart so the artifact holds the
             # whole transition: pre-crash ticks -> crash -> restart
             dump = sup.dump_flight(
-                self.recorder, "crash_restart", error=str(cause)
+                self.recorder, "crash_restart", error=str(cause),
+                compile_ledger=self.compile_ledger,
             )
             print(
                 f"[engine] recovered from {type(cause).__name__} — "
@@ -729,7 +814,10 @@ class ContinuousBatchingEngine:
         self._terminal = err  # set BEFORE resolving, so waiters see it
         reason = "circuit_open" if sup.circuit_open else "fatal"
         self.recorder.record(reason, error=str(err))
-        dump = sup.dump_flight(self.recorder, reason, error=str(cause))
+        dump = sup.dump_flight(
+            self.recorder, reason, error=str(cause),
+            compile_ledger=self.compile_ledger,
+        )
         self._fail_inflight(err)
         self._fail_queued(err)
         if self._watchdog is not None:
@@ -762,12 +850,13 @@ class ContinuousBatchingEngine:
 
     def _admit(self) -> None:
         """Refill free slots from the queue head — strict FIFO, any config."""
-        while self._live.sum() < self._slots:
-            try:
-                req = self._q.get_nowait()
-            except queue.Empty:
-                return
-            self._handle_new(req)
+        with annotate("admit"):
+            while self._live.sum() < self._slots:
+                try:
+                    req = self._q.get_nowait()
+                except queue.Empty:
+                    return
+                self._handle_new(req)
 
     def _handle_new(self, req: Request) -> None:
         if req.abandoned:
@@ -828,11 +917,12 @@ class ContinuousBatchingEngine:
         knobs = self._knob_arrays(req)
         import jax
 
-        self._cache, self._state, first = prefill(
-            self._params, self._cache, self._state, padded, np.int32(plen),
-            np.int32(slot), knobs, jax.random.PRNGKey(req.seed),
-        )
-        first = int(first)  # host sync: the prefill really ran to completion
+        with annotate("prefill"):
+            self._cache, self._state, first = prefill(
+                self._params, self._cache, self._state, padded, np.int32(plen),
+                np.int32(slot), knobs, jax.random.PRNGKey(req.seed),
+            )
+            first = int(first)  # host sync: the prefill really ran to completion
         self._now = time.monotonic()
         self.stats.observe("prefill_chunk_s", self._now - t0)
         if req.trace is not None:
@@ -877,10 +967,11 @@ class ContinuousBatchingEngine:
         t0 = time.monotonic()
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
-        self._cache, self._state, toks = step(
-            self._params, self._cache, self._state, self._live.copy()
-        )
-        toks = np.asarray(toks)  # the host sync a wedged link would hang
+        with annotate("sample"):
+            self._cache, self._state, toks = step(
+                self._params, self._cache, self._state, self._live.copy()
+            )
+            toks = np.asarray(toks)  # the host sync a wedged link would hang
         self._tick_done(t0)
         for slot in range(self._slots):
             req = self._slot_req[slot]
@@ -935,10 +1026,12 @@ class ContinuousBatchingEngine:
             if n_draft.any():
                 gen = self._generator
                 dstep = gen.draft_slot_step(self._slots, k)
-                self._dcache, dbuf = dstep(
-                    gen.draft_params, self._dcache, self._state, window, start
-                )
-                drafts = np.asarray(dbuf).astype(np.int32)
+                with annotate("draft"):
+                    self._dcache, dbuf = dstep(
+                        gen.draft_params, self._dcache, self._state, window,
+                        start,
+                    )
+                    drafts = np.asarray(dbuf).astype(np.int32)
             return drafts, n_draft
         for slot in range(self._slots):
             want = self._spec_want(slot)
@@ -959,12 +1052,13 @@ class ContinuousBatchingEngine:
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         drafts, n_draft = self._propose_drafts()
-        self._cache, self._state, toks, n_emit = step(
-            self._params, self._cache, self._state, self._live.copy(),
-            drafts, n_draft,
-        )
-        toks = np.asarray(toks)  # the host sync a wedged link would hang
-        n_emit = np.asarray(n_emit)
+        with annotate("verify"):
+            self._cache, self._state, toks, n_emit = step(
+                self._params, self._cache, self._state, self._live.copy(),
+                drafts, n_draft,
+            )
+            toks = np.asarray(toks)  # the host sync a wedged link would hang
+            n_emit = np.asarray(n_emit)
         self._tick_done(t0)
         self._emit_spec(toks, n_emit, n_draft)
 
@@ -1116,6 +1210,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     admit into the fresh pool.
     """
 
+    # utilization gauges read the paged per-tick decode programs
+    DECODE_PROGRAMS = ("paged_step", "spec_paged_step")
+
     def __init__(
         self,
         generator,
@@ -1172,6 +1269,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         tables, and a new device-side paged cache. Queued/waiting requests
         are untouched — they re-plan against the fresh pool at admission."""
         gen = self._generator
+        self.compile_ledger.current_generation = self.supervisor.generation
         self._allocator = BlockAllocator(self._num_blocks)
         self._prefix = PrefixCache(self._allocator, self._block_len)
         self._table[:, :] = NULL_BLOCK
@@ -1388,15 +1486,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             chunk = np.asarray(
                 req.prompt[task.next : task.next + C], np.int32
             )[None, :]
-            self._cache = ingest(
-                self._params, self._cache, table, chunk, np.int32(task.next),
-                np.int32(req.adapter_idx),
-            )
-            # sync before timing: the single device stream serializes this
-            # against the next decode dispatch anyway, so blocking here only
-            # moves the wait — it does not add one — and it makes the chunk
-            # histogram measure device time, not dispatch time
-            jax.block_until_ready(self._cache)
+            with annotate("prefill"):
+                self._cache = ingest(
+                    self._params, self._cache, table, chunk,
+                    np.int32(task.next), np.int32(req.adapter_idx),
+                )
+                # sync before timing: the single device stream serializes
+                # this against the next decode dispatch anyway, so blocking
+                # here only moves the wait — it does not add one — and it
+                # makes the chunk histogram measure device time, not
+                # dispatch time
+                jax.block_until_ready(self._cache)
             task.next += C
             self.stats.incr("prefill_chunks")
             self.stats.observe("prefill_chunk_s", time.monotonic() - t0)
@@ -1413,13 +1513,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         padded[0, :remaining] = req.prompt[task.next :]
         seen_row = np.zeros((1, gen.config.vocab_size), bool)
         seen_row[0, np.asarray(req.prompt, np.intp)] = True
-        self._cache, self._state, first = final(
-            self._params, self._cache, self._state, table, padded,
-            np.int32(task.next), np.int32(task.plen), seen_row,
-            np.int32(task.slot), self._knob_arrays(req),
-            jax.random.PRNGKey(req.seed),
-        )
-        first = int(first)  # host sync: the final chunk really landed
+        with annotate("prefill"):
+            self._cache, self._state, first = final(
+                self._params, self._cache, self._state, table, padded,
+                np.int32(task.next), np.int32(task.plen), seen_row,
+                np.int32(task.slot), self._knob_arrays(req),
+                jax.random.PRNGKey(req.seed),
+            )
+            first = int(first)  # host sync: the final chunk really landed
         self._now = time.monotonic()
         self._prefills.pop(0)
         self.stats.incr("prefill_chunks")
@@ -1481,10 +1582,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         t0 = time.monotonic()
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
-        self._cache, self._state, toks = step(
-            self._params, self._cache, self._state, self._live.copy(), tables
-        )
-        toks = np.asarray(toks)
+        with annotate("sample"):
+            self._cache, self._state, toks = step(
+                self._params, self._cache, self._state, self._live.copy(),
+                tables,
+            )
+            toks = np.asarray(toks)
         self._tick_done(t0)
         self.stats.gauge_max("peak_blocks_in_use", self._allocator.used_count)
         for slot in range(self._slots):
@@ -1510,12 +1613,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.faults.maybe_fail_decode(self._decode_index)
         drafts, n_draft = self._propose_drafts()
         step = gen.spec_paged_step(self._slots, nb, self._block_len, self._spec_k)
-        self._cache, self._state, toks, n_emit = step(
-            self._params, self._cache, self._state, self._live.copy(), tables,
-            drafts, n_draft,
-        )
-        toks = np.asarray(toks)
-        n_emit = np.asarray(n_emit)
+        with annotate("verify"):
+            self._cache, self._state, toks, n_emit = step(
+                self._params, self._cache, self._state, self._live.copy(),
+                tables, drafts, n_draft,
+            )
+            toks = np.asarray(toks)
+            n_emit = np.asarray(n_emit)
         self._tick_done(t0)
         self.stats.gauge_max("peak_blocks_in_use", self._allocator.used_count)
         self._emit_spec(toks, n_emit, n_draft)

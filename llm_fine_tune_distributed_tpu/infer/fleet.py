@@ -82,7 +82,8 @@ from llm_fine_tune_distributed_tpu.infer.routing import (
 )
 from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
 from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
-from llm_fine_tune_distributed_tpu.observe.tracing import Histogram
+from llm_fine_tune_distributed_tpu.observe.tracing import Histogram, RequestTrace
+from llm_fine_tune_distributed_tpu.observe.xla import CompileLedger
 
 # Replica failures that do not implicate the request: the fleet re-places
 # the request on a sibling instead of surfacing them. (QueueOverflowError
@@ -284,11 +285,17 @@ class EngineFleet:
     ):
         """Route, call the replica, and fail over until success or the
         candidate set is exhausted. Each replica is tried at most once per
-        request; ``timeout`` spans ALL attempts."""
+        request; ``timeout`` spans ALL attempts.
+
+        The fleet mints ONE RequestTrace up front and every hop adopts it
+        (replicas that declare ``SUPPORTS_TRACE``), so the router decision,
+        each failed hop, and the completing replica's lifecycle all land in
+        one timeline under one propagated trace id."""
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
         keys = self._keys(prompt_ids)
+        trace = RequestTrace()
         excluded: set = set()
         overflowed: Dict[int, QueueOverflowError] = {}
         last_err: Optional[BaseException] = None
@@ -296,6 +303,11 @@ class EngineFleet:
             placement = self._route(keys, frozenset(excluded), adapter)
             if placement is None:
                 raise self._exhausted_error(overflowed, last_err)
+            trace.mark(
+                f"router_decision replica={placement.index} "
+                f"policy={self.routing} reason={placement.reason} "
+                f"score={placement.score:g}"
+            )
             replica = self.replicas[placement.index]
             remaining: Optional[float] = None
             if deadline is not None:
@@ -311,16 +323,25 @@ class EngineFleet:
             kwargs = dict(seed=seed, timeout=remaining)
             if adapter is not None:
                 kwargs["adapter"] = adapter
+            # same opt-in shape for the trace: scripted test replicas keep
+            # their bare submit signature, real engines adopt the timeline
+            if getattr(replica, "SUPPORTS_TRACE", False):
+                kwargs["trace"] = trace
             try:
                 return getattr(replica, method)(prompt_ids, gen, **kwargs)
             except QueueOverflowError as e:
                 overflowed[placement.index] = e
                 excluded.add(placement.index)
                 last_err = e
+                trace.mark(f"reroute_overflow replica={placement.index}")
                 self._count("requests_rerouted_overflow")
             except _FAILOVER_ERRORS as e:
                 excluded.add(placement.index)
                 last_err = e
+                trace.mark(
+                    f"failover replica={placement.index} "
+                    f"error={type(e).__name__}"
+                )
                 self._count("requests_failed_over")
 
     # ------------------------------------------------------- engine surface
@@ -362,6 +383,13 @@ class EngineFleet:
         caller — tokens may already be with the client, and replaying on a
         sibling would emit them twice."""
         return self._dispatch("stream", prompt_ids, gen, seed, timeout, adapter)
+
+    def mark_compile_warm(self) -> None:
+        """Fan warmup-over out to every replica's compile ledger."""
+        for rep in self.replicas:
+            mark = getattr(rep, "mark_compile_warm", None)
+            if mark is not None:
+                mark()
 
     def begin_drain(self) -> None:
         for rep in self.replicas:
@@ -493,6 +521,21 @@ class EngineFleet:
         agg["histograms"] = {
             name: h.summary() for name, h in self.merged_histograms().items()
         }
+        # compile ledgers dedup by identity: replicas over one shared
+        # Generator share one ledger, so a shared compilation counts once
+        agg["compile"] = CompileLedger.merge(
+            getattr(rep, "compile_ledger", None) for rep in self.replicas
+        )
+        # utilization is per-device, not additive — the fleet-level gauge
+        # reports the busiest replica (stub replicas report nothing)
+        agg["model_flops_utilization"] = max(
+            (s.get("model_flops_utilization", 0.0) for s in snaps),
+            default=0.0,
+        )
+        agg["hbm_bandwidth_utilization"] = max(
+            (s.get("hbm_bandwidth_utilization", 0.0) for s in snaps),
+            default=0.0,
+        )
         agg["circuit_state"] = self.circuit_state
         agg["draining"] = self.draining
         agg["replicas"] = len(self.replicas)

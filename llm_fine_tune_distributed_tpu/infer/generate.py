@@ -33,6 +33,7 @@ from llm_fine_tune_distributed_tpu.models.transformer import (
     insert_cache_row,
     unembed,
 )
+from llm_fine_tune_distributed_tpu.observe.xla import CompileLedger, instrument
 
 _PROMPT_BUCKET = 256
 
@@ -152,6 +153,10 @@ class Generator:
             eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
         self.eos_token_ids = tuple(int(e) for e in eos)
         self._jit_cache = {}
+        # every jitted program this Generator dispatches registers its
+        # compilations here (observe/xla.py); engines sharing the Generator
+        # share the ledger, so a fleet's shared jit cache is counted once
+        self.compile_ledger = CompileLedger()
         # sequential-forward count + draft acceptance rate of the last
         # speculative run (telemetry; None when the last call took the plain
         # batch path). The per-row arrays attribute each LIVE row's own
@@ -588,18 +593,33 @@ class Generator:
         cache = init_cache(self.config, slots, buf_len, dtype=self.compute_dtype)
         return cache, self._fresh_slot_state(slots)
 
+    def _instrument(self, key, fn, aot: bool = True):
+        """Ledger-wrap a freshly built program: ``key`` is the jit-cache
+        key, whose head is the program name and whose tail is the shape
+        bucket — exactly the dedup signature the ledger wants. aot=True
+        (engine hot paths, array-only call sites) compiles ahead-of-time
+        for exact compile seconds + cost analysis; aot=False (call sites
+        passing python scalars / donated buffers) times the first call."""
+        return instrument(
+            key[0], fn, self.compile_ledger, shapes=str(key[1:]), aot=aot
+        )
+
     def slot_step(self, slots: int, buf_len: int):
         """Jitted one-token decode step for ALL slots (cached per shape)."""
         key = ("slot_step", slots, buf_len)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_slot_step(slots, buf_len)
+            self._jit_cache[key] = self._instrument(
+                key, self._build_slot_step(slots, buf_len)
+            )
         return self._jit_cache[key]
 
     def slot_prefill(self, bucket: int, buf_len: int):
         """Jitted prefill-insert (cached per prompt bucket)."""
         key = ("slot_prefill", bucket, buf_len)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_slot_prefill(bucket, buf_len)
+            self._jit_cache[key] = self._instrument(
+                key, self._build_slot_prefill(bucket, buf_len)
+            )
         return self._jit_cache[key]
 
     def _build_slot_step(self, slots: int, buf_len: int):
@@ -728,15 +748,17 @@ class Generator:
         """Jitted one-token paged decode step (cached per table width)."""
         key = ("paged_step", slots, nb, block_len)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_paged_step(slots, nb, block_len)
+            self._jit_cache[key] = self._instrument(
+                key, self._build_paged_step(slots, nb, block_len)
+            )
         return self._jit_cache[key]
 
     def paged_prefill_chunk(self, chunk: int, nb: int, block_len: int):
         """Jitted ingest-only prefill chunk (all but a prompt's last chunk)."""
         key = ("paged_chunk", chunk, nb, block_len)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_paged_prefill(
-                chunk, nb, block_len, final=False
+            self._jit_cache[key] = self._instrument(
+                key, self._build_paged_prefill(chunk, nb, block_len, final=False)
             )
         return self._jit_cache[key]
 
@@ -745,8 +767,8 @@ class Generator:
         state scatter (cached per pad bucket)."""
         key = ("paged_final", bucket, nb, block_len)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_paged_prefill(
-                bucket, nb, block_len, final=True
+            self._jit_cache[key] = self._instrument(
+                key, self._build_paged_prefill(bucket, nb, block_len, final=True)
             )
         return self._jit_cache[key]
 
@@ -906,15 +928,17 @@ class Generator:
         """Jitted fused draft-verify step, dense cache (cached per shape)."""
         key = ("spec_slot_step", slots, buf_len, k)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_spec_slot_step(slots, buf_len, k)
+            self._jit_cache[key] = self._instrument(
+                key, self._build_spec_slot_step(slots, buf_len, k)
+            )
         return self._jit_cache[key]
 
     def spec_paged_step(self, slots: int, nb: int, block_len: int, k: int):
         """Jitted fused draft-verify step, paged pool (cached per table width)."""
         key = ("spec_paged_step", slots, nb, block_len, k)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_spec_paged_step(
-                slots, nb, block_len, k
+            self._jit_cache[key] = self._instrument(
+                key, self._build_spec_paged_step(slots, nb, block_len, k)
             )
         return self._jit_cache[key]
 
@@ -1071,14 +1095,18 @@ class Generator:
         """Jitted draft-cache prompt ingest + row insert (cached per bucket)."""
         key = ("draft_slot_prefill", bucket)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_draft_slot_prefill(bucket)
+            self._jit_cache[key] = self._instrument(
+                key, self._build_draft_slot_prefill(bucket)
+            )
         return self._jit_cache[key]
 
     def draft_slot_step(self, slots: int, K: int):
         """Jitted per-tick K-token draft proposal (cached per shape)."""
         key = ("draft_slot_step", slots, K)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_draft_slot_step(slots, K)
+            self._jit_cache[key] = self._instrument(
+                key, self._build_draft_slot_step(slots, K)
+            )
         return self._jit_cache[key]
 
     def _build_draft_slot_prefill(self, bucket: int):
@@ -1190,7 +1218,14 @@ class Generator:
         bucket = -(-len(prompt) // _PROMPT_BUCKET) * _PROMPT_BUCKET
         key = ("stream", bucket, gen, chunk)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_stream(bucket, gen, chunk)
+            s_prefill, s_decode = self._build_stream(bucket, gen, chunk)
+            sig = str(key[1:])
+            self._jit_cache[key] = (
+                instrument("stream_prefill", s_prefill, self.compile_ledger,
+                           shapes=sig, aot=False),
+                instrument("stream_decode", s_decode, self.compile_ledger,
+                           shapes=sig, aot=False),
+            )
         prefill, decode_chunk = self._jit_cache[key]
 
         padded = np.zeros((1, bucket), np.int32)
@@ -1252,13 +1287,19 @@ class Generator:
         if speculate:
             key = ("specd" if with_draft else "spec", len(prompts), bucket, gen)
             if key not in self._jit_cache:
-                self._jit_cache[key] = self._build_spec(
-                    len(prompts), bucket, gen, with_draft=with_draft
+                self._jit_cache[key] = self._instrument(
+                    key,
+                    self._build_spec(
+                        len(prompts), bucket, gen, with_draft=with_draft
+                    ),
+                    aot=False,
                 )
         else:
             key = ("batch", len(prompts), bucket, gen)
             if key not in self._jit_cache:
-                self._jit_cache[key] = self._build_batch(len(prompts), bucket, gen)
+                self._jit_cache[key] = self._instrument(
+                    key, self._build_batch(len(prompts), bucket, gen), aot=False
+                )
         run = self._jit_cache[key]
 
         padded = np.zeros((len(prompts), bucket), np.int32)

@@ -87,11 +87,19 @@ class ReplicaView:
 
 @dataclass(frozen=True)
 class Placement:
-    """A routing decision: which replica, and which rule decided."""
+    """A routing decision: which replica, which rule decided, and the
+    winning rule's score — what the fleet stamps into the request trace's
+    router span."""
 
     index: int
     # "adapter_affinity" | "prefix_affinity" | "least_loaded" | "round_robin"
     reason: str
+    # affinity strength under the deciding rule: resident prefix blocks
+    # (prefix_affinity), adapter residency 0/1 (adapter_affinity),
+    # negative load (least_loaded — higher is still better), 0 for
+    # round-robin. Deterministic in (policy, views, rr_seq) like the rest
+    # of the decision.
+    score: float = 0.0
 
 
 def choose_replica(
@@ -129,4 +137,11 @@ def choose_replica(
                 reason = "prefix_affinity"
     min_load = min(v.load for v in cands)
     tied = [v for v in cands if v.load == min_load]
-    return Placement(tied[rr_seq % len(tied)].index, reason)
+    chosen = tied[rr_seq % len(tied)]
+    if reason == "prefix_affinity":
+        score = float(chosen.prefix_hits)
+    elif reason == "adapter_affinity":
+        score = float(chosen.adapter_hits)
+    else:
+        score = -min_load
+    return Placement(chosen.index, reason, score)

@@ -105,6 +105,7 @@ def serve(
     watchdog_timeout_s: float = 0.0,
     flight_dir: Optional[str] = "outputs/flight_recorder",
     trace_log: Optional[str] = None,
+    profile_dir: Optional[str] = None,
     control: Optional[dict] = None,
 ) -> None:
     """``control``, when given, is populated with the drain entry points
@@ -133,6 +134,10 @@ def serve(
         prometheus_exposition,
     )
     from llm_fine_tune_distributed_tpu.observe.profiler import device_memory_report
+    from llm_fine_tune_distributed_tpu.observe.xla import (
+        CaptureBusyError,
+        ProfilerCapture,
+    )
     from llm_fine_tune_distributed_tpu.ops.int8 import QUANTIZE_MODES, maybe_quantize
 
     if quantize not in QUANTIZE_MODES:  # fail fast, before the model load
@@ -323,6 +328,21 @@ def serve(
             else:
                 cont_engine = _make_replica(0)
             cont_kind = engine_kind
+    # on-demand profiler capture (POST /v1/profile): one per server process
+    # (jax.profiler traces are process-wide). Captures go on the engine's
+    # flight-recorder timeline so they line up with crashes and restarts.
+    profiler_capture = None
+    if profile_dir:
+        if isinstance(cont_engine, EngineFleet):
+            capture_recorder = cont_engine.replicas[0].recorder
+        elif cont_engine is not None:
+            capture_recorder = cont_engine.recorder
+        else:
+            capture_recorder = None
+        profiler_capture = ProfilerCapture(
+            profile_dir,
+            on_event=capture_recorder.record if capture_recorder else None,
+        )
     drain_state = {"draining": False}
     print(
         f"Model ready (engine={cont_kind}, "
@@ -610,7 +630,7 @@ def serve(
 
         def do_POST(self):  # noqa: N802
             if drain_state["draining"] and self.path in (
-                "/v1/generate", "/v1/stream"
+                "/v1/generate", "/v1/stream", "/v1/profile"
             ):
                 # admission is closed server-wide during drain; in-flight
                 # work keeps running until done or --drain-timeout-s
@@ -618,6 +638,35 @@ def serve(
                     "server draining; retry against another replica",
                     retry_after_s=float(drain_timeout_s),
                 ))
+                return
+            if self.path == "/v1/profile":
+                # on-demand jax.profiler capture: starts a bounded trace to
+                # a fresh subdirectory of --profile-dir and auto-stops.
+                # 409 while a capture is already running (one at a time).
+                if profiler_capture is None:
+                    self._send(404, {
+                        "error": "profiling disabled; start the server "
+                                 "with --profile-dir",
+                    })
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(req, dict):
+                        raise TypeError("body must be a JSON object")
+                    duration_s = float(req.get("duration_s", 3.0))
+                    trace_dir = profiler_capture.start(duration_s)
+                except CaptureBusyError as e:
+                    self._send(409, {"error": str(e)})
+                    return
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+                    return
+                self._send(200, {
+                    "profiling": True,
+                    "trace_dir": trace_dir,
+                    "duration_s": duration_s,
+                })
                 return
             if self.path == "/v1/stream":
                 try:
@@ -801,6 +850,7 @@ def serve(
         control["httpd"] = httpd
         control["cont_engine"] = cont_engine
         control["window_engine"] = engine
+        control["profiler"] = profiler_capture
 
     print(f"Serving on {host}:{port}")
     try:
@@ -964,7 +1014,14 @@ def main(argv: Optional[list] = None) -> int:
     parser.add_argument(
         "--trace-log", default=None,
         help="JSONL file appending every settled request's lifecycle trace "
-             "(span + request-relative time). Off by default",
+             "(span + request-relative time + propagated trace id). Off by "
+             "default",
+    )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="enable POST /v1/profile: on-demand jax.profiler captures "
+             "written to fresh subdirectories of this path (view with "
+             "tensorboard --logdir). Off by default",
     )
     args = parser.parse_args(argv)
     if not os.path.isdir(args.model_dir):
@@ -989,7 +1046,8 @@ def main(argv: Optional[list] = None) -> int:
           circuit_window_s=args.circuit_window_s,
           watchdog_timeout_s=args.watchdog_timeout_s,
           flight_dir=args.flight_dir or None,
-          trace_log=args.trace_log)
+          trace_log=args.trace_log,
+          profile_dir=args.profile_dir)
     return 0
 
 

@@ -98,13 +98,22 @@ class EngineSupervisor:
     def failure_count(self) -> int:
         return len(self._failures)
 
-    def dump_flight(self, recorder, reason: str, error: Optional[str] = None) -> Optional[str]:
+    def dump_flight(
+        self,
+        recorder,
+        reason: str,
+        error: Optional[str] = None,
+        compile_ledger=None,
+    ) -> Optional[str]:
         """Serialize the engine's flight recorder to a JSON artifact.
 
         Called on the worker thread at the moments worth a post-mortem —
         after a crash's restart transition has been recorded, and when the
         circuit opens or a fatal error kills the worker. Returns the
         artifact path, or ``None`` when no ``flight_dir`` is configured.
+        ``compile_ledger`` (observe/xla.CompileLedger) adds a ``compile``
+        section — per-program compile counts and the post-warmup recompile
+        counter — so retrace churn around a crash is in the artifact.
         Dump failures are swallowed: the recorder must never take down a
         recovery that would otherwise succeed.
         """
@@ -126,6 +135,8 @@ class EngineSupervisor:
                 "dumped_at_unix": time.time(),
                 "events": recorder.events(),
             }
+            if compile_ledger is not None:
+                payload["compile"] = compile_ledger.snapshot()
             with open(path, "w") as f:
                 json.dump(payload, f, indent=2)
             return path

@@ -320,6 +320,32 @@ def prometheus_exposition(
                 f'{name}{{tenant="{tenant}"}} '
                 f"{int(per_tenant[tenant].get(key, 0))}"
             )
+    # compile-ledger samples: ``compile`` is a nested dict (skipped by the
+    # numeric loop), so per-program compile counts/seconds are emitted
+    # explicitly with a ``program`` label. TYPE lines are UNCONDITIONAL so
+    # the exposition schema is identical with an empty ledger — or with
+    # snapshots that have no ``compile`` key at all (window-engine
+    # fallback).
+    compile_snap = snap.get("compile") or {}
+    programs = compile_snap.get("programs") or {}
+    name = f"{prefix}_compiles_total"
+    lines.append(f"# TYPE {name} counter")
+    for prog in sorted(programs):
+        lines.append(
+            f'{name}{{program="{prog}"}} {int(programs[prog]["compiles"])}'
+        )
+    name = f"{prefix}_compile_seconds_total"
+    lines.append(f"# TYPE {name} counter")
+    for prog in sorted(programs):
+        lines.append(
+            f'{name}{{program="{prog}"}} '
+            f'{float(programs[prog]["compile_s"]):.10g}'
+        )
+    name = f"{prefix}_recompiles_after_warmup_total"
+    lines.append(f"# TYPE {name} counter")
+    lines.append(
+        f"{name} {int(compile_snap.get('recompiles_after_warmup', 0))}"
+    )
     for key in histograms or {}:
         name = _prom_name(key, prefix)
         lines.extend(histograms[key].prometheus_lines(name))

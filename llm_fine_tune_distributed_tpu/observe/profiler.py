@@ -14,25 +14,45 @@ xprof. Host 0 only; tracing other hosts adds nothing for SPMD programs.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
 
 from llm_fine_tune_distributed_tpu.runtime.distributed import is_primary_host
 
+logger = logging.getLogger("llm_fine_tune_distributed_tpu.observe.profiler")
+
 
 class StepProfiler:
     """Trace steps [start, start+count) of the training loop.
 
     Skips the first steps by default so compilation and warmup don't pollute
-    the trace (first-step compile dominates otherwise).
+    the trace (first-step compile dominates otherwise). ``recorder`` (an
+    observe/tracing.FlightRecorder) gets a ``profile_start`` /
+    ``profile_stop`` event per transition, so captures appear on the same
+    timeline as crashes and restarts.
     """
 
-    def __init__(self, profile_dir: Optional[str], start_step: int = 3, num_steps: int = 3):
+    def __init__(
+        self,
+        profile_dir: Optional[str],
+        start_step: int = 3,
+        num_steps: int = 3,
+        recorder=None,
+    ):
         self.dir = profile_dir if (profile_dir and is_primary_host()) else None
         self.start = start_step
         self.stop_at = start_step + num_steps
         self._active = False
+        self._recorder = recorder
+
+    def _record(self, kind: str, **fields) -> None:
+        if self._recorder is not None:
+            try:
+                self._recorder.record(kind, **fields)
+            except Exception:
+                pass  # telemetry must never take down the train loop
 
     def step(self, step: int) -> None:
         """Call once per optimizer step (after the step completes)."""
@@ -41,16 +61,21 @@ class StepProfiler:
         if not self._active and step == self.start:
             jax.profiler.start_trace(self.dir)
             self._active = True
+            self._record("profile_start", dir=self.dir, step=step)
         elif self._active and step >= self.stop_at:
             jax.profiler.stop_trace()
             self._active = False
-            print(f"[profiler] trace for steps [{self.start},{self.stop_at}) "
-                  f"written to {self.dir}")
+            self._record("profile_stop", dir=self.dir, step=step)
+            logger.info(
+                "trace for steps [%d,%d) written to %s",
+                self.start, self.stop_at, self.dir,
+            )
 
     def close(self) -> None:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._record("profile_stop", dir=self.dir, step=-1)
 
 
 def device_memory_report() -> dict:

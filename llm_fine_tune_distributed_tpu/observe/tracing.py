@@ -28,6 +28,7 @@ import math
 import os
 import threading
 import time
+import uuid
 from bisect import bisect_left
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -175,12 +176,23 @@ class RequestTrace:
     everything else). ``list.append`` of a ready tuple is GIL-atomic, so
     the hot path takes no lock; ``to_dict`` is only called after the
     request settled (or by the owner of the request record).
+
+    ``trace_id`` is the PROPAGATED identity: the fleet front door mints
+    one trace, stamps its routing decision and failover hops into it, and
+    every engine hop adopts the same object — so a request that reroutes
+    or resettles shows all hops under one id in one JSONL record.
     """
 
-    __slots__ = ("request_id", "t0", "events")
+    __slots__ = ("request_id", "trace_id", "t0", "events")
 
-    def __init__(self, request_id: int = 0, t0: Optional[float] = None):
+    def __init__(
+        self,
+        request_id: int = 0,
+        t0: Optional[float] = None,
+        trace_id: Optional[str] = None,
+    ):
         self.request_id = request_id
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
         self.t0 = time.monotonic() if t0 is None else t0
         self.events: List[Tuple[str, float]] = []
 
@@ -191,6 +203,7 @@ class RequestTrace:
         events = list(self.events)
         out = {
             "request_id": self.request_id,
+            "trace_id": self.trace_id,
             "events": [
                 {"span": span, "t_s": round(t - self.t0, 6)} for span, t in events
             ],

@@ -44,6 +44,7 @@ from llm_fine_tune_distributed_tpu.models.transformer import init_params
 from llm_fine_tune_distributed_tpu.observe.metrics import MetricLogger
 from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter
 from llm_fine_tune_distributed_tpu.observe.tracing import Histogram
+from llm_fine_tune_distributed_tpu.observe.xla import CompileLedger, instrument
 from llm_fine_tune_distributed_tpu.parallel.freeze import describe_trainable, trainable_mask
 from llm_fine_tune_distributed_tpu.parallel.optimizer import build_lr_schedule, build_optimizer
 from llm_fine_tune_distributed_tpu.parallel.sharding import param_spec
@@ -584,17 +585,29 @@ class SFTTrainer:
 
     def _prepare_steps(self) -> None:
         act = self._make_shardings()
+        # Every jitted entry point registers with the compile ledger so a
+        # shape drift mid-run (a loader emitting an off-bucket batch, an
+        # eval slab reshaped) shows up as recompiles_after_warmup in the
+        # step logs instead of an unexplained stall. aot=False: train_step
+        # donates its state, so an AOT re-execute of the first call is
+        # forbidden — first-call wall timing only.
+        self.compile_ledger = CompileLedger()
         if self._pipe_size > 1:
             from llm_fine_tune_distributed_tpu.parallel.pipeline import (
                 build_pipeline_eval_step,
                 build_pipeline_train_step,
             )
 
-            self.train_step = jit_train_step(
-                build_pipeline_train_step(
-                    self.model_config, self.config, self.optimizer, self.mesh,
-                    self._layer_vec,
-                )
+            self.train_step = instrument(
+                "train_step",
+                jit_train_step(
+                    build_pipeline_train_step(
+                        self.model_config, self.config, self.optimizer,
+                        self.mesh, self._layer_vec,
+                    )
+                ),
+                self.compile_ledger,
+                aot=False,
             )
             self._eval_step_fn = build_pipeline_eval_step(
                 self.model_config, self.config, self.mesh
@@ -605,12 +618,18 @@ class SFTTrainer:
                 self.model_config, self.config, self.optimizer,
                 activation_sharding=act, quant_impl=quant_impl,
             )
-            self.train_step = jit_train_step(train_step)
+            self.train_step = instrument(
+                "train_step", jit_train_step(train_step),
+                self.compile_ledger, aot=False,
+            )
             self._eval_step_fn = build_eval_step(
                 self.model_config, self.config, activation_sharding=act,
                 quant_impl=quant_impl,
             )
-        self.eval_step = jax.jit(self._eval_step_fn)
+        self.eval_step = instrument(
+            "eval_step", jax.jit(self._eval_step_fn),
+            self.compile_ledger, aot=False,
+        )
 
         def eval_all(state, staged):
             """Summed eval-step outputs over every staged eval batch in ONE
@@ -629,7 +648,9 @@ class SFTTrainer:
             sums, _ = jax.lax.scan(body, init, staged)
             return sums
 
-        self._eval_all = jax.jit(eval_all)
+        self._eval_all = instrument(
+            "eval_all", jax.jit(eval_all), self.compile_ledger, aot=False,
+        )
         self._staged_eval = None
 
     def _device_batch(
@@ -1080,6 +1101,22 @@ class SFTTrainer:
                             if psum["count"]:
                                 logs[f"phase_{pname}_p50_s"] = round(psum["p50"], 6)
                                 logs[f"phase_{pname}_p99_s"] = round(psum["p99"], 6)
+                        # compile ledger totals: total_compiles should go
+                        # flat after the first eval boundary; a nonzero
+                        # recompiles_after_warmup means a shape drifted
+                        # mid-run (off-bucket batch, reshaped eval slab)
+                        csnap = self.compile_ledger.snapshot()
+                        logs["compile_total"] = csnap["total_compiles"]
+                        logs["compile_s_total"] = csnap["total_compile_s"]
+                        logs["recompiles_after_warmup"] = csnap[
+                            "recompiles_after_warmup"
+                        ]
+                        if not self.compile_ledger.warmed and (
+                            do_eval or not (cfg.eval_steps and self.n_val > 0)
+                        ):
+                            # warm boundary: the first eval has compiled the
+                            # eval programs too (or no eval will ever run)
+                            self.compile_ledger.mark_warm()
                         if is_primary_host():
                             mem = device_memory_report()
                             if mem:

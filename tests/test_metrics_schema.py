@@ -69,6 +69,9 @@ SNAPSHOT_KEYS = {
     "histograms",
     # supervision (engine.stats_snapshot)
     "circuit_state", "draining",
+    # XLA introspection (engine.stats_snapshot): the compile-ledger
+    # sub-snapshot and the roofline utilization gauges
+    "compile", "model_flops_utilization", "hbm_bandwidth_utilization",
 }
 PAGED_ONLY_KEYS = {
     "total_blocks", "block_pool_occupancy", "peak_block_pool_occupancy",
@@ -138,6 +141,14 @@ EXPECTED_METRICS = {
     ("serving_draft_acceptance_rate", "gauge"),
     ("serving_mean_tokens_per_step", "gauge"),
     ("serving_draining", "gauge"),
+    # XLA introspection: per-program compile counters (program="..."
+    # labels; TYPE lines emitted even with an empty ledger) + roofline
+    # utilization gauges
+    ("serving_compiles_total", "counter"),
+    ("serving_compile_seconds_total", "counter"),
+    ("serving_recompiles_after_warmup_total", "counter"),
+    ("serving_model_flops_utilization", "gauge"),
+    ("serving_hbm_bandwidth_utilization", "gauge"),
     # histograms (trailing _s -> _seconds; spec_run_len is unitless)
     ("serving_ttft_seconds", "histogram"),
     ("serving_inter_token_seconds", "histogram"),

@@ -3,6 +3,7 @@ model dir — the serving capability the reference only templates
 (examples/openshift-deploy.yaml, SURVEY.md C21)."""
 
 import json
+import os
 import socket
 import threading
 import time
@@ -107,6 +108,64 @@ def test_unknown_path_404(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(f"{server}/nope", timeout=10)
     assert e.value.code == 404
+
+
+def test_profile_disabled_404(server):
+    """Without --profile-dir the endpoint doesn't exist."""
+    req = urllib.request.Request(f"{server}/v1/profile", data=b"{}")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 404
+
+
+@pytest.mark.slow
+def test_profile_capture_endpoint(model_dir, tmp_path):
+    """POST /v1/profile starts a bounded jax.profiler capture: one at a
+    time (409 while running), 400 on a bad duration, auto-stop frees the
+    next capture into a FRESH subdirectory, and stopped captures leave a
+    non-empty trace dir (the artifact tensorboard loads). slow: a second
+    server startup plus real wall-clock captures; the ProfilerCapture
+    unit tests cover the same semantics in tier-1."""
+    base = _start_server(model_dir, profile_dir=str(tmp_path / "profiles"))
+
+    def post(body):
+        req = urllib.request.Request(
+            f"{base}/v1/profile", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    first = post({"duration_s": 2.0})
+    assert first["profiling"] is True
+    trace_dir = first["trace_dir"]
+    assert os.path.isdir(trace_dir)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post({"duration_s": 1.0})  # one capture at a time
+    assert e.value.code == 409
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post({"duration_s": -3})
+    assert e.value.code == 400
+    # the timer auto-stops the first capture; the next start then succeeds
+    second = None
+    deadline = time.time() + 60
+    while second is None and time.time() < deadline:
+        try:
+            second = post({"duration_s": 0.2})
+        except urllib.error.HTTPError as err:
+            assert err.code == 409
+            time.sleep(0.25)
+    assert second is not None and second["trace_dir"] != trace_dir
+
+    def has_files(d):
+        return any(files for _, _, files in os.walk(d))
+
+    deadline = time.time() + 60
+    while time.time() < deadline and not (
+        has_files(trace_dir) and has_files(second["trace_dir"])
+    ):
+        time.sleep(0.25)
+    assert has_files(trace_dir) and has_files(second["trace_dir"])
 
 
 @pytest.mark.slow
