@@ -61,7 +61,11 @@ SERVE_FLEET_CLIENTS=8), SERVE_TENANTS=4 (multi-tenant arm tenant count; 0
 disables; SERVE_TENANT_REQS=8 requests per tenant), SERVE_COMPILES=1
 (zero-recompile assertion arm: warm the full spec+adapters+paged workload,
 mark the compile ledger warm, re-run it, exit nonzero on ANY post-warmup
-recompile). Every engine-backed JSON line also carries the XLA
+recompile), SERVE_HOTSWAP=1 (hot-swap arm: publish a perturbed checkpoint
+while SERVE_HOTSWAP_CLIENTS=16 clients hammer a paged engine, deploy it
+mid-run via HotSwapManager, exit nonzero on any dropped request or any
+post-warmup recompile; SERVE_HOTSWAP_REQS_PER_CLIENT=4). Every
+engine-backed JSON line also carries the XLA
 introspection gauges: mfu, hbm_bw_util, compiles_total,
 compile_seconds_total.
 """
@@ -905,6 +909,101 @@ def main():
             "compiles_total": comp["total_compiles"],
             "compile_seconds_total": comp["total_compile_s"],
             "programs": sorted(comp["programs"]),
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+        if not ok:
+            sys.exit(1)
+
+    # hot-swap arm: a perturbed checkpoint publishes while clients hammer a
+    # paged engine, and HotSwapManager deploys it mid-run. The acceptance
+    # bar from the live-deployment ISSUE: no request errors across the swap
+    # and no compiles beyond the warmup pass (the swap re-points weights but
+    # never changes shapes, so every jit cache stays warm).
+    if os.environ.get("SERVE_HOTSWAP", "1") == "1":
+        import shutil
+        import tempfile
+
+        from llm_fine_tune_distributed_tpu.infer.deploy import (
+            CheckpointWatcher,
+            HotSwapManager,
+        )
+        from llm_fine_tune_distributed_tpu.train.checkpoints import (
+            frozen_fingerprint,
+        )
+        from llm_fine_tune_distributed_tpu.train.publish import (
+            CheckpointPublisher,
+        )
+        from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+        hs_clients = int(os.environ.get("SERVE_HOTSWAP_CLIENTS", "16"))
+        hs_reqs = int(os.environ.get("SERVE_HOTSWAP_REQS_PER_CLIENT", "4"))
+        hs_gen = Generator(  # fresh generator: isolated compile ledger
+            params, mc, ByteChatMLTokenizer(), compute_dtype=dtype,
+            eos_token_ids=[],
+        )
+        hs_engine = PagedContinuousBatchingEngine(
+            hs_gen, slots=slots, buf_len=256, prompt_bucket=32, block_len=32,
+            prefill_chunk=64,
+        )
+        hs_load = _workload(np.random.RandomState(8), mc.vocab_size, 64)
+        _run_config(hs_engine, 1, len(hs_load), hs_load)  # warm every shape
+        compiles0 = hs_engine.stats_snapshot()["compile"]["total_compiles"]
+
+        flat = flatten_dict(params)
+        tr_keys = [k for k in sorted(flat) if k.endswith("kernel")][:4]
+        trainable = {  # genuinely new values so the swap is not an identity
+            k: np.asarray(flat[k], np.float32) + 1e-3 for k in tr_keys
+        }
+        pub_dir = tempfile.mkdtemp(prefix="serve_bench_hotswap_")
+        CheckpointPublisher(pub_dir, keep_last=2).publish(
+            1, trainable,
+            frozen_fp=frozen_fingerprint(
+                {k: v for k, v in flat.items() if k not in tr_keys}
+            ),
+        )
+        mgr = HotSwapManager(
+            hs_engine, CheckpointWatcher(pub_dir, base_params=params)
+        )
+
+        swap_info = {}
+
+        def _swap_mid_run():
+            time.sleep(0.3)  # let the client threads saturate the slots
+            t_swap = time.perf_counter()
+            swap_info["result"] = mgr.poll_once()
+            swap_info["latency_s"] = time.perf_counter() - t_swap
+
+        swapper = threading.Thread(target=_swap_mid_run)
+        swapper.start()
+        total, dt, errors, lats = _run_config(
+            hs_engine, hs_clients, hs_reqs, hs_load
+        )
+        swapper.join()
+        snap = hs_engine.stats_snapshot()
+        compile_delta = snap["compile"]["total_compiles"] - compiles0
+        shutil.rmtree(pub_dir, ignore_errors=True)
+        ok = (
+            not errors
+            and snap["requests_failed"] == 0
+            and compile_delta == 0
+            and swap_info.get("result") is not None
+        )
+        print(json.dumps({
+            "metric": "serve_hotswap_guard",
+            "value": 1 if ok else 0,
+            "unit": "1 = mid-run swap: zero drops, zero recompiles",
+            "clients": hs_clients,
+            "requests": hs_clients * hs_reqs,
+            "requests_dropped": len(errors) + snap["requests_failed"],
+            "swap_applied": swap_info.get("result") is not None,
+            "swap_latency_s": round(swap_info.get("latency_s", 0.0), 4),
+            "weight_generation": hs_engine.weight_generation,
+            "compiles_during_swap": compile_delta,
+            "tokens_served": total,
+            "tokens_per_sec": round(total / dt, 2) if dt > 0 else 0.0,
+            "wall_seconds": round(dt, 2),
+            **_latency_fields(lats, hs_engine),
             "model": preset,
             "platform": jax.devices()[0].platform,
         }), flush=True)

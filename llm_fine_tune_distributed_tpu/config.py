@@ -371,6 +371,15 @@ class TrainConfig:
     # Multi-process saves always use Orbax's own async path.
     checkpoint_async_snapshot: bool = True
 
+    # live deployment (train/publish.py -> infer/deploy.py): after each
+    # checkpoint save, also publish the trainable weights + manifest
+    # (frozen-param fingerprint, step, eval metrics) atomically to this
+    # directory so a serving fleet started with --publish-watch-dir
+    # hot-swaps them without a restart. keep_last bounds disk: only the
+    # newest K publishes survive retention.
+    publish_dir: Optional[str] = None
+    publish_keep_last: int = 3
+
     # resume
     resume_from_checkpoint: Optional[str] = None  # "latest" or a path
 
@@ -436,6 +445,8 @@ class TrainConfig:
         "RESUME_FROM_CHECKPOINT": ("resume_from_checkpoint", str),
         "CHECKPOINT_TRAINABLE_ONLY": ("checkpoint_trainable_only", "_env_bool"),
         "CHECKPOINT_ASYNC_SNAPSHOT": ("checkpoint_async_snapshot", "_env_bool"),
+        "PUBLISH_DIR": ("publish_dir", str),
+        "PUBLISH_KEEP_LAST": ("publish_keep_last", int),
         "WATCHDOG_TIMEOUT_S": ("watchdog_timeout_s", float),
         "WATCHDOG_ACTION": ("watchdog_action", str),
         "OBJECTIVE": ("objective", str),

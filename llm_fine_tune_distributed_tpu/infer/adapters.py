@@ -249,6 +249,21 @@ class AdapterRegistry:
                 padded, scale = self._host[name]
                 self._write_slot(slot, padded, scale)
 
+    def rebind(self, view) -> None:
+        """Re-point the registry at a replacement params view (a weight
+        hot-swap builds a copy-on-write tree off the old one). Site dicts
+        along swapped paths were shallow-copied, so ``_sites`` must track
+        the dicts embedded in the LIVE tree — otherwise the next adapter
+        load would write its pool slot into a dead generation. Pool leaves
+        rode along by reference, so the resident set needs no re-upload."""
+        with self._lock:
+            self.params = view
+            for pth in list(self._sites):
+                node = view
+                for key in pth:
+                    node = node[key]
+                self._sites[pth] = node
+
     # -------------------------------------------------------------- internals
 
     def _free_slot(self) -> int:

@@ -1,0 +1,395 @@
+"""Live deployment: zero-downtime checkpoint hot-swap, serving side.
+
+This closes the train→serve loop opened by train/publish.py. The trainer
+drops trainable-only payloads + manifests into a publish directory; here a
+``CheckpointWatcher`` polls that directory and a ``HotSwapManager`` rolls
+each new publish across the serving replicas without dropping a request or
+recompiling a program:
+
+- **Watch.** The watcher targets the NEWEST committed publish (manifest
+  presence is the commit point — train/publish.py writes it last). Torn,
+  malformed, or mid-deletion publishes are logged and skipped, never
+  raised into serving: the worst defective publish costs is one poll.
+- **Verify.** Before any swap, the manifest's frozen-param fingerprint is
+  checked against the resident base (train/checkpoints.verify_fingerprint
+  over the resident leaves NOT in the published payload). A delta trained
+  against different base weights is rejected at the door.
+- **Double-buffer.** Weights load into host RAM first; the engine applies
+  them copy-on-write at a drained tick boundary
+  (engine.request_weight_swap), so the device holds old + new trainable
+  leaves only across the apply instant and the old tree keeps serving on
+  any failure.
+- **Roll.** Fleet swaps go one replica at a time; a mid-swap replica
+  reports ``swap_pending`` and the router sheds its traffic to siblings,
+  so the fleet as a whole never stops admitting. If replica k fails to
+  swap, replicas 0..k-1 are rolled back best-effort and the deploy raises.
+- **Rollback.** The previously-resident values of every swapped path are
+  kept in host RAM. ``rollback()`` re-rolls them out (bumping the weight
+  generation — a rollback is a forward swap to old values, not a rewind),
+  and an optional monitor auto-rolls-back when the post-swap error rate
+  over a trailing window trips the configured threshold.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from llm_fine_tune_distributed_tpu.train.publish import (
+    list_published,
+    load_manifest,
+    load_weights,
+)
+
+__all__ = ["CheckpointWatcher", "HotSwapManager"]
+
+
+def _flatten(tree, prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+class CheckpointWatcher:
+    """Polls a publish directory for new deployment candidates.
+
+    ``check()`` returns the newest verified candidate beyond ``min_step``
+    as ``{"step", "fingerprint", "weights", "manifest", "path"}`` with the
+    weights already buffered in host RAM — or None when there is nothing
+    new (or nothing valid: every defect is logged and skipped, the watcher
+    never raises into the serving path).
+
+    ``base_params`` (the resident full param tree, or a pooled adapter
+    view — extra non-base leaves are ignored) enables frozen-fingerprint
+    verification; without it the watcher trusts the manifest
+    (``verify_frozen=False`` path, for tests and stub engines).
+    """
+
+    def __init__(
+        self,
+        publish_dir: str,
+        *,
+        base_params=None,
+        verify_frozen: bool = True,
+    ):
+        self.publish_dir = publish_dir
+        self._base = base_params
+        self._verify = bool(verify_frozen) and base_params is not None
+        # resident frozen fingerprint, cached per trainable key-set (the
+        # frozen set is "everything the publish does not carry", so it can
+        # only change when the published leaf set does)
+        self._resident_fp: Dict[frozenset, Dict[str, Any]] = {}
+
+    def _resident_frozen_fp(self, trainable_keys: frozenset) -> Dict[str, Any]:
+        cached = self._resident_fp.get(trainable_keys)
+        if cached is None:
+            from llm_fine_tune_distributed_tpu.train.checkpoints import (
+                frozen_fingerprint,
+            )
+
+            flat = _flatten(self._base)
+            # adapter-pool leaves (infer/adapters.py) ride in the serving
+            # view but exist on no trainer — they are neither trainable nor
+            # frozen from the publish protocol's point of view
+            frozen = {
+                k: v
+                for k, v in flat.items()
+                if k not in trainable_keys and "_pool" not in k.rsplit("/", 1)[-1]
+            }
+            cached = frozen_fingerprint(frozen)
+            self._resident_fp[trainable_keys] = cached
+        return cached
+
+    def check(self, min_step: int = -1) -> Optional[Dict[str, Any]]:
+        """Newest verified publish with step > ``min_step``, or None."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        for step, path in reversed(list_published(self.publish_dir)):
+            if step <= min_step:
+                return None
+            manifest = load_manifest(path)
+            if manifest is None:
+                continue  # torn/malformed: already logged by the loader
+            try:
+                weights = load_weights(path, manifest)
+            except Exception as e:  # noqa: BLE001 — skip, never crash serving
+                log.warning("ignoring unloadable publish %s: %s", path, e)
+                continue
+            if self._verify:
+                from llm_fine_tune_distributed_tpu.train.checkpoints import (
+                    FingerprintMismatch,
+                    verify_fingerprint,
+                )
+
+                try:
+                    verify_fingerprint(
+                        manifest["frozen_fp"],
+                        self._resident_frozen_fp(frozenset(weights)),
+                    )
+                except FingerprintMismatch as e:
+                    log.warning(
+                        "rejecting publish %s: frozen params do not match "
+                        "the resident base (%s)", path, e,
+                    )
+                    continue
+            return {
+                "step": int(manifest["step"]),
+                "fingerprint": str(manifest["weight_fingerprint"]),
+                "weights": weights,
+                "manifest": manifest,
+                "path": path,
+            }
+        return None
+
+
+class HotSwapManager:
+    """Rolls verified publishes across a fleet (or a single engine) and
+    keeps the previous buffer for instant rollback.
+
+    ``target`` is anything exposing either ``.replicas`` (EngineFleet) or
+    ``request_weight_swap`` itself (a bare engine). ``poll_once()`` is the
+    on-demand deploy (``POST /v1/deploy``); ``start()`` runs it on a poll
+    loop that also watches the post-swap error rate and auto-rolls-back
+    when it trips.
+    """
+
+    def __init__(
+        self,
+        target,
+        watcher: CheckpointWatcher,
+        *,
+        poll_s: float = 2.0,
+        swap_timeout_s: float = 600.0,
+        auto_rollback_window_s: float = 0.0,
+        auto_rollback_error_rate: float = 0.5,
+        auto_rollback_min_requests: int = 8,
+    ):
+        self.watcher = watcher
+        self.engines = list(getattr(target, "replicas", None) or [target])
+        self._target = target
+        self.poll_s = max(0.05, float(poll_s))
+        self.swap_timeout_s = float(swap_timeout_s)
+        self.auto_rollback_window_s = float(auto_rollback_window_s)
+        self.auto_rollback_error_rate = float(auto_rollback_error_rate)
+        self.auto_rollback_min_requests = int(auto_rollback_min_requests)
+        self._lock = threading.Lock()
+        self.deployed_step = -1
+        self.deployed_fingerprint: Optional[str] = None
+        # a rollback marks the fled step as held: the poller ignores
+        # publishes at or below it (otherwise the next poll would redeploy
+        # exactly the generation the rollback rejected). A NEWER publish
+        # clears the hold by superseding it.
+        self._hold_step = -1
+        # host-RAM rollback buffer: previous values of the last deploy's
+        # paths, plus the identity they served under
+        self._prev_weights: Optional[Dict[str, np.ndarray]] = None
+        self._prev_fingerprint: Optional[str] = None
+        self._prev_step = -1
+        # post-swap error-rate watch (None = no window armed)
+        self._watch_deadline: Optional[float] = None
+        self._watch_base = (0, 0)  # (completed, failed) at swap time
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- deploys
+
+    def poll_once(self) -> Optional[Dict[str, Any]]:
+        """Check the publish dir and deploy anything newer than what is
+        resident. Returns the deploy result dict, or None when current."""
+        with self._lock:
+            dep = self.watcher.check(max(self.deployed_step, self._hold_step))
+            if dep is None:
+                return None
+            return self._deploy(
+                dep["weights"], dep["fingerprint"], dep["step"],
+                kind="deploy",
+            )
+
+    def rollback(self) -> Dict[str, Any]:
+        """Re-roll the previous buffer out (``POST /v1/deploy/rollback``).
+        Raises RuntimeError when no previous generation is buffered."""
+        with self._lock:
+            if self._prev_weights is None:
+                raise RuntimeError(
+                    "nothing to roll back to: no hot-swap has completed on "
+                    "this manager (the boot weights were never displaced)"
+                )
+            fled = self.deployed_step
+            result = self._deploy(
+                self._prev_weights, self._prev_fingerprint, self._prev_step,
+                kind="rollback",
+            )
+            self._hold_step = max(self._hold_step, fled)
+            return result
+
+    def _deploy(
+        self,
+        weights: Dict[str, np.ndarray],
+        fingerprint: Optional[str],
+        step: int,
+        kind: str,
+    ) -> Dict[str, Any]:
+        """Rolling swap of ``weights`` across every engine (lock held).
+
+        Captures the currently-resident values of the affected paths first
+        (the NEXT rollback buffer), then swaps one replica at a time so the
+        router always has siblings to shed to. A failure part-way rolls the
+        already-swapped replicas back best-effort and raises — the fleet
+        never ends up split across generations."""
+        prev = self._capture(weights)
+        t0 = time.monotonic()
+        done: List[Any] = []
+        results = []
+        try:
+            for eng in self.engines:
+                results.append(
+                    eng.request_weight_swap(
+                        weights, fingerprint=fingerprint, step=step,
+                        timeout=self.swap_timeout_s,
+                    )
+                )
+                done.append(eng)
+        except BaseException:
+            for eng in done:  # best-effort: restore the pre-deploy values
+                try:
+                    eng.request_weight_swap(
+                        prev, fingerprint=self.deployed_fingerprint,
+                        step=self.deployed_step, timeout=self.swap_timeout_s,
+                    )
+                except Exception:  # noqa: BLE001 — original error wins
+                    pass
+            raise
+        if kind == "rollback":
+            for eng in self.engines:
+                eng.stats.incr("weight_rollbacks")
+        self._prev_weights = prev
+        self._prev_fingerprint = self.deployed_fingerprint
+        self._prev_step = self.deployed_step
+        self.deployed_step = int(step)
+        self.deployed_fingerprint = fingerprint
+        self._arm_watch()
+        dt = time.monotonic() - t0
+        print(
+            f"[deploy] {kind}: step {step} ({fingerprint}) live on "
+            f"{len(self.engines)} replica(s) in {dt:.3f}s",
+            flush=True,
+        )
+        return {
+            "kind": kind,
+            "step": int(step),
+            "fingerprint": fingerprint,
+            "replicas": len(self.engines),
+            "duration_s": dt,
+            "weight_generation": max(r["weight_generation"] for r in results),
+            "cache_invalidated": any(r["cache_invalidated"] for r in results),
+        }
+
+    def _capture(self, weights: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Host copies of the values currently resident at ``weights``'s
+        paths (read off replica 0 — a completed rolling swap leaves every
+        replica on the same generation, so any replica would do)."""
+        params = self.engines[0]._params
+        out = {}
+        for key in weights:
+            node = params
+            for part in key.split("/"):
+                node = node[part]
+            out[key] = np.asarray(node)
+        return out
+
+    # ------------------------------------------------------ auto-rollback
+
+    def _counters(self) -> tuple:
+        snap = (
+            self._target.stats_snapshot()
+            if hasattr(self._target, "stats_snapshot")
+            else self.engines[0].stats_snapshot()
+        )
+        return (
+            int(snap.get("requests_completed", 0)),
+            int(snap.get("requests_failed", 0)),
+        )
+
+    def _arm_watch(self) -> None:
+        if self.auto_rollback_window_s <= 0:
+            return
+        self._watch_deadline = time.monotonic() + self.auto_rollback_window_s
+        self._watch_base = self._counters()
+
+    def _watch_tripped(self) -> bool:
+        """True when the post-swap window shows an error rate above the
+        threshold over enough requests to mean anything."""
+        if self._watch_deadline is None:
+            return False
+        if time.monotonic() > self._watch_deadline:
+            self._watch_deadline = None  # window closed clean
+            return False
+        completed, failed = self._counters()
+        d_ok = completed - self._watch_base[0]
+        d_bad = failed - self._watch_base[1]
+        total = d_ok + d_bad
+        if total < self.auto_rollback_min_requests:
+            return False
+        return (d_bad / total) >= self.auto_rollback_error_rate
+
+    def tick(self) -> None:
+        """One poll-loop iteration: auto-rollback check, then deploy poll."""
+        if self._watch_tripped():
+            self._watch_deadline = None
+            try:
+                res = self.rollback()
+                print(
+                    f"[deploy] auto-rollback tripped — restored step "
+                    f"{res['step']} ({res['fingerprint']})",
+                    flush=True,
+                )
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                print(f"[deploy] auto-rollback failed: {e}", flush=True)
+            return  # do not immediately redeploy the generation we fled
+        try:
+            self.poll_once()
+        except Exception as e:  # noqa: BLE001 — a bad publish skips, a
+            # failed swap logs; either way the loop keeps polling
+            print(f"[deploy] deploy attempt failed: {e}", flush=True)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hot-swap-manager", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.tick()
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "deployed_step": self.deployed_step,
+            "deployed_fingerprint": self.deployed_fingerprint,
+            "rollback_available": self._prev_weights is not None,
+            "rollback_step": self._prev_step,
+            "weight_generations": [
+                int(getattr(e, "weight_generation", 0)) for e in self.engines
+            ],
+            "watching": self.watcher.publish_dir,
+        }

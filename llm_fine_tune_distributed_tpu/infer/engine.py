@@ -148,6 +148,79 @@ def _prompt_lookup(ctx: np.ndarray, k: int) -> np.ndarray:
     return ctx[j + 2 : j + 2 + k]
 
 
+# queue sentinel that wakes an idle worker so it notices a staged hot-swap
+# without a request arriving; filtered out everywhere requests leave the queue
+_SWAP_POKE = object()
+
+
+class _PendingSwap:
+    """One staged checkpoint hot-swap (infer/deploy.py): the host-RAM double
+    buffer of updated leaves plus a completion latch. Created on the deploy
+    thread, consumed exactly once by the engine worker at a drained tick
+    boundary; ``result`` or ``error`` is set before ``done``."""
+
+    __slots__ = ("updates", "fingerprint", "step", "done", "result", "error")
+
+    def __init__(self, updates, fingerprint, step):
+        self.updates = updates  # [(path_tuple, host_array)]
+        self.fingerprint = fingerprint
+        self.step = step
+        self.done = threading.Event()
+        self.result: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+
+
+def _cow_swap_tree(params, updates):
+    """Copy-on-write leaf replacement for a weight hot-swap: returns a NEW
+    nested dict sharing every untouched subtree with ``params``, with each
+    updated leaf cast to the resident leaf's dtype and device_put over its
+    sharding. The caller re-points its params reference afterwards — fleet
+    siblings still holding the old tree are unaffected (the rolling swap
+    depends on exactly that), and a raise part-way leaves the old tree
+    fully intact (all-or-nothing). Shapes must match the resident leaves:
+    a same-architecture fine-tune changes values, never shapes, which is
+    what keeps the warm jit caches valid across the swap."""
+    import jax
+    import jax.numpy as jnp
+
+    def rec(node, subs, prefix):
+        if not isinstance(node, dict):
+            raise KeyError(
+                f"published path walks through a non-dict at {'/'.join(prefix)!r}"
+            )
+        out = dict(node)
+        by_head: Dict[str, list] = {}
+        for path, v in subs:
+            by_head.setdefault(path[0], []).append((path[1:], v))
+        for head, group in by_head.items():
+            where = "/".join(prefix + (head,))
+            if head not in out:
+                raise KeyError(f"published path not in resident params: {where!r}")
+            leaves = [g for g in group if not g[0]]
+            if leaves:
+                if len(group) != 1:
+                    raise KeyError(f"path {where!r} is both a leaf and a subtree")
+                old = out[head]
+                arr = leaves[0][1]
+                if tuple(getattr(old, "shape", ())) != tuple(np.shape(arr)):
+                    raise ValueError(
+                        f"shape mismatch at {where!r}: resident "
+                        f"{tuple(getattr(old, 'shape', ()))} vs published "
+                        f"{tuple(np.shape(arr))} — a hot-swap may change "
+                        "values, never shapes"
+                    )
+                new = jnp.asarray(np.asarray(arr)).astype(old.dtype)
+                sharding = getattr(old, "sharding", None)
+                out[head] = (
+                    jax.device_put(new, sharding) if sharding is not None else new
+                )
+            else:
+                out[head] = rec(out[head], group, prefix + (head,))
+        return out
+
+    return rec(params, updates, ()), len(updates)
+
+
 class ContinuousBatchingEngine:
     """S-slot persistent decode loop with in-flight FIFO admission."""
 
@@ -234,6 +307,14 @@ class ContinuousBatchingEngine:
             flight_dir=flight_dir,
         )
         self.faults = faults if faults is not None else FaultInjector()
+        # live deployment (infer/deploy.py): at most one staged checkpoint
+        # hot-swap, applied by the worker at a drained tick boundary under a
+        # weight-generation bump. The resident fingerprint keys prefix-cache
+        # invalidation (an identity republish keeps the cache warm).
+        self._swap_lock = threading.Lock()
+        self._swap_pending: Optional[_PendingSwap] = None
+        self._weight_generation = 0
+        self._weight_fingerprint: Optional[str] = None
         # observability: bounded event ring the supervisor dumps on
         # crash/circuit-open, optional JSONL export of settled request
         # traces, and a monotonically increasing request id. The tick
@@ -438,6 +519,81 @@ class ContinuousBatchingEngine:
         """True while the worker is mid-restart (backoff + rebuild)."""
         return self.supervisor.recovering
 
+    @property
+    def swap_pending(self) -> bool:
+        """True while a checkpoint hot-swap is staged or draining — the
+        fleet router sheds this replica to siblings exactly like one
+        mid-restart, while its in-flight requests finish on the old
+        weight generation."""
+        return self._swap_pending is not None
+
+    @property
+    def weight_generation(self) -> int:
+        """Monotonic count of applied weight hot-swaps (rollbacks included:
+        a rollback is a swap to the previous buffer, not a rewind)."""
+        return self._weight_generation
+
+    @property
+    def weight_fingerprint(self) -> Optional[str]:
+        """Identity of the last swapped-in trainable payload (None until
+        the first hot-swap — the boot weights carry no publish digest)."""
+        return self._weight_fingerprint
+
+    def request_weight_swap(
+        self,
+        weights: Dict[str, "np.ndarray"],
+        *,
+        fingerprint: Optional[str] = None,
+        step: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        """Stage ``weights`` (flat ``{"a/b/c": host array}``) for an atomic
+        hot-swap and block until the worker applies it at a drained tick
+        boundary. Semantics (docs/architecture.md "Live deployment"):
+
+        - in-flight requests FINISH on the current generation (the worker
+          keeps decoding live slots but admits nothing new until the swap
+          lands — under a fleet, the router sheds to siblings meanwhile);
+        - queued requests admit onto the NEW generation afterwards;
+        - shapes are unchanged, so the warm jit caches serve the new values
+          with zero recompiles (assert via ``compile_ledger``);
+        - the paged prefix cache is flushed iff ``fingerprint`` differs
+          from the resident one (identity republish keeps it warm);
+        - a failed apply leaves the old tree serving and raises here.
+
+        Raises on a terminal engine, a concurrently staged swap, a wait
+        ``timeout``, or an apply failure.
+        """
+        if self._terminal is not None:
+            raise self._terminal
+        updates = [(tuple(k.split("/")), v) for k, v in weights.items()]
+        if not updates:
+            raise ValueError("request_weight_swap needs at least one leaf")
+        swap = _PendingSwap(updates, fingerprint, step)
+        with self._swap_lock:
+            if self._swap_pending is not None:
+                raise RuntimeError("a weight swap is already staged on this engine")
+            self._swap_pending = swap
+        self.recorder.record(
+            "weight_swap_begin",
+            step=step,
+            fingerprint=fingerprint,
+            live=int(self._live.sum()),
+            queued=self._queue_len(),
+        )
+        self._q.put(_SWAP_POKE)  # wake an idle worker parked on the queue
+        if not swap.done.wait(timeout):
+            raise TimeoutError(
+                f"weight swap not applied within {timeout}s (engine still "
+                f"draining {int(self._live.sum())} live slot(s))"
+            )
+        if swap.error is not None:
+            raise RuntimeError(
+                "weight swap failed; the engine kept the old generation: "
+                f"{type(swap.error).__name__}: {swap.error}"
+            ) from swap.error
+        return dict(swap.result)
+
     def predicted_drain_s(self) -> float:
         """Public Retry-After estimate: seconds until this replica's current
         backlog drains through its slots (service-time EWMA; clamped
@@ -465,6 +621,7 @@ class ContinuousBatchingEngine:
         self.stats.gauge("queue_depth", self._queue_len())
         self.stats.gauge("live_slots", int(self._live.sum()))
         self.stats.gauge("engine_generation", self.supervisor.generation)
+        self.stats.gauge("weight_generation", self._weight_generation)
         self.stats.gauge(
             "adapters_resident",
             len(self._mt.resident()) if self._mt is not None else 0,
@@ -626,6 +783,10 @@ class ContinuousBatchingEngine:
         invariant wait_drained and the tests lean on. Also the one export
         point for the request's lifecycle trace: every terminal path has
         already marked its terminal span by the time it settles."""
+        # the weight generation this request resolved under: a request that
+        # drains ahead of a staged hot-swap settles BEFORE the apply, so it
+        # visibly finished on the old generation (pinned by tests)
+        req.weight_generation = self._weight_generation
         with self._plock:
             self._pending -= 1
             if req.adapter is not None:
@@ -645,6 +806,7 @@ class ContinuousBatchingEngine:
                     "prompt_tokens": len(req.prompt),
                     "generated_tokens": len(req.result or ()),
                     "error": type(req.error).__name__ if req.error else None,
+                    "weight_generation": self._weight_generation,
                     **req.trace.to_dict(),
                 }
             )
@@ -698,8 +860,11 @@ class ContinuousBatchingEngine:
         # terminal: a submit may have passed the admission gate just before
         # _terminal was set and enqueued afterwards — resolve those too
         while True:
+            self._resolve_swap_terminal()
             self._fail_queued(self._terminal)
             req = self._q.get()
+            if req is _SWAP_POKE:
+                continue
             self._resolve_error(req, self._terminal)
 
     def _startup(self) -> None:
@@ -737,19 +902,106 @@ class ContinuousBatchingEngine:
             step = self._generator.slot_step(self._slots, self._buf_len)
             decode = lambda: self._decode_once(step)  # noqa: E731
         while True:
+            if self._swap_pending is not None:
+                # hot-swap staged: admission pauses (queued requests start on
+                # the NEW generation), live slots finish on the old one, and
+                # the swap applies at the drained tick boundary
+                if self._live.any():
+                    decode()
+                    continue
+                self._apply_swap()
             self._admit()
             if not self._live.any():
                 # idle: block until traffic instead of spinning
-                self._handle_new(self._idle_get())
+                req = self._idle_get()
+                if req is not None:
+                    self._handle_new(req)
                 continue
             decode()
 
-    def _idle_get(self) -> Request:
+    def _idle_get(self) -> Optional[Request]:
         """Blocking queue read with the watchdog disarmed: an empty queue is
-        legitimate silence, not a wedged device. The next poke re-arms."""
+        legitimate silence, not a wedged device. The next poke re-arms.
+        Returns None for a swap poke — the caller loops back to the swap
+        check instead of treating it as traffic."""
         if self._watchdog is not None:
             self._watchdog.pause()
-        return self._q.get()
+        req = self._q.get()
+        return None if req is _SWAP_POKE else req
+
+    def _apply_swap(self) -> None:
+        """Apply the staged weight swap at a fully drained tick boundary
+        (worker thread only, no live slots). All-or-nothing: the new tree is
+        built copy-on-write off to the side and only then re-pointed, so a
+        failure mid-build leaves the old generation serving untouched. The
+        jitted programs are keyed on shapes, which a swap can never change —
+        so the warm compile caches survive and nothing recompiles."""
+        swap = self._swap_pending
+        assert swap is not None
+        t0 = time.monotonic()
+        try:
+            new_params, updated = _cow_swap_tree(self._params, swap.updates)
+            self._params = new_params
+            if self._mt is not None:
+                # the adapter registry holds references into the old tree;
+                # re-point it and re-stamp resident adapters into the new one
+                self._mt.rebind(new_params)
+            changed = (
+                swap.fingerprint is None
+                or swap.fingerprint != self._weight_fingerprint
+            )
+            if changed:
+                self._invalidate_prefix_cache()
+            self._weight_fingerprint = swap.fingerprint
+            self._weight_generation += 1
+            dt = time.monotonic() - t0
+            self.stats.incr("weight_swaps")
+            self.stats.gauge("weight_generation", self._weight_generation)
+            for waiter in self._swap_waiters():
+                if waiter.trace is not None:
+                    waiter.trace.mark("weight_swap")
+            self.recorder.record(
+                "weight_swap",
+                generation=self._weight_generation,
+                step=swap.step,
+                fingerprint=swap.fingerprint,
+                updated_leaves=updated,
+                cache_invalidated=changed,
+                dt_ms=round(dt * 1000.0, 3),
+            )
+            swap.result = {
+                "weight_generation": self._weight_generation,
+                "updated_leaves": updated,
+                "cache_invalidated": changed,
+                "duration_s": dt,
+            }
+        except BaseException as e:  # noqa: BLE001 — reported to the waiter
+            swap.error = e
+            self.recorder.record(
+                "weight_swap_failed", step=swap.step, error=f"{type(e).__name__}: {e}"
+            )
+        finally:
+            self._swap_pending = None
+            swap.done.set()
+
+    def _swap_waiters(self) -> List[Request]:
+        """Requests that queued while the swap was staged — they start on the
+        new generation, so the swap window is part of their latency story."""
+        with self._q.mutex:
+            return [r for r in list(self._q.queue) if r is not _SWAP_POKE]
+
+    def _invalidate_prefix_cache(self) -> None:
+        """Weights changed, so cached KV is stale. The dense engine keeps no
+        cross-request KV — nothing to do; the paged engine overrides."""
+
+    def _resolve_swap_terminal(self) -> None:
+        """Fail a staged swap with the terminal error so its waiter never
+        hangs (retryable restarts keep the stage: it applies post-recovery)."""
+        with self._swap_lock:
+            swap, self._swap_pending = self._swap_pending, None
+        if swap is not None:
+            swap.error = self._terminal
+            swap.done.set()
 
     def _recover(self, cause: BaseException) -> bool:
         """Classify a worker failure; True = state rebuilt, serve again."""
@@ -812,6 +1064,7 @@ class ContinuousBatchingEngine:
             )
         err.__cause__ = cause
         self._terminal = err  # set BEFORE resolving, so waiters see it
+        self._resolve_swap_terminal()  # a staged swap must not hang its waiter
         reason = "circuit_open" if sup.circuit_open else "fatal"
         self.recorder.record(reason, error=str(err))
         dump = sup.dump_flight(
@@ -846,6 +1099,8 @@ class ContinuousBatchingEngine:
                 req = self._q.get_nowait()
             except queue.Empty:
                 return
+            if req is _SWAP_POKE:
+                continue
             self._resolve_error(req, err)
 
     def _admit(self) -> None:
@@ -856,9 +1111,13 @@ class ContinuousBatchingEngine:
                     req = self._q.get_nowait()
                 except queue.Empty:
                     return
+                if req is _SWAP_POKE:
+                    continue
                 self._handle_new(req)
 
     def _handle_new(self, req: Request) -> None:
+        if req is _SWAP_POKE:  # defense: pokes are normally filtered upstream
+            return
         if req.abandoned:
             # timed-out while queued: dropped WITHOUT decoding (the waiter is
             # gone; prefilling for nobody would starve live traffic)
@@ -1284,6 +1543,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _serve_loop(self) -> None:
         while True:
+            if self._swap_pending is not None:
+                # hot-swap staged: no new admissions; in-progress prefills
+                # and live slots finish on the old generation, then the swap
+                # applies at the fully drained tick boundary
+                if self._prefills or self._live.any():
+                    if self._prefills:
+                        self._prefill_tick()
+                    if self._live.any():
+                        self._decode_tick()
+                    continue
+                self._apply_swap()
             self._admit()
             busy = False
             if self._prefills:
@@ -1296,7 +1566,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 # idle: block until traffic instead of spinning (_admit
                 # guarantees a queued head either admits or errors when
                 # nothing is running, so waiting-but-idle cannot happen)
-                self._waiting.append(self._idle_get())
+                req = self._idle_get()
+                if req is not None:
+                    self._waiting.append(req)
 
     def _fail_inflight(self, err: ServingError) -> None:
         self._prefills.clear()  # their requests resolve via _slot_req below
@@ -1306,6 +1578,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         while self._waiting:
             self._resolve_error(self._waiting.popleft(), err)
         super()._fail_queued(err)
+
+    def _swap_waiters(self) -> List[Request]:
+        return list(self._waiting) + super()._swap_waiters()
+
+    def _invalidate_prefix_cache(self) -> None:
+        """New weights make every cached prefix's KV stale: evicting down to
+        a full-pool free target empties the cache (entries re-enter and hit
+        again as post-swap traffic rebuilds them against the new weights)."""
+        dropped = len(self._prefix)
+        self._prefix.evict(self._num_blocks)
+        self.recorder.record("prefix_cache_invalidated", entries=dropped)
 
     def _queue_len(self) -> int:
         return self._q.qsize() + len(self._waiting)
@@ -1320,9 +1603,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         """
         while True:
             try:
-                self._waiting.append(self._q.get_nowait())
+                item = self._q.get_nowait()
             except queue.Empty:
                 break
+            if item is not _SWAP_POKE:
+                self._waiting.append(item)
         while self._waiting:
             req = self._waiting[0]
             if req.abandoned:

@@ -179,7 +179,11 @@ class EngineFleet:
                     index=i,
                     healthy=rep.healthy,
                     draining=rep.draining,
-                    recovering=rep.recovering,
+                    # a replica mid-hot-swap (infer/deploy.py) sheds exactly
+                    # like one mid-restart: siblings absorb new traffic while
+                    # its in-flight requests finish on the old generation
+                    recovering=rep.recovering
+                    or bool(getattr(rep, "swap_pending", False)),
                     queue_depth=rep.queue_depth,
                     live_slots=rep.live_slots,
                     slots=rep.slot_count,
@@ -473,7 +477,14 @@ class EngineFleet:
             agg[key] = sum(s[key] for s in snaps)
         for key in ServingStats.GAUGES:
             vals = [s[key] for s in snaps]
-            agg[key] = max(vals) if key == "engine_generation" else sum(vals)
+            # generations are epochs, not occupancy: the fleet's restart
+            # epoch and weight generation are the furthest any replica has
+            # advanced (mid-rolling-swap the replicas legitimately differ)
+            agg[key] = (
+                max(vals)
+                if key in ("engine_generation", "weight_generation")
+                else sum(vals)
+            )
         agg["tokens_per_s_1m"] = sum(s["tokens_per_s_1m"] for s in snaps)
         agg["uptime_s"] = max(s["uptime_s"] for s in snaps)
         agg["slots"] = sum(s["slots"] for s in snaps)

@@ -106,6 +106,10 @@ def serve(
     flight_dir: Optional[str] = "outputs/flight_recorder",
     trace_log: Optional[str] = None,
     profile_dir: Optional[str] = None,
+    publish_watch_dir: Optional[str] = None,
+    publish_poll_s: float = 2.0,
+    auto_rollback_window_s: float = 0.0,
+    auto_rollback_error_rate: float = 0.5,
     control: Optional[dict] = None,
 ) -> None:
     """``control``, when given, is populated with the drain entry points
@@ -184,6 +188,13 @@ def serve(
             "router places by queue depth and prefix residency, which the "
             "window batcher does not expose); drop --replicas or pick "
             "--engine continuous|paged"
+        )
+    if publish_watch_dir and engine_kind == "window":
+        raise ValueError(
+            "--publish-watch-dir (checkpoint hot-swap) needs a continuous/"
+            "paged engine — the swap lands at the slot scheduler's tick "
+            "boundary, which the window batcher does not have; drop "
+            "--publish-watch-dir or pick --engine continuous|paged"
         )
     print(f"Loading model from {model_dir} ...")
     params, model_config = load_model_dir(model_dir)
@@ -342,6 +353,40 @@ def serve(
         profiler_capture = ProfilerCapture(
             profile_dir,
             on_event=capture_recorder.record if capture_recorder else None,
+        )
+    # live deployment (infer/deploy.py): watch a trainer's publish dir and
+    # hot-swap new checkpoints in at tick boundaries, POST /v1/deploy[/rollback]
+    deploy_mgr = None
+    if publish_watch_dir:
+        if cont_engine is None:
+            raise ValueError(
+                "--publish-watch-dir needs a continuous/paged engine on "
+                "this host (multi-host serving falls back to the window "
+                "engine, which cannot hot-swap)"
+            )
+        from llm_fine_tune_distributed_tpu.infer.deploy import (
+            CheckpointWatcher,
+            HotSwapManager,
+        )
+
+        deploy_mgr = HotSwapManager(
+            cont_engine,
+            CheckpointWatcher(publish_watch_dir, base_params=generator.params),
+            poll_s=publish_poll_s,
+            auto_rollback_window_s=auto_rollback_window_s,
+            auto_rollback_error_rate=auto_rollback_error_rate,
+        )
+        deploy_mgr.start()
+        print(
+            f"[serve] watching {publish_watch_dir} for published "
+            f"checkpoints (poll every {publish_poll_s:g}s"
+            + (
+                f", auto-rollback at {auto_rollback_error_rate:.0%} errors "
+                f"over {auto_rollback_window_s:g}s"
+                if auto_rollback_window_s > 0
+                else ""
+            )
+            + ")"
         )
     drain_state = {"draining": False}
     print(
@@ -682,6 +727,35 @@ def serve(
                 except Exception as e:  # headers may already be sent: log only
                     print(f"[serve] stream error: {e}", flush=True)
                 return
+            if self.path in ("/v1/deploy", "/v1/deploy/rollback"):
+                # live deployment (infer/deploy.py). Deliberately NOT behind
+                # the drain guard: a draining replica may still be rolled
+                # back while its in-flight work finishes.
+                if deploy_mgr is None:
+                    self._send(404, {
+                        "error": "live deployment disabled; start the "
+                                 "server with --publish-watch-dir",
+                    })
+                    return
+                try:
+                    if self.path.endswith("/rollback"):
+                        result = deploy_mgr.rollback()
+                    else:
+                        result = deploy_mgr.poll_once() or {
+                            "kind": "noop",
+                            "detail": "no publish newer than the deployed "
+                                      "generation",
+                            **deploy_mgr.status(),
+                        }
+                except RuntimeError as e:
+                    self._send(409, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 — swap failures map
+                    # through the taxonomy (engine kept the old generation)
+                    self._send_error(e)
+                    return
+                self._send(200, result)
+                return
             if self.path != "/v1/generate":
                 self._send(404, {"error": "not found"})
                 return
@@ -851,6 +925,7 @@ def serve(
         control["cont_engine"] = cont_engine
         control["window_engine"] = engine
         control["profiler"] = profiler_capture
+        control["deploy"] = deploy_mgr
 
     print(f"Serving on {host}:{port}")
     try:
@@ -859,6 +934,8 @@ def serve(
         pass
     finally:
         httpd.server_close()
+        if deploy_mgr is not None:
+            deploy_mgr.stop()
         if coordinator is not None:
             coordinator.stop()  # release follower hosts
         if drain_state["draining"]:
@@ -1023,6 +1100,30 @@ def main(argv: Optional[list] = None) -> int:
              "written to fresh subdirectories of this path (view with "
              "tensorboard --logdir). Off by default",
     )
+    parser.add_argument(
+        "--publish-watch-dir", default=os.environ.get("PUBLISH_DIR") or None,
+        help="live deployment: watch this trainer publish directory "
+             "(train --publish-dir) and hot-swap each new checkpoint in "
+             "at a tick boundary with zero dropped requests and zero "
+             "recompiles; enables POST /v1/deploy and "
+             "POST /v1/deploy/rollback. Off by default",
+    )
+    parser.add_argument(
+        "--publish-poll-s", type=float, default=2.0,
+        help="seconds between publish-directory polls "
+             "(--publish-watch-dir)",
+    )
+    parser.add_argument(
+        "--auto-rollback-window-s", type=float, default=0.0,
+        help="after each hot-swap, watch the error rate for this many "
+             "seconds and roll back automatically if it trips "
+             "--auto-rollback-error-rate (0 = manual rollback only)",
+    )
+    parser.add_argument(
+        "--auto-rollback-error-rate", type=float, default=0.5,
+        help="failed-request fraction within the post-swap window that "
+             "triggers the automatic rollback",
+    )
     args = parser.parse_args(argv)
     if not os.path.isdir(args.model_dir):
         print(f"Error: model directory not found: {args.model_dir!r}")
@@ -1047,7 +1148,11 @@ def main(argv: Optional[list] = None) -> int:
           watchdog_timeout_s=args.watchdog_timeout_s,
           flight_dir=args.flight_dir or None,
           trace_log=args.trace_log,
-          profile_dir=args.profile_dir)
+          profile_dir=args.profile_dir,
+          publish_watch_dir=args.publish_watch_dir,
+          publish_poll_s=args.publish_poll_s,
+          auto_rollback_window_s=args.auto_rollback_window_s,
+          auto_rollback_error_rate=args.auto_rollback_error_rate)
     return 0
 
 

@@ -75,11 +75,18 @@ class ServingStats:
         "requests_shed_overflow", "requests_shed_deadline",
         "draft_tokens_proposed", "draft_tokens_accepted",
         "adapter_loads", "adapter_evictions", "requests_shed_tenant_quota",
+        # live deployment (infer/deploy.py): checkpoint hot-swaps applied at
+        # a tick boundary, and rollbacks to the previous weight buffer
+        "weight_swaps", "weight_rollbacks",
     )
     GAUGES = (
         "queue_depth", "live_slots", "engine_generation",
         "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
         "adapters_resident",
+        # monotonically increasing weight generation: bumped by every applied
+        # hot-swap (rollbacks included — a rollback is a swap to the previous
+        # buffer, not a counter rewind)
+        "weight_generation",
     )
     # the per-tenant record's exact key set (pinned by
     # tests/test_metrics_schema.py so the /v1/stats schema cannot drift)

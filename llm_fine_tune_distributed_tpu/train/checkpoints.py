@@ -144,6 +144,7 @@ class CheckpointManager:
         trainable_only: bool = False,
     ):
         directory = os.path.abspath(directory)
+        self.directory = directory
         if jax.process_index() == 0:
             os.makedirs(directory, exist_ok=True)
         self.metric_name = metric_name
@@ -224,6 +225,7 @@ class CheckpointManager:
                 args=ocp.args.Composite(state=ocp.args.StandardSave(payload)),
                 metrics=metrics,
             )
+            self._write_latest(step, metrics)
             return
         # (the entry join above already waited out any previous background
         # save: transient HBM is bounded to ONE extra payload copy and Orbax
@@ -279,6 +281,7 @@ class CheckpointManager:
                     metrics=metrics,
                 )
                 self._mgr.wait_until_finished()
+                self._write_latest(step, metrics)
             except BaseException as e:  # surfaced on next save/wait/close
                 self._snapshot_error = e
 
@@ -286,6 +289,31 @@ class CheckpointManager:
             target=_bg_save, name=f"ckpt-snapshot-{step}", daemon=True
         )
         self._snapshot_thread.start()
+
+    def _write_latest(self, step: int, metrics: Optional[Dict[str, float]]) -> None:
+        """Torn-read-proof ``latest.json`` beside the step dirs (temp path +
+        ``os.replace`` — train/publish.atomic_write_json): the step really is
+        durable by the time this runs, so an external reader (a publish-dir
+        watcher, a resume script, a human) gets (step, metrics, payload mode)
+        without importing Orbax, and never a half-written pointer. Process 0
+        only — exactly the host that owns directory rotation."""
+        if jax.process_index() != 0:
+            return
+        from llm_fine_tune_distributed_tpu.train.publish import atomic_write_json
+
+        try:
+            atomic_write_json(
+                os.path.join(self.directory, "latest.json"),
+                {
+                    "step": int(step),
+                    "metrics": {
+                        k: float(v) for k, v in (metrics or {}).items()
+                    },
+                    "trainable_only": self.trainable_only,
+                },
+            )
+        except OSError:
+            pass  # the pointer is advisory; the checkpoint itself is durable
 
     def join_snapshot(self) -> None:
         if self._snapshot_thread is not None:

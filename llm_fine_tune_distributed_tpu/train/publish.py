@@ -1,0 +1,243 @@
+"""Atomic publication of trainable-only checkpoints for live deployment.
+
+This is the trainer's half of the train→serve hot-swap loop (the serving
+half is infer/deploy.py): after a checkpoint save, the trainer drops the
+trainable weights plus a manifest into a *publish directory* that a
+serving fleet watches. The protocol is deliberately dumb — a directory of
+``step_NNNNNNNN/`` subdirs on any shared filesystem — because the hard
+requirements are about *atomicity*, not transport:
+
+- **Torn-read-proof files.** Every file lands via ``atomic_write_bytes``:
+  temp file in the same directory, flush + fsync, one ``os.replace``. A
+  concurrent reader sees the old bytes or the new bytes, never a prefix.
+- **Manifest-last commit.** ``manifest.json`` is written atomically AFTER
+  the weights file, so its presence is the publish's commit point: a
+  watcher that can read a manifest knows the weights it names were fully
+  durable first. Conversely deletion unlinks the manifest FIRST, so a
+  half-deleted publish is undiscoverable rather than half-readable.
+- **Identity before bytes.** The manifest carries a digest of the
+  trainable payload (``weight_fingerprint``) and the per-leaf 4-stat
+  fingerprint of the FROZEN params (train/checkpoints.frozen_fingerprint)
+  the weights were trained against. The serving side verifies the frozen
+  stats against its resident base before swapping (a delta trained against
+  different base weights must never be grafted on), and keys prefix-cache
+  invalidation on the trainable digest (an identity republish keeps the
+  cache; any real change flushes it).
+
+Retention (``keep_last``) deletes only publishes at least ``keep_last``
+steps behind the newest; the watcher only ever loads the newest valid
+manifest, so by the time a publish is deletion-eligible no correct watcher
+targets it — and a watcher that loses the race anyway surfaces a logged
+skip (deploy.py), never a crash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import re
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+MANIFEST_NAME = "manifest.json"
+WEIGHTS_NAME = "trainable.npz"
+MANIFEST_SCHEMA = 1
+_STEP_RE = re.compile(r"^step_(\d{8,})$")
+
+
+# ------------------------------------------------------------ atomic writes
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` torn-read-proof: temp file in the same
+    directory (same filesystem, so the rename is atomic), fsync, then one
+    ``os.replace``. Readers see the old file or the new file, never a
+    partial one; a crash mid-write leaves the old file untouched."""
+    path = os.path.abspath(path)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path), prefix=os.path.basename(path) + ".tmp."
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    atomic_write_bytes(
+        path, json.dumps(obj, indent=2, sort_keys=True).encode("utf-8")
+    )
+
+
+# -------------------------------------------------------------- identities
+
+
+def weights_digest(flat: Dict[str, np.ndarray]) -> str:
+    """16-hex identity of a flat ``{path: array}`` payload — exact bytes,
+    order-independent. Identical weights republished give the identical
+    digest (the serving side keeps its prefix cache); any real change gives
+    a new one (the cache is flushed)."""
+    h = hashlib.sha256()
+    for k in sorted(flat):
+        a = np.ascontiguousarray(np.asarray(flat[k]))
+        h.update(k.encode("utf-8"))
+        h.update(str(a.dtype).encode("utf-8"))
+        h.update(np.asarray(a.shape, np.int64).tobytes())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def fingerprint_digest(stats: Dict[str, Any]) -> str:
+    """16-hex identity of a per-leaf 4-stat fingerprint dict
+    (train/checkpoints.frozen_fingerprint output)."""
+    h = hashlib.sha256()
+    for k in sorted(stats):
+        h.update(k.encode("utf-8"))
+        h.update(np.asarray(stats[k], np.float32).tobytes())
+    return h.hexdigest()[:16]
+
+
+# ------------------------------------------------------------- directories
+
+
+def step_dir_name(step: int) -> str:
+    return f"step_{int(step):08d}"
+
+
+def parse_step(name: str) -> Optional[int]:
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def list_published(publish_dir: str) -> List[Tuple[int, str]]:
+    """``(step, dir)`` ascending for every step dir whose manifest exists —
+    manifest presence IS the commit point, so a dir mid-publish (weights
+    written, manifest not yet) is invisible here by construction."""
+    try:
+        names = os.listdir(publish_dir)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        step = parse_step(name)
+        if step is None:
+            continue
+        path = os.path.join(publish_dir, name)
+        if os.path.isfile(os.path.join(path, MANIFEST_NAME)):
+            out.append((step, path))
+    return sorted(out)
+
+
+def load_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """Parse ``path``'s manifest; None (logged) on any defect — a torn or
+    hand-damaged manifest must read as 'no publish here', never raise into
+    the serving engine."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(mpath, "rb") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("ignoring unreadable manifest %s: %s", mpath, e)
+        return None
+    required = ("schema", "step", "weights_file", "weight_fingerprint", "frozen_fp")
+    missing = [k for k in required if k not in manifest]
+    if missing or not isinstance(manifest.get("frozen_fp"), dict):
+        log.warning("ignoring malformed manifest %s: missing %s", mpath, missing)
+        return None
+    return manifest
+
+
+def load_weights(path: str, manifest: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Load the manifest's weights into host RAM (the serving side's double
+    buffer). Raises OSError/KeyError/ValueError on missing or torn files —
+    the watcher catches and skips."""
+    wpath = os.path.join(path, str(manifest["weights_file"]))
+    with np.load(wpath) as z:
+        return {k: np.asarray(z[k]) for k in z.files}
+
+
+# --------------------------------------------------------------- publisher
+
+
+class CheckpointPublisher:
+    """Publishes trainable-only payloads + manifests with keep-last-K
+    retention. One instance per training run; ``publish`` is called from
+    the trainer right after each checkpoint save."""
+
+    def __init__(self, publish_dir: str, keep_last: int = 3):
+        self.publish_dir = os.path.abspath(publish_dir)
+        self.keep_last = max(1, int(keep_last))
+        os.makedirs(self.publish_dir, exist_ok=True)
+
+    def publish(
+        self,
+        step: int,
+        trainable: Dict[str, Any],
+        *,
+        frozen_fp: Dict[str, Any],
+        metrics: Optional[Dict[str, float]] = None,
+    ) -> str:
+        """Publish ``trainable`` (flat ``{path: array}``, device or host) as
+        ``step``'s deployment candidate; returns the published directory.
+        Weights first, manifest last, both atomically — see module doc."""
+        host = {k: np.asarray(v) for k, v in trainable.items()}
+        final = os.path.join(self.publish_dir, step_dir_name(step))
+        os.makedirs(final, exist_ok=True)
+        buf = io.BytesIO()
+        np.savez(buf, **host)
+        atomic_write_bytes(os.path.join(final, WEIGHTS_NAME), buf.getvalue())
+        manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "step": int(step),
+            "published_unix": time.time(),
+            "weights_file": WEIGHTS_NAME,
+            "weight_fingerprint": weights_digest(host),
+            "num_leaves": len(host),
+            "bytes": int(sum(a.nbytes for a in host.values())),
+            "frozen_fp": {
+                k: np.asarray(v, np.float32).tolist()
+                for k, v in frozen_fp.items()
+            },
+            "metrics": {k: float(v) for k, v in (metrics or {}).items()},
+        }
+        atomic_write_json(os.path.join(final, MANIFEST_NAME), manifest)
+        log.info(
+            "published step %d (%d leaves, %d bytes) to %s",
+            step, manifest["num_leaves"], manifest["bytes"], final,
+        )
+        self.retain()
+        return final
+
+    def retain(self) -> List[str]:
+        """Delete all but the newest ``keep_last`` committed publishes.
+        The manifest is unlinked FIRST (atomic), so a dir being deleted
+        stops being discoverable before its weights disappear — combined
+        with the watcher's newest-only targeting and skip-on-error load,
+        deletion can never turn into a serving crash."""
+        doomed = list_published(self.publish_dir)[: -self.keep_last]
+        removed = []
+        for _, path in doomed:
+            try:
+                os.unlink(os.path.join(path, MANIFEST_NAME))
+                shutil.rmtree(path)
+                removed.append(path)
+            except OSError as e:
+                log.warning("retention could not remove %s: %s", path, e)
+        return removed

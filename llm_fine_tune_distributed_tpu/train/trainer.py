@@ -84,6 +84,9 @@ class SFTTrainer:
         # boundary in train(); set -> emergency checkpoint + clean exit so a
         # JobSet restart resumes instead of losing up to save_steps of work
         self._preempt = threading.Event()
+        # live-deployment publisher (train/publish.py), built lazily at the
+        # first save when config.publish_dir is set
+        self._publisher = None
         # subclasses (DPO) stash extra eval-time scalars here; merged into the
         # metric sinks whenever an eval fires
         self.extra_eval_logs: Dict[str, float] = {}
@@ -798,7 +801,7 @@ class SFTTrainer:
         save on single-process runs (VERDICT r4 #1 — the next train step
         must not block on the device->host checkpoint stream)."""
         fp = None
-        if ckpt.trainable_only:
+        if ckpt.trainable_only or self.config.publish_dir:
             if not hasattr(self, "_frozen_fp"):
                 from llm_fine_tune_distributed_tpu.train.checkpoints import (
                     frozen_fingerprint,
@@ -810,9 +813,37 @@ class SFTTrainer:
             step,
             self.state,
             metrics=metrics,
-            fingerprint=fp,
+            fingerprint=fp if ckpt.trainable_only else None,
             snapshot_async=self.config.checkpoint_async_snapshot,
         )
+        self._publish(step, fp, metrics)
+
+    def _publish(self, step: int, fp, metrics) -> None:
+        """Live deployment (train/publish.py): drop the trainable weights +
+        manifest into the publish dir a serving fleet hot-swaps from
+        (infer/deploy.py). Process 0 only — one publisher per run, and the
+        payload is the replicated trainable masters. Publish failures are
+        logged, never fatal: deployment lag must not kill the fine-tune."""
+        if not self.config.publish_dir or jax.process_index() != 0:
+            return
+        if self._publisher is None:
+            from llm_fine_tune_distributed_tpu.train.publish import (
+                CheckpointPublisher,
+            )
+
+            self._publisher = CheckpointPublisher(
+                self.config.publish_dir,
+                keep_last=self.config.publish_keep_last,
+            )
+        try:
+            self._publisher.publish(
+                step, self.state.trainable, frozen_fp=fp, metrics=metrics
+            )
+        except Exception as e:  # noqa: BLE001 — advisory side channel
+            print(
+                f"[train] checkpoint publish for step {step} failed: {e}",
+                flush=True,
+            )
 
     def _resolve_best_mode(self) -> str:
         cfg = self.config

@@ -57,8 +57,10 @@ SNAPSHOT_KEYS = {
     "requests_shed_overflow", "requests_shed_deadline",
     "draft_tokens_proposed", "draft_tokens_accepted",
     "adapter_loads", "adapter_evictions", "requests_shed_tenant_quota",
+    # live deployment (infer/deploy.py): applied hot-swaps / rollback swaps
+    "weight_swaps", "weight_rollbacks",
     # gauges
-    "queue_depth", "live_slots", "engine_generation",
+    "queue_depth", "live_slots", "engine_generation", "weight_generation",
     "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
     "adapters_resident",
     # multi-tenant LoRA: tenant -> {requests, tokens, queue_depth}
@@ -117,6 +119,8 @@ EXPECTED_METRICS = {
     ("serving_adapter_loads_total", "counter"),
     ("serving_adapter_evictions_total", "counter"),
     ("serving_requests_shed_tenant_quota_total", "counter"),
+    ("serving_weight_swaps_total", "counter"),
+    ("serving_weight_rollbacks_total", "counter"),
     # per-tenant series (tenant="name" labels; TYPE lines are emitted even
     # with zero tenants so the schema is load-independent)
     ("serving_tenant_requests_total", "counter"),
@@ -126,6 +130,7 @@ EXPECTED_METRICS = {
     ("serving_queue_depth", "gauge"),
     ("serving_live_slots", "gauge"),
     ("serving_engine_generation", "gauge"),
+    ("serving_weight_generation", "gauge"),
     ("serving_adapters_resident", "gauge"),
     ("serving_blocks_in_use", "gauge"),
     ("serving_peak_blocks_in_use", "gauge"),
