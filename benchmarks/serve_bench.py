@@ -64,7 +64,14 @@ mark the compile ledger warm, re-run it, exit nonzero on ANY post-warmup
 recompile), SERVE_HOTSWAP=1 (hot-swap arm: publish a perturbed checkpoint
 while SERVE_HOTSWAP_CLIENTS=16 clients hammer a paged engine, deploy it
 mid-run via HotSwapManager, exit nonzero on any dropped request or any
-post-warmup recompile; SERVE_HOTSWAP_REQS_PER_CLIENT=4). Every
+post-warmup recompile; SERVE_HOTSWAP_REQS_PER_CLIENT=4), SERVE_OVERLOAD=1
+(overload arm: a 10x bursty mixed-tier spike with deadlines against a
+small paged engine; exits nonzero if interactive p99 TTFT degrades beyond
+2x the uncontended baseline — small absolute floor,
+SERVE_OVERLOAD_TTFT_FLOOR_S=1.0 — or any request ends without a terminal
+result: tokens, a 504, or a tier-labelled 429;
+SERVE_OVERLOAD_BASE_CLIENTS=3, SERVE_OVERLOAD_BURST=10,
+SERVE_OVERLOAD_REQS_PER_CLIENT=3). Every
 engine-backed JSON line also carries the XLA
 introspection gauges: mfu, hbm_bw_util, compiles_total,
 compile_seconds_total.
@@ -183,6 +190,36 @@ def _repetitive_workload(rng, vocab, n, spec_k, max_new=32):
     return out
 
 
+def _overload_workload(rng, vocab, n, interactive_only=False):
+    """Mixed-tier pool for the overload arm: [(prompt, gen, seed, tier,
+    deadline_s)]. Interactive requests are short and deadline-free (they
+    feed the TTFT gate); batch carries deadlines — mostly generous, a few
+    deliberately unmeetable so the sweep exercises 504 cancellation; the
+    best_effort tail is what brownout and preemption shed first."""
+    from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+
+    out = []
+    for i in range(n):
+        r = 0.0 if interactive_only else rng.rand()
+        if r < 0.4:
+            tier, max_new, deadline = "interactive", 8, None
+        elif r < 0.7:
+            tier, max_new = "batch", 16
+            deadline = 30.0 if rng.rand() < 0.8 else 0.02
+        else:
+            tier, max_new, deadline = "best_effort", 24, None
+        plen = int(rng.choice([8, 24, 48]))
+        sampled = bool(rng.rand() < 0.5)
+        gen = GenerationConfig(
+            max_new_tokens=max_new,
+            do_sample=sampled,
+            temperature=1.0 if sampled else 0.0,
+        )
+        prompt = rng.randint(0, min(vocab, 256), (plen,)).tolist()
+        out.append((prompt, gen, i, tier, deadline))
+    return out
+
+
 def _run_config(engine, clients, reqs_per_client, workload):
     """clients threads x reqs_per_client sequential submits each. Returns
     (tokens_served, wall_s, errors, per-request client latencies)."""
@@ -211,6 +248,59 @@ def _run_config(engine, clients, reqs_per_client, workload):
         t.join()
     dt = time.perf_counter() - t0
     return sum(served), dt, errors, lats
+
+
+def _overload_run(engine, workload, clients, reqs_per_client):
+    """Streamed mixed-tier run for the overload arm. Every request must
+    reach a TERMINAL state: tokens, a deadline 504, or a tier-labelled
+    429 — anything else lands in ``unexpected`` and fails the gate.
+    Returns (interactive TTFTs, outcome counters, unexpected errors)."""
+    from llm_fine_tune_distributed_tpu.infer.errors import (
+        DeadlineExceededError,
+        QueueOverflowError,
+    )
+
+    ttfts = []
+    counts = {"completed": 0, "deadline_504": 0, "shed_429": 0}
+    unexpected = []
+    lock = threading.Lock()
+
+    def client(ci):
+        for ri in range(reqs_per_client):
+            prompt, gen, seed, tier, deadline = workload[
+                (ci * reqs_per_client + ri) % len(workload)
+            ]
+            t_req = time.perf_counter()
+            try:
+                it = engine.stream(
+                    prompt, gen, seed=seed, timeout=600,
+                    priority=tier, deadline_s=deadline,
+                )
+                next(it)
+                ttft = time.perf_counter() - t_req
+                for _ in it:
+                    pass
+                with lock:
+                    counts["completed"] += 1
+                    if tier == "interactive":
+                        ttfts.append(ttft)
+            except DeadlineExceededError:
+                with lock:
+                    counts["deadline_504"] += 1
+            except QueueOverflowError:  # brownout + overflow sheds
+                with lock:
+                    counts["shed_429"] += 1
+            except Exception as e:
+                unexpected.append(repr(e))
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return ttfts, counts, unexpected
 
 
 def _pctl(sorted_vals, q):
@@ -1006,6 +1096,99 @@ def main():
             **_latency_fields(lats, hs_engine),
             "model": preset,
             "platform": jax.devices()[0].platform,
+        }), flush=True)
+        if not ok:
+            sys.exit(1)
+
+    # overload arm: a 10x bursty mixed-tier spike against a small paged
+    # engine with overload control at defaults. Two gates: interactive p99
+    # TTFT under the burst stays within 2x of the uncontended baseline
+    # (plus a small absolute floor so millisecond-scale baselines don't
+    # gate on scheduler noise), and EVERY issued request terminates —
+    # tokens, a deadline 504, or a tier-labelled 429. A request that
+    # vanishes (hang, stray exception) fails the arm.
+    if os.environ.get("SERVE_OVERLOAD", "1") == "1":
+        ov_base_clients = int(
+            os.environ.get("SERVE_OVERLOAD_BASE_CLIENTS", "3")
+        )
+        ov_mult = int(os.environ.get("SERVE_OVERLOAD_BURST", "10"))
+        ov_reqs = int(os.environ.get("SERVE_OVERLOAD_REQS_PER_CLIENT", "3"))
+        ov_floor = float(os.environ.get("SERVE_OVERLOAD_TTFT_FLOOR_S", "1.0"))
+        ov_engine = PagedContinuousBatchingEngine(
+            generator, slots=min(slots, 4), buf_len=256, prompt_bucket=32,
+            block_len=32, prefill_chunk=64,
+        )
+        base_load = _overload_workload(
+            np.random.RandomState(9), mc.vocab_size, 32, interactive_only=True
+        )
+        burst_load = _overload_workload(
+            np.random.RandomState(10), mc.vocab_size, 96
+        )
+        # warm every prompt bucket / decode width / sampling mode both
+        # phases will touch, so burst TTFT measures scheduling, not XLA
+        _overload_run(ov_engine, base_load + burst_load, 6, 8)
+
+        base_ttfts, base_counts, base_errs = _overload_run(
+            ov_engine, base_load, ov_base_clients, ov_reqs
+        )
+
+        peak_stage = [0]
+        stop = threading.Event()
+
+        def _stage_monitor():
+            while not stop.is_set():
+                peak_stage[0] = max(
+                    peak_stage[0],
+                    ov_engine.stats_snapshot()["brownout_stage"],
+                )
+                time.sleep(0.02)
+
+        monitor = threading.Thread(target=_stage_monitor)
+        monitor.start()
+        burst_clients = ov_base_clients * ov_mult
+        burst_ttfts, burst_counts, burst_errs = _overload_run(
+            ov_engine, burst_load, burst_clients, ov_reqs
+        )
+        stop.set()
+        monitor.join()
+
+        base_p99 = _pctl(sorted(base_ttfts), 0.99)
+        burst_p99 = _pctl(sorted(burst_ttfts), 0.99)
+        ttft_limit = max(2.0 * base_p99, ov_floor)
+        issued = burst_clients * ov_reqs
+        accounted = sum(burst_counts.values())
+        snap = ov_engine.stats_snapshot()
+        ok = (
+            not base_errs
+            and not burst_errs
+            and accounted == issued
+            and bool(burst_ttfts)  # at least one interactive served
+            and burst_p99 <= ttft_limit
+        )
+        print(json.dumps({
+            "metric": "serve_overload_guard",
+            "value": 1 if ok else 0,
+            "unit": "1 = 10x mixed-tier burst: interactive p99 TTFT <= "
+                    "max(2x baseline, floor), all requests terminal",
+            "baseline_clients": ov_base_clients,
+            "burst_clients": burst_clients,
+            "requests_issued": issued,
+            "requests_accounted": accounted,
+            "baseline_interactive_p99_ttft_s": round(base_p99, 4),
+            "burst_interactive_p99_ttft_s": round(burst_p99, 4),
+            "ttft_limit_s": round(ttft_limit, 4),
+            "burst_completed": burst_counts["completed"],
+            "burst_deadline_504": burst_counts["deadline_504"],
+            "burst_shed_429": burst_counts["shed_429"],
+            "unexpected_errors": base_errs + burst_errs,
+            "peak_brownout_stage": peak_stage[0],
+            "preemptions": snap["preemptions"],
+            "requests_shed_by_tier": snap["requests_shed_by_tier"],
+            "requests_shed_deadline_decode":
+                snap["requests_shed_deadline_decode"],
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+            "slots": min(slots, 4),
         }), flush=True)
         if not ok:
             sys.exit(1)

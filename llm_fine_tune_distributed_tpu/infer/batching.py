@@ -37,6 +37,11 @@ from typing import List, Optional, Sequence
 
 from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
 
+# Priority tiers for overload control (infer/engine.py): index = tier
+# number, LOWER is more important. Admission orders by (aged tier, arrival);
+# under pressure the highest-numbered tier sheds and preempts first.
+PRIORITY_TIERS = ("interactive", "batch", "best_effort")
+
 
 @dataclass
 class Request:
@@ -91,6 +96,22 @@ class Request:
     trace: Optional[object] = None
     first_token_t: Optional[float] = None
     last_token_t: Optional[float] = None
+    # overload control (continuous engines only): the request's priority
+    # tier name and number (index into PRIORITY_TIERS; lower = more
+    # important), and the absolute client deadline (monotonic) past which
+    # it is cancelled wherever it is — queued, prefilling, or mid-decode.
+    priority: str = "interactive"
+    tier: int = 0
+    deadline: Optional[float] = None
+    # KV-pressure preemption: tokens generated before the slot was
+    # reclaimed (the resume prefills prompt+preempted_tokens and decode
+    # continues from there), and how many times this request was bumped.
+    preempted_tokens: List[int] = field(default_factory=list)
+    preemptions: int = 0
+    # set (GIL-atomic, like ``abandoned``) by an admission thread that
+    # displaced this queued lower-priority request to make room; the
+    # scheduler resolves it with a tier-labelled 429 at its next admit pass
+    shed_by_pressure: bool = False
 
 
 # historical name, kept for callers/tests that referenced the private type

@@ -80,10 +80,12 @@ from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from llm_fine_tune_distributed_tpu.infer.batching import Request
+from llm_fine_tune_distributed_tpu.infer.batching import PRIORITY_TIERS, Request
 from llm_fine_tune_distributed_tpu.infer.errors import (
     AdapterPoolFullError,
+    BrownoutShedError,
     CircuitOpenError,
+    DeadlineExceededError,
     DrainingError,
     FatalEngineError,
     QueueDeadlineError,
@@ -254,6 +256,13 @@ class ContinuousBatchingEngine:
         trace_log: Optional[str] = None,
         adapters=None,
         adapter_quota: int = 0,
+        priority_default: str = "interactive",
+        age_promote_s: float = 5.0,
+        brownout_thresholds: Sequence[float] = (0.7, 0.85, 0.95),
+        brownout_hysteresis: float = 0.1,
+        brownout_queue_wait_s: float = 2.0,
+        brownout_drain_s: float = 10.0,
+        brownout_cap_tokens: int = 32,
     ):
         if getattr(generator, "_multihost", False):
             raise ValueError(
@@ -298,6 +307,35 @@ class ContinuousBatchingEngine:
         # EWMA of queue-entry -> completion seconds; seeds the Retry-After
         # hints before any request has completed (worker-thread-only writes)
         self._avg_service_s = 1.0
+        # ±20% deterministic Retry-After jitter sequence (submit threads;
+        # next() on itertools.count is GIL-atomic)
+        self._retry_seq = itertools.count(1)
+        # -------- overload control (docs/architecture.md "Overload control")
+        if priority_default not in PRIORITY_TIERS:
+            raise ValueError(
+                f"unknown priority_default {priority_default!r} "
+                f"(expected one of {PRIORITY_TIERS})"
+            )
+        self._priority_default = priority_default
+        # anti-starvation aging: every age_promote_s of queue wait promotes
+        # a waiter one tier for ORDERING purposes (raw tiers still govern
+        # shedding and preemption, so promotion cannot cause churn).
+        # <= 0 disables promotion.
+        self._age_promote_s = float(age_promote_s)
+        # priority admission buffer shared by both engines: the worker
+        # drains _q into it and admits by (aged tier, arrival id). Worker-
+        # thread-mutated; submit threads only len()/iterate (GIL-atomic).
+        self._waiting: "deque[Request]" = deque()
+        # staged brownout: pressure thresholds for stages 1..3, hysteresis
+        # band for de-escalation, and the normalizing scales that turn the
+        # queue-wait EWMA and predicted drain into [0,1]-ish pressure
+        self._brownout_thresholds = tuple(float(t) for t in brownout_thresholds)
+        self._brownout_hysteresis = float(brownout_hysteresis)
+        self._brownout_queue_wait_s = max(1e-6, float(brownout_queue_wait_s))
+        self._brownout_drain_s = max(1e-6, float(brownout_drain_s))
+        self._brownout_cap_tokens = max(1, int(brownout_cap_tokens))
+        self._brownout_stage = 0
+        self._queue_wait_ewma = 0.0
         # supervision: restart policy + deterministic fault hooks
         self.supervisor = EngineSupervisor(
             restart_backoff_s=restart_backoff_s,
@@ -379,10 +417,13 @@ class ContinuousBatchingEngine:
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
         trace: Optional[RequestTrace] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> List[int]:
         """Blocking: enqueue one request, wait for its full token list."""
         return self.submit_full(
-            prompt_ids, gen, seed, timeout, adapter, trace=trace
+            prompt_ids, gen, seed, timeout, adapter, trace=trace,
+            priority=priority, deadline_s=deadline_s,
         ).result
 
     def submit_full(
@@ -393,15 +434,22 @@ class ContinuousBatchingEngine:
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
         trace: Optional[RequestTrace] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         """``submit`` returning the whole request record (window-engine
         parity, so the server can swap engines behind one call shape).
         ``adapter`` names the tenant's LoRA adapter (AdapterRegistry slot);
         None serves the base model. ``trace`` is a caller-owned
         RequestTrace (the fleet's cross-replica timeline) this engine
-        adopts instead of opening its own."""
+        adopts instead of opening its own. ``priority`` is a PRIORITY_TIERS
+        name (None -> the engine's default tier); ``deadline_s`` is the
+        client's end-to-end budget — past it the request is cancelled
+        wherever it is (queued, prefilling, or mid-decode) with a
+        DeadlineExceededError carrying the tokens generated so far."""
         req = self._make_request(
-            prompt_ids, gen, seed, adapter=adapter, trace=trace
+            prompt_ids, gen, seed, adapter=adapter, trace=trace,
+            priority=priority, deadline_s=deadline_s,
         )
         self._q.put(req)
         if not req.done.wait(timeout):
@@ -422,6 +470,8 @@ class ContinuousBatchingEngine:
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
         trace: Optional[RequestTrace] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> Iterator[int]:
         """Yield the request's tokens one at a time AS THEY DECODE, while the
         request shares the slot batch with everything else in flight — the
@@ -434,7 +484,7 @@ class ContinuousBatchingEngine:
         committing to an SSE response."""
         req = self._make_request(
             prompt_ids, gen, seed, tokens_q=queue.Queue(), adapter=adapter,
-            trace=trace,
+            trace=trace, priority=priority, deadline_s=deadline_s,
         )
         self._q.put(req)
 
@@ -518,6 +568,13 @@ class ContinuousBatchingEngine:
     def recovering(self) -> bool:
         """True while the worker is mid-restart (backoff + rebuild)."""
         return self.supervisor.recovering
+
+    @property
+    def brownout_stage(self) -> int:
+        """Current degradation stage (0 healthy .. 3 shedding best_effort);
+        the fleet router reads it to steer best_effort traffic away from
+        stage-3 replicas before their engine-level shed fires."""
+        return self._brownout_stage
 
     @property
     def swap_pending(self) -> bool:
@@ -626,6 +683,7 @@ class ContinuousBatchingEngine:
             "adapters_resident",
             len(self._mt.resident()) if self._mt is not None else 0,
         )
+        self.stats.gauge("brownout_stage", self._brownout_stage)
         snap = self.stats.snapshot()
         snap["circuit_state"] = self.circuit_state
         snap["draining"] = self._draining
@@ -674,15 +732,32 @@ class ContinuousBatchingEngine:
     # ------------------------------------------------------------- admission
 
     def _queue_len(self) -> int:
-        return self._q.qsize()
+        return self._q.qsize() + len(self._waiting)
 
     def _retry_after(self) -> float:
         """Finite Retry-After hint: roughly how long until the backlog ahead
-        of a retry drains through the slots, from the service-time EWMA.
-        Clamped to [0.5s, 600s] so a cold EWMA can never emit 0 or inf."""
+        of a retry drains through the slots, from the service-time EWMA
+        (seeded finite at construction, so even the very first 429 carries a
+        usable hint). A ±20% deterministic jitter (Knuth multiplicative
+        hash over a monotonic sequence) decorrelates clients shed in the
+        same burst, so they don't retry in lockstep and re-create the spike
+        they were shed from. Clamped to [0.5s, 600s] so a cold EWMA can
+        never emit 0 or inf."""
         backlog = self._queue_len() + max(1, int(self._live.sum()))
         est = self._avg_service_s * backlog / self._slots
+        seq = next(self._retry_seq)
+        est *= 0.8 + 0.4 * ((seq * 2654435761) % 1000) / 1000.0
         return float(min(max(est, 0.5), 600.0))
+
+    def _waiting_snapshot(self) -> List[Request]:
+        """Every request queued but not yet admitted, as seen from a submit
+        thread: the worker's priority buffer plus the hand-off queue. Both
+        reads are GIL-atomic (list() of a deque; the queue under its own
+        mutex) — a slightly stale view only mis-picks a displacement victim,
+        never corrupts state."""
+        with self._q.mutex:
+            q = [r for r in list(self._q.queue) if r is not _SWAP_POKE]
+        return list(self._waiting) + q
 
     def _make_request(
         self,
@@ -692,12 +767,23 @@ class ContinuousBatchingEngine:
         tokens_q: Optional["queue.Queue"] = None,
         adapter: Optional[str] = None,
         trace: Optional[RequestTrace] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         """Admission gate, shared by submit and stream: reject terminal /
-        draining / overflow states BEFORE the request enters the queue, and
-        stamp the queue-wait deadline. Registers the request in the pending
-        ledger — from here on, exactly one ``_settle`` resolves it (which
-        also releases the adapter pin and tenant bookkeeping taken here)."""
+        draining / brownout / overflow states BEFORE the request enters the
+        queue, and stamp the queue-wait and client deadlines. Registers the
+        request in the pending ledger — from here on, exactly one
+        ``_settle`` resolves it (which also releases the adapter pin and
+        tenant bookkeeping taken here)."""
+        if priority is None:
+            priority = self._priority_default
+        if priority not in PRIORITY_TIERS:
+            raise ValueError(
+                f"unknown priority {priority!r} (expected one of "
+                f"{PRIORITY_TIERS})"
+            )
+        tier = PRIORITY_TIERS.index(priority)
         if self._terminal is not None:
             raise self._terminal
         if self._draining:
@@ -706,14 +792,65 @@ class ContinuousBatchingEngine:
                 "replica",
                 retry_after_s=self._retry_after(),
             )
-        if self._max_queue_depth and self._queue_len() >= self._max_queue_depth:
+        if (
+            self._brownout_stage >= 3
+            and tier >= PRIORITY_TIERS.index("best_effort")
+            # never shed into an IDLE engine: after a burst drains, the
+            # worker only de-escalates on its next admit/tick pass — a
+            # best_effort-only client must not starve against a stale stage
+            and (self._queue_len() > 0 or bool(self._live.any()))
+        ):
+            # stage 3: best_effort never enqueues. The fleet's overflow
+            # reroute tries siblings (BrownoutShedError IS a
+            # QueueOverflowError); with every replica browned out the
+            # client gets the fleet-wide tier-labelled 429.
             self.stats.incr("requests_shed_overflow")
-            self.recorder.record("shed_overflow", queued=self._queue_len())
-            raise QueueOverflowError(
-                f"admission queue full ({self._queue_len()} waiting >= "
-                f"max_queue_depth {self._max_queue_depth})",
-                retry_after_s=self._retry_after(),
+            self.stats.tier_shed_incr(priority)
+            self.recorder.record(
+                "shed_brownout", tier=priority, stage=self._brownout_stage
             )
+            raise BrownoutShedError(
+                f"brownout stage {self._brownout_stage}: shedding "
+                f"{priority!r} traffic until pressure clears",
+                retry_after_s=self._retry_after(),
+                tier=priority,
+            )
+        if self._max_queue_depth and self._queue_len() >= self._max_queue_depth:
+            # priority displacement: a full queue holding a strictly
+            # lower-priority waiter sheds THAT waiter (newest of the lowest
+            # tier) instead of the arrival — under pressure the lowest tier
+            # goes first. Marking is a GIL-atomic bool (like ``abandoned``);
+            # the worker resolves the victim with a tier-labelled 429 at its
+            # next admit pass. The queue transiently overshoots by at most
+            # one request per displacement.
+            victim = None
+            for cand in self._waiting_snapshot():
+                if cand.shed_by_pressure or cand.abandoned:
+                    continue
+                if cand.tier > tier and (
+                    victim is None or (cand.tier, cand.id) > (victim.tier, victim.id)
+                ):
+                    victim = cand
+            if victim is not None:
+                victim.shed_by_pressure = True
+                self.recorder.record(
+                    "shed_displaced",
+                    request=victim.id,
+                    tier=victim.priority,
+                    displaced_by=priority,
+                )
+            else:
+                self.stats.incr("requests_shed_overflow")
+                self.stats.tier_shed_incr(priority)
+                self.recorder.record(
+                    "shed_overflow", queued=self._queue_len(), tier=priority
+                )
+                raise QueueOverflowError(
+                    f"admission queue full ({self._queue_len()} waiting >= "
+                    f"max_queue_depth {self._max_queue_depth})",
+                    retry_after_s=self._retry_after(),
+                    tier=priority,
+                )
         adapter_idx = 0
         if adapter is not None:
             if self._mt is None:
@@ -764,6 +901,10 @@ class ContinuousBatchingEngine:
         req.trace.mark("received", req.enqueued_at)
         if self._queue_deadline_s is not None:
             req.queue_deadline = req.enqueued_at + self._queue_deadline_s
+        req.priority = priority
+        req.tier = tier
+        if deadline_s is not None:
+            req.deadline = req.enqueued_at + float(deadline_s)
         with self._plock:
             self._pending += 1
         req.trace.mark("queued", req.enqueued_at)
@@ -774,6 +915,14 @@ class ContinuousBatchingEngine:
             req.queue_deadline is not None
             and time.monotonic() > req.queue_deadline
         )
+
+    def _deadline_expired(self, req: Request, now: Optional[float] = None) -> bool:
+        """Client deadline (``deadline_ms``) check — pre-prefill callers
+        read the clock; decode-tick callers pass the tick stamp ``_now`` so
+        the hot loop adds no clock reads."""
+        if req.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) > req.deadline
 
     # ------------------------------------------------------------ resolution
 
@@ -843,6 +992,231 @@ class ContinuousBatchingEngine:
                 retry_after_s=self._retry_after(),
             ),
         )
+
+    def _resolve_displaced(self, req: Request) -> None:
+        """Settle a queued request a higher-priority arrival displaced from
+        the full queue (marked ``shed_by_pressure`` on a submit thread,
+        resolved here on the worker): a tier-labelled 429."""
+        self.stats.incr("requests_shed_overflow")
+        self.stats.tier_shed_incr(req.priority)
+        self.recorder.record(
+            "shed_displaced_resolved", request=req.id, tier=req.priority
+        )
+        self._resolve_error(
+            req,
+            QueueOverflowError(
+                f"request (tier {req.priority!r}) displaced from the full "
+                "queue by a higher-priority arrival",
+                retry_after_s=self._retry_after(),
+                tier=req.priority,
+            ),
+        )
+
+    def _cancel_deadline_queued(self, req: Request) -> None:
+        """Client deadline expired before prefill: 504 with whatever tokens
+        an earlier preempted run banked (usually none)."""
+        waited = time.monotonic() - req.enqueued_at if req.enqueued_at else 0.0
+        self.stats.incr("requests_shed_deadline")
+        self.recorder.record(
+            "deadline_cancel", request=req.id, where="queued",
+            waited_s=round(waited, 4), tokens_generated=len(req.preempted_tokens),
+        )
+        self._resolve_error(
+            req,
+            DeadlineExceededError(
+                f"deadline expired after {waited:.2f}s, before prefill",
+                tokens=tuple(req.preempted_tokens),
+            ),
+        )
+
+    def _cancel_deadline_decode(self, slot: int, req: Request) -> None:
+        """Client deadline expired while the request held a slot (prefilling
+        or decoding): cancel mid-flight, settle with the tokens generated so
+        far, and free the slot (and its blocks) THIS tick."""
+        tokens = req.preempted_tokens + self._slot_tokens[slot]
+        self.stats.incr("requests_shed_deadline_decode")
+        self.recorder.record(
+            "deadline_cancel", request=req.id, where="decode", slot=slot,
+            tokens_generated=len(tokens),
+        )
+        self._resolve_error(
+            req,
+            DeadlineExceededError(
+                f"deadline expired mid-decode after {len(tokens)} token(s)",
+                tokens=tuple(tokens),
+            ),
+        )
+        self._release(slot)
+
+    def _pre_admit_resolve(self, req: Request) -> bool:
+        """Shared pre-prefill triage: settle requests that must not admit
+        (abandoned waiter, displaced under pressure, queue deadline, client
+        deadline). True when the request was resolved here."""
+        if req.abandoned:
+            # timed-out while queued: dropped WITHOUT decoding (the waiter
+            # is gone; prefilling for nobody would starve live traffic)
+            self._settle_abandoned(req)
+            return True
+        if req.shed_by_pressure:
+            self._resolve_displaced(req)
+            return True
+        if self._expired(req):
+            self._shed_deadline(req)
+            return True
+        if self._deadline_expired(req):
+            self._cancel_deadline_queued(req)
+            return True
+        return False
+
+    # ------------------------------------------------- overload control
+    # (docs/architecture.md "Overload control": priority admission,
+    # KV-pressure preemption, staged brownout — all worker-thread-only)
+
+    def _drain_queue(self) -> None:
+        """Move every queued request into the priority buffer (the queue is
+        just the submit->worker hand-off; ordering lives in ``_waiting``)."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not _SWAP_POKE:
+                self._waiting.append(item)
+
+    def _shed_marked(self) -> None:
+        """Resolve waiters a submit thread marked for displacement (the
+        full-queue priority shed in ``_make_request``)."""
+        for i in range(len(self._waiting) - 1, -1, -1):
+            if self._waiting[i].shed_by_pressure:
+                req = self._waiting[i]
+                del self._waiting[i]
+                self._resolve_displaced(req)
+
+    def _effective_tier(self, req: Request, now: float) -> int:
+        """Tier used for ORDERING only: every ``age_promote_s`` of queue
+        wait promotes the waiter one tier (anti-starvation — a saturating
+        interactive stream cannot park a batch request forever). Raw
+        ``req.tier`` still governs shedding and preemption, so promotion
+        can never cause preemption churn."""
+        if self._age_promote_s <= 0:
+            return req.tier
+        waited = now - req.enqueued_at if req.enqueued_at else 0.0
+        return max(0, req.tier - int(waited / self._age_promote_s))
+
+    def _select_waiting(self) -> int:
+        """Index of the next waiter to admit: lowest (aged tier, arrival)."""
+        now = time.monotonic()
+        return min(
+            range(len(self._waiting)),
+            key=lambda i: (
+                self._effective_tier(self._waiting[i], now),
+                self._waiting[i].id,
+            ),
+        )
+
+    def _effective_prompt(self, req: Request) -> List[int]:
+        """The sequence to prefill: the prompt plus any tokens banked by a
+        preemption. Resume = one re-prefill over both (the paged engine's
+        prefix cache makes it cheap), then decode continues exactly where
+        the preempted run stopped."""
+        if not req.preempted_tokens:
+            return list(req.prompt)
+        return list(req.prompt) + list(req.preempted_tokens)
+
+    def _budget_cap(self, req: Request) -> int:
+        """max_new_tokens still owed to this request: banked preempted
+        tokens count against the budget (a resumed run emits exactly the
+        remainder, so preempt+resume totals match the uninterrupted run),
+        and brownout stage 2+ caps best_effort output. Never below 1 —
+        prefill structurally emits one token."""
+        cap = int(req.gen.max_new_tokens)
+        if self._brownout_stage >= 2 and req.tier >= PRIORITY_TIERS.index(
+            "best_effort"
+        ):
+            cap = min(cap, self._brownout_cap_tokens)
+        return max(1, cap - len(req.preempted_tokens))
+
+    def _preempt_victim(self, tier: int) -> Optional[int]:
+        """Pick the slot to preempt for an arrival of raw tier ``tier``:
+        the youngest request of the WORST strictly-lower tier (strict, so
+        equal tiers never preempt each other — no ping-pong). None when
+        nothing live is lower-priority than the arrival."""
+        victim = None
+        vkey = None
+        for slot in range(self._slots):
+            req = self._slot_req[slot]
+            if req is None or not self._live[slot]:
+                continue  # free, or prefilling (never preempted mid-prefill)
+            if req.tier <= tier:
+                continue
+            key = (req.tier, req.id)
+            if vkey is None or key > vkey:
+                victim, vkey = slot, key
+        return victim
+
+    def _preempt_slot(self, slot: int) -> None:
+        """KV-pressure preemption: bank the slot's generated-so-far tokens
+        on the request, free the slot (and its blocks) NOW, and requeue the
+        request — it resumes via a fresh prefill over prompt+banked tokens
+        with the remaining budget. Greedy resume is bit-identical to the
+        uninterrupted run (same context -> same logits -> same argmax),
+        using only already-compiled programs."""
+        req = self._slot_req[slot]
+        req.preempted_tokens.extend(self._slot_tokens[slot])
+        req.preemptions += 1
+        self.stats.incr("preemptions")
+        if req.trace is not None:
+            req.trace.mark("preempted")
+        self.recorder.record(
+            "preempt",
+            request=req.id,
+            slot=slot,
+            tier=req.priority,
+            tokens_banked=len(req.preempted_tokens),
+        )
+        self._release(slot)
+        self._waiting.append(req)
+
+    def _occupancy(self) -> float:
+        """KV-pool occupancy in [0, 1]; the dense engine's slab is
+        preallocated per slot, so only the paged engine reports one."""
+        return 0.0
+
+    def _pressure(self) -> float:
+        """Composite overload signal: the max of (a) queue-wait EWMA over
+        its budget, (b) block-pool occupancy, (c) predicted backlog drain
+        time over its budget — each ~1.0 at the edge of trouble, so the
+        stage thresholds read as fractions of 'definitely overloaded'."""
+        backlog = self._queue_len() + int(self._live.sum())
+        drain = self._avg_service_s * backlog / self._slots
+        return max(
+            self._queue_wait_ewma / self._brownout_queue_wait_s,
+            self._occupancy(),
+            drain / self._brownout_drain_s,
+        )
+
+    def _update_brownout(self) -> None:
+        """Move the brownout stage toward the pressure signal, with a
+        hysteresis band below each threshold so the stage doesn't flap at
+        the boundary. Every transition is a flight-recorder event and
+        moves the serving_brownout_stage gauge."""
+        if self._queue_len() == 0:
+            # an empty queue is an observation of zero wait — without it a
+            # drained burst would leave the EWMA frozen at its peak
+            self._queue_wait_ewma += 0.2 * (0.0 - self._queue_wait_ewma)
+        p = self._pressure()
+        stage = self._brownout_stage
+        th = self._brownout_thresholds
+        while stage < len(th) and p >= th[stage]:
+            stage += 1
+        while stage > 0 and p < th[stage - 1] - self._brownout_hysteresis:
+            stage -= 1
+        if stage != self._brownout_stage:
+            prev, self._brownout_stage = self._brownout_stage, stage
+            self.stats.gauge("brownout_stage", stage)
+            self.recorder.record(
+                "brownout", stage=stage, prev=prev, pressure=round(p, 4)
+            )
 
     # ---------------------------------------------------------------- worker
 
@@ -988,7 +1362,8 @@ class ContinuousBatchingEngine:
         """Requests that queued while the swap was staged — they start on the
         new generation, so the swap window is part of their latency story."""
         with self._q.mutex:
-            return [r for r in list(self._q.queue) if r is not _SWAP_POKE]
+            q = [r for r in list(self._q.queue) if r is not _SWAP_POKE]
+        return list(self._waiting) + q
 
     def _invalidate_prefix_cache(self) -> None:
         """Weights changed, so cached KV is stale. The dense engine keeps no
@@ -1094,6 +1469,8 @@ class ContinuousBatchingEngine:
     def _fail_queued(self, err: ServingError) -> None:
         """Resolve everything still queued (terminal shutdown only — on a
         restart, queued requests survive and admit into the new generation)."""
+        while self._waiting:
+            self._resolve_error(self._waiting.popleft(), err)
         while True:
             try:
                 req = self._q.get_nowait()
@@ -1104,27 +1481,33 @@ class ContinuousBatchingEngine:
             self._resolve_error(req, err)
 
     def _admit(self) -> None:
-        """Refill free slots from the queue head — strict FIFO, any config."""
+        """Refill free slots in (aged tier, arrival) order. When every slot
+        is live and the best waiter outranks a live request (raw tiers),
+        preempt the youngest lowest-tier slot — its tokens bank and it
+        requeues behind the admission."""
         with annotate("admit"):
-            while self._live.sum() < self._slots:
-                try:
-                    req = self._q.get_nowait()
-                except queue.Empty:
-                    return
-                if req is _SWAP_POKE:
+            self._drain_queue()
+            self._shed_marked()
+            self._update_brownout()
+            while self._waiting:
+                idx = self._select_waiting()
+                req = self._waiting[idx]
+                if self._pre_admit_resolve(req):
+                    del self._waiting[idx]
                     continue
+                if int(self._live.sum()) >= self._slots:
+                    victim = self._preempt_victim(req.tier)
+                    if victim is None:
+                        return  # nothing live is lower-priority; wait
+                    self._preempt_slot(victim)
+                    continue
+                del self._waiting[idx]
                 self._handle_new(req)
 
     def _handle_new(self, req: Request) -> None:
         if req is _SWAP_POKE:  # defense: pokes are normally filtered upstream
             return
-        if req.abandoned:
-            # timed-out while queued: dropped WITHOUT decoding (the waiter is
-            # gone; prefilling for nobody would starve live traffic)
-            self._settle_abandoned(req)
-            return
-        if self._expired(req):
-            self._shed_deadline(req)
+        if self._pre_admit_resolve(req):
             return
         try:
             self._insert(req)
@@ -1154,7 +1537,8 @@ class ContinuousBatchingEngine:
     def _insert(self, req: Request) -> None:
         gen = self._generator
         slot = int(np.flatnonzero(~self._live)[0])
-        plen = len(req.prompt)
+        prompt = self._effective_prompt(req)
+        plen = len(prompt)
         if plen == 0:
             raise ValueError("continuous engine needs a non-empty prompt")
         if plen >= self._buf_len:
@@ -1166,13 +1550,17 @@ class ContinuousBatchingEngine:
         t0 = time.monotonic()
         if req.trace is not None:
             req.trace.mark("admitted", t0)
-        if req.enqueued_at:
-            self.stats.observe("queue_wait_s", t0 - req.enqueued_at)
+        if req.enqueued_at and req.preemptions == 0:
+            # first admission only: a resumed request's elapsed time mixes
+            # decode and queue time, which would poison the wait signal
+            wait = t0 - req.enqueued_at
+            self.stats.observe("queue_wait_s", wait)
+            self._queue_wait_ewma += 0.2 * (wait - self._queue_wait_ewma)
         self.recorder.record("admit", request=req.id, slot=slot, prompt_tokens=plen)
         bucket = min(-(-plen // self._bucket) * self._bucket, self._buf_len)
         prefill = gen.slot_prefill(bucket, self._buf_len)
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = req.prompt
+        padded[0, :plen] = prompt
         knobs = self._knob_arrays(req)
         import jax
 
@@ -1197,9 +1585,10 @@ class ContinuousBatchingEngine:
             )
         self._slot_req[slot] = req
         self._slot_tokens[slot] = []
-        # the budget honors max_new_tokens but never the buffer's end: the
-        # slot == position invariant holds only inside the buffer
-        self._slot_budget[slot] = min(req.gen.max_new_tokens, self._buf_len - plen)
+        # the budget honors max_new_tokens (less any banked preempted
+        # tokens) but never the buffer's end: the slot == position
+        # invariant holds only inside the buffer
+        self._slot_budget[slot] = min(self._budget_cap(req), self._buf_len - plen)
         self._live[slot] = True
         self.stats.incr("requests_admitted")
         self._emit_token(slot, req, first)
@@ -1220,6 +1609,7 @@ class ContinuousBatchingEngine:
             live=int(self._live.sum()),
             dt_ms=round((self._now - t0) * 1000.0, 3),
         )
+        self._update_brownout()
 
     def _decode_once(self, step) -> None:
         gen = self._generator
@@ -1241,21 +1631,32 @@ class ContinuousBatchingEngine:
                 self._settle_abandoned(req)
                 self._release(slot)
                 continue
+            if self._deadline_expired(req, self._now):
+                self._cancel_deadline_decode(slot, req)
+                continue
             self._emit_token(slot, req, int(toks[slot]))
 
     # ------------------------------------------------------------ speculative
 
     def _slot_ctx(self, slot: int) -> np.ndarray:
-        """The slot's full token context (prompt + accepted generations).
-        Its length - 1 equals the device-side ``pos`` for the slot."""
+        """The slot's full token context (effective prompt + accepted
+        generations). Its length - 1 equals the device-side ``pos``."""
         req = self._slot_req[slot]
-        return np.asarray(list(req.prompt) + self._slot_tokens[slot], np.int32)
+        return np.asarray(
+            self._effective_prompt(req) + self._slot_tokens[slot], np.int32
+        )
 
     def _spec_want(self, slot: int) -> int:
         """Draft depth this slot asks for this tick: the request's K capped
-        by the engine's compiled K; 0 for dead slots and non-spec requests."""
+        by the engine's compiled K; 0 for dead slots and non-spec requests.
+        Brownout stage 1+ disables drafting engine-wide — the fused step
+        still runs (no recompile; 0-draft slots reduce to plain steps
+        inside the same program) but stops burning verify FLOPs on
+        positions that mostly reject under pressure."""
         req = self._slot_req[slot]
         if req is None or not self._live[slot]:
+            return 0
+        if self._brownout_stage >= 1:
             return 0
         return min(int(req.gen.speculative_lookup), self._spec_k)
 
@@ -1338,6 +1739,9 @@ class ContinuousBatchingEngine:
                 self._settle_abandoned(req)
                 self._release(slot)
                 continue
+            if self._deadline_expired(req, self._now):
+                self._cancel_deadline_decode(slot, req)
+                continue
             proposed = int(n_draft[slot])
             m = int(n_emit[slot])
             if proposed:
@@ -1389,7 +1793,10 @@ class ContinuousBatchingEngine:
             self._finish(slot, req)
 
     def _finish(self, slot: int, req: Request) -> None:
-        req.result = self._slot_tokens[slot]
+        # banked preempted tokens lead the result: the client sees ONE
+        # uninterrupted token sequence no matter how often the request
+        # was bumped (greedy: bit-identical to the solo run)
+        req.result = req.preempted_tokens + self._slot_tokens[slot]
         if req.trace is not None:
             req.trace.mark("completed", self._now)
         if req.draft_tokens_proposed:
@@ -1511,7 +1918,6 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
         self._slot_plen = [0] * slots
         self._prefills: List[_PrefillTask] = []  # FIFO, head in progress
-        self._waiting: "deque[Request]" = deque()  # FIFO admission buffer
         stats = stats or ServingStats(slots, total_blocks=self._num_blocks - 1)
         # parent starts the worker thread LAST, so every paged field above
         # must exist before this call (kwargs: supervision/admission knobs)
@@ -1574,14 +1980,6 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._prefills.clear()  # their requests resolve via _slot_req below
         super()._fail_inflight(err)
 
-    def _fail_queued(self, err: ServingError) -> None:
-        while self._waiting:
-            self._resolve_error(self._waiting.popleft(), err)
-        super()._fail_queued(err)
-
-    def _swap_waiters(self) -> List[Request]:
-        return list(self._waiting) + super()._swap_waiters()
-
     def _invalidate_prefix_cache(self) -> None:
         """New weights make every cached prefix's KV stale: evicting down to
         a full-pool free target empties the cache (entries re-enter and hit
@@ -1590,48 +1988,51 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._prefix.evict(self._num_blocks)
         self.recorder.record("prefix_cache_invalidated", entries=dropped)
 
-    def _queue_len(self) -> int:
-        return self._q.qsize() + len(self._waiting)
-
     def _admit(self) -> None:
-        """Admit from the FIFO head while a slot AND blocks are available.
+        """Admit in (aged tier, arrival) order while a slot AND blocks are
+        available.
 
         Unlike the dense parent, occupancy is ``_slot_req`` (a prefilling
         slot is occupied but not yet live) and admission can fail for lack
-        of BLOCKS with free slots remaining — in that case the head waits
-        (strict FIFO: nothing overtakes it) for retirements to free blocks.
-        """
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except queue.Empty:
-                break
-            if item is not _SWAP_POKE:
-                self._waiting.append(item)
+        of BLOCKS with free slots remaining — then the selected waiter
+        holds its turn (nothing overtakes it), but a strictly lower-tier
+        LIVE slot is preempted first to free its blocks (KV-pressure
+        preemption); only when nothing live is lower-priority does the
+        waiter block on retirements."""
+        self._drain_queue()
+        self._shed_marked()
+        self._update_brownout()
         while self._waiting:
-            req = self._waiting[0]
-            if req.abandoned:
-                self._waiting.popleft()
-                self._settle_abandoned(req)
-                continue
-            if self._expired(req):
-                self._waiting.popleft()
-                self._shed_deadline(req)
+            idx = self._select_waiting()
+            req = self._waiting[idx]
+            if self._pre_admit_resolve(req):
+                del self._waiting[idx]
                 continue
             free = [s for s in range(self._slots) if self._slot_req[s] is None]
             if not free:
-                return
+                victim = self._preempt_victim(req.tier)
+                if victim is None:
+                    return  # every slot is equal-or-higher tier; wait
+                self._preempt_slot(victim)
+                continue
             try:
                 plan = self._plan(req)
             except (ValueError, RuntimeError) as e:
                 # host-side rejection (can-never-fit, drained-pool paradox):
                 # request-level, the worker is fine
-                self._waiting.popleft()
+                del self._waiting[idx]
                 self._resolve_error(req, e)
                 continue
             if plan is None:
-                return  # head waits for blocks; FIFO holds
-            self._waiting.popleft()
+                # pool exhausted: bump a lower-tier live slot (its banked
+                # blocks go through the prefix cache, so the resume is
+                # cheap) or wait for retirements to free blocks
+                victim = self._preempt_victim(req.tier)
+                if victim is None:
+                    return
+                self._preempt_slot(victim)
+                continue
+            del self._waiting[idx]
             self._insert_paged(req, free[0], plan)
 
     def _chunk_plan(self, plen: int, shared_len: int):
@@ -1651,8 +2052,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         """Match the prefix cache and reserve every block the request can
         ever touch (prefill pads included — all-or-nothing, so a live slot
         can never run out of blocks mid-decode). Returns None to make the
-        FIFO head wait, raises to reject, otherwise the admission plan."""
-        plen = len(req.prompt)
+        selected waiter wait, raises to reject, otherwise the admission
+        plan. A preempted request plans over prompt+banked tokens with its
+        REMAINING budget, so its block total never grows across resumes."""
+        prompt = self._effective_prompt(req)
+        plen = len(prompt)
         if plen == 0:
             raise ValueError("continuous engine needs a non-empty prompt")
         if plen >= self._buf_len:
@@ -1661,8 +2065,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 f"{self._buf_len}-position block budget (need >= 1 decode slot)"
             )
         L = self._block_len
-        budget_end = min(plen + req.gen.max_new_tokens, self._buf_len)
-        keys = self._prefix.block_keys(req.prompt)
+        budget_end = min(plen + self._budget_cap(req), self._buf_len)
+        keys = self._prefix.block_keys(prompt)
         # cap: >= 1 suffix token must prefill (the first sampled token needs
         # the last prompt token's logits)
         shared = self._prefix.match(keys, (plen - 1) // L)
@@ -1727,8 +2131,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         now = time.monotonic()
         if req.trace is not None:
             req.trace.mark("admitted", now)
-        if req.enqueued_at:
-            self.stats.observe("queue_wait_s", now - req.enqueued_at)
+        if req.enqueued_at and req.preemptions == 0:
+            # first admission only: a resumed request's elapsed time mixes
+            # decode and queue time, which would poison the wait signal
+            wait = now - req.enqueued_at
+            self.stats.observe("queue_wait_s", wait)
+            self._queue_wait_ewma += 0.2 * (wait - self._queue_wait_ewma)
         self.recorder.record(
             "admit",
             request=req.id,
@@ -1757,6 +2165,29 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self._settle_abandoned(req)
             self._release(task.slot)
             return
+        if self._deadline_expired(req):
+            # prefill-start (and every chunk boundary of a long prompt):
+            # an expired request stops ingesting immediately — its blocks
+            # free this tick instead of after a doomed full prefill
+            self._prefills.pop(0)
+            tokens = req.preempted_tokens
+            self.stats.incr("requests_shed_deadline_decode")
+            self.recorder.record(
+                "deadline_cancel", request=req.id, where="prefill",
+                slot=task.slot, tokens_generated=len(tokens),
+                positions_ingested=task.next,
+            )
+            self._resolve_error(
+                req,
+                DeadlineExceededError(
+                    f"deadline expired during prefill "
+                    f"({task.next}/{task.plen} positions ingested)",
+                    tokens=tuple(tokens),
+                ),
+            )
+            self._release(task.slot)
+            return
+        prompt = self._effective_prompt(req)
         self.faults.maybe_fail_prefill()
         import jax
 
@@ -1769,7 +2200,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 C, self._table_blocks, self._block_len
             )
             chunk = np.asarray(
-                req.prompt[task.next : task.next + C], np.int32
+                prompt[task.next : task.next + C], np.int32
             )[None, :]
             with annotate("prefill"):
                 self._cache = ingest(
@@ -1795,9 +2226,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             bucket, self._table_blocks, self._block_len
         )
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :remaining] = req.prompt[task.next :]
+        padded[0, :remaining] = prompt[task.next :]
         seen_row = np.zeros((1, gen.config.vocab_size), bool)
-        seen_row[0, np.asarray(req.prompt, np.intp)] = True
+        seen_row[0, np.asarray(prompt, np.intp)] = True
         with annotate("prefill"):
             self._cache, self._state, first = final(
                 self._params, self._cache, self._state, table, padded,
@@ -1822,7 +2253,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 -(-task.plen // self._bucket) * self._bucket, self._buf_len
             )
             dpad = np.zeros((1, dbucket), np.int32)
-            dpad[0, : task.plen] = req.prompt
+            dpad[0, : task.plen] = prompt
             dprefill = gen.draft_slot_prefill(dbucket)
             self._dcache = dprefill(
                 gen.draft_params, self._dcache, dpad, np.int32(task.slot)
@@ -1883,6 +2314,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self._settle_abandoned(req)
                 self._release(slot)
                 continue
+            if self._deadline_expired(req, self._now):
+                self._cancel_deadline_decode(slot, req)
+                continue
             self._emit_token(slot, req, int(toks[slot]))
 
     def _decode_tick_spec(self) -> None:
@@ -1910,6 +2344,31 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._emit_spec(toks, n_emit, n_draft)
 
     # ------------------------------------------------------------- plumbing
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Bank the victim's valid full KV blocks in the prefix cache BEFORE
+        releasing the slot, keyed by prompt+banked+generated — the resume's
+        ``_plan`` computes exactly those keys, so every banked block
+        re-matches and the resume prefills only the unwritten tail. The
+        last emitted token's KV is NOT yet written (it writes on the next
+        decode step), so only ``(ctx - 1) // block_len`` blocks are
+        bankable. Under continued pressure the cache's normal LRU eviction
+        reclaims banked blocks like any other entry (the resume then
+        re-prefills from scratch — slower, never wrong)."""
+        req = self._slot_req[slot]
+        ctx = (
+            list(req.prompt)
+            + list(req.preempted_tokens)
+            + self._slot_tokens[slot]
+        )
+        full = (len(ctx) - 1) // self._block_len
+        if full > 0:
+            keys = self._prefix.block_keys(ctx)
+            self._prefix.insert(keys[:full], self._slot_blocks[slot][:full])
+        super()._preempt_slot(slot)
+
+    def _occupancy(self) -> float:
+        return self._allocator.used_count / max(1, self._num_blocks - 1)
 
     def _release(self, slot: int) -> None:
         for bid in self._slot_blocks[slot]:

@@ -91,9 +91,39 @@ class CircuitOpenError(ServingError):
 
 class QueueOverflowError(ServingError):
     """Bounded admission queue is full; shed at submit with 429 and a
-    Retry-After derived from observed service time."""
+    Retry-After derived from observed service time. Carries the shed
+    request's priority tier so clients (and the fleet router) can tell a
+    best-effort displacement from total saturation."""
 
     kind = "queue_overflow"
+    status = 429
+    retryable = True
+
+    def __init__(
+        self,
+        message: str,
+        retry_after_s: Optional[float] = None,
+        generation: Optional[int] = None,
+        tier: Optional[str] = None,
+    ):
+        super().__init__(message, retry_after_s, generation)
+        self.tier = tier
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        if self.tier is not None:
+            d["tier"] = self.tier
+        return d
+
+
+class BrownoutShedError(QueueOverflowError):
+    """Brownout stage 3: the engine is shedding ``best_effort`` traffic
+    before it ever enqueues. A subclass of QueueOverflowError so the fleet
+    router's overflow reroute (try a sibling, then the aggregate 429 with
+    min predicted drain) applies unchanged — a replica in brownout looks
+    exactly like a full replica to placement."""
+
+    kind = "brownout_shed"
     status = 429
     retryable = True
 
@@ -105,6 +135,33 @@ class QueueDeadlineError(ServingError):
     kind = "queue_deadline"
     status = 503
     retryable = True
+
+
+class DeadlineExceededError(ServingError):
+    """The request's client-supplied deadline (``deadline_ms``) expired —
+    at queue, at prefill start, or mid-decode at a scheduler tick. Not
+    retryable as-is (the client's budget is spent); the body carries the
+    tokens generated before cancellation so partial work is not lost."""
+
+    kind = "deadline_exceeded"
+    status = 504
+    retryable = False
+
+    def __init__(
+        self,
+        message: str,
+        tokens: Optional[Tuple[int, ...]] = None,
+        retry_after_s: Optional[float] = None,
+        generation: Optional[int] = None,
+    ):
+        super().__init__(message, retry_after_s, generation)
+        self.tokens = list(tokens) if tokens else []
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["tokens_generated"] = len(self.tokens)
+        d["partial_tokens"] = [int(t) for t in self.tokens]
+        return d
 
 
 class DrainingError(ServingError):

@@ -65,6 +65,7 @@ from collections import OrderedDict, deque
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from llm_fine_tune_distributed_tpu.infer.errors import (
+    BrownoutShedError,
     CircuitOpenError,
     DrainingError,
     FatalEngineError,
@@ -108,6 +109,7 @@ class EngineFleet:
         "requests_failed_over",
         "requests_rerouted_overflow",
         "requests_shed_fleet_saturated",
+        "requests_shed_fleet_brownout",
     )
 
     def __init__(
@@ -165,6 +167,7 @@ class EngineFleet:
         keys: List[bytes],
         excluded: frozenset,
         adapter: Optional[str] = None,
+        best_effort: bool = False,
     ) -> Optional[Placement]:
         """One placement decision: snapshot views, score, commit router
         state (rotation, intent map, counters, log). Commits at DECISION
@@ -202,10 +205,18 @@ class EngineFleet:
                         and rep.adapter_resident(adapter)
                         else 0
                     ),
+                    # stage-3 brownout replicas leave the candidate set for
+                    # best_effort traffic (fleet-wide tier shed); plain
+                    # stubs without the property read as stage 0
+                    brownout_stage=int(
+                        getattr(rep, "brownout_stage", 0) or 0
+                    ),
                 )
             )
         with self._lock:
-            placement = choose_replica(self.routing, views, self._rr_seq)
+            placement = choose_replica(
+                self.routing, views, self._rr_seq, best_effort=best_effort
+            )
             if placement is None:
                 return None
             self._rr_seq += 1
@@ -286,6 +297,8 @@ class EngineFleet:
         seed: int,
         timeout: Optional[float],
         adapter: Optional[str] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ):
         """Route, call the replica, and fail over until success or the
         candidate set is exhausted. Each replica is tried at most once per
@@ -295,17 +308,55 @@ class EngineFleet:
         (replicas that declare ``SUPPORTS_TRACE``), so the router decision,
         each failed hop, and the completing replica's lifecycle all land in
         one timeline under one propagated trace id."""
+        if deadline_s is not None:
+            # the failover budget derives from the client deadline: a retry
+            # against a sibling past the deadline can only waste its slots
+            timeout = (
+                deadline_s if timeout is None else min(timeout, deadline_s)
+            )
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
+        client_deadline = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        best_effort = priority == "best_effort"
         keys = self._keys(prompt_ids)
         trace = RequestTrace()
         excluded: set = set()
         overflowed: Dict[int, QueueOverflowError] = {}
         last_err: Optional[BaseException] = None
         while True:
-            placement = self._route(keys, frozenset(excluded), adapter)
+            placement = self._route(
+                keys, frozenset(excluded), adapter, best_effort=best_effort
+            )
             if placement is None:
+                if best_effort:
+                    browned = [
+                        rep
+                        for i, rep in enumerate(self.replicas)
+                        if i not in excluded
+                        and rep.healthy
+                        and not rep.draining
+                        and not rep.recovering
+                        and int(getattr(rep, "brownout_stage", 0) or 0) >= 3
+                    ]
+                    if browned:
+                        # candidates exist but every one of them is browning
+                        # out best_effort: the FLEET's tier-labelled 429
+                        self._count("requests_shed_fleet_brownout")
+                        drains = [
+                            rep.predicted_drain_s()
+                            for rep in browned
+                            if getattr(rep, "predicted_drain_s", None)
+                            is not None
+                        ]
+                        raise BrownoutShedError(
+                            f"all {len(browned)} available replica(s) in "
+                            "brownout stage 3: best_effort shed fleet-wide",
+                            retry_after_s=min(drains) if drains else None,
+                            tier="best_effort",
+                        )
                 raise self._exhausted_error(overflowed, last_err)
             trace.mark(
                 f"router_decision replica={placement.index} "
@@ -327,6 +378,16 @@ class EngineFleet:
             kwargs = dict(seed=seed, timeout=remaining)
             if adapter is not None:
                 kwargs["adapter"] = adapter
+            # opt-in like the adapter: plain stubs keep their bare
+            # signatures, real engines get the tier and the REMAINING
+            # client budget (the deadline is absolute end-to-end, so each
+            # failover hop hands the next replica what is left of it)
+            if priority is not None:
+                kwargs["priority"] = priority
+            if client_deadline is not None:
+                kwargs["deadline_s"] = max(
+                    client_deadline - time.monotonic(), 0.001
+                )
             # same opt-in shape for the trace: scripted test replicas keep
             # their bare submit signature, real engines adopt the timeline
             if getattr(replica, "SUPPORTS_TRACE", False):
@@ -357,8 +418,13 @@ class EngineFleet:
         seed: int = 0,
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> List[int]:
-        return self.submit_full(prompt_ids, gen, seed, timeout, adapter).result
+        return self.submit_full(
+            prompt_ids, gen, seed, timeout, adapter,
+            priority=priority, deadline_s=deadline_s,
+        ).result
 
     def submit_full(
         self,
@@ -367,10 +433,17 @@ class EngineFleet:
         seed: int = 0,
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ):
-        """Blocking request with placement + failover (engine parity)."""
+        """Blocking request with placement + failover (engine parity).
+        ``deadline_s`` bounds the WHOLE fleet attempt — placement, every
+        failover hop, and the winning replica's decode all spend the same
+        budget; a DeadlineExceededError from a replica is final (never
+        retried: the client's budget is spent)."""
         return self._dispatch(
-            "submit_full", prompt_ids, gen, seed, timeout, adapter
+            "submit_full", prompt_ids, gen, seed, timeout, adapter,
+            priority=priority, deadline_s=deadline_s,
         )
 
     def stream(
@@ -380,13 +453,18 @@ class EngineFleet:
         seed: int = 0,
         timeout: Optional[float] = None,
         adapter: Optional[str] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> Iterator[int]:
         """Streaming request. Admission-time rejections (overflow, drain,
         replica terminal) fail over exactly like ``submit``; once the
         iterator is handed out, a mid-stream failure surfaces to the
         caller — tokens may already be with the client, and replaying on a
         sibling would emit them twice."""
-        return self._dispatch("stream", prompt_ids, gen, seed, timeout, adapter)
+        return self._dispatch(
+            "stream", prompt_ids, gen, seed, timeout, adapter,
+            priority=priority, deadline_s=deadline_s,
+        )
 
     def mark_compile_warm(self) -> None:
         """Fan warmup-over out to every replica's compile ledger."""
@@ -479,10 +557,13 @@ class EngineFleet:
             vals = [s[key] for s in snaps]
             # generations are epochs, not occupancy: the fleet's restart
             # epoch and weight generation are the furthest any replica has
-            # advanced (mid-rolling-swap the replicas legitimately differ)
+            # advanced (mid-rolling-swap the replicas legitimately differ).
+            # brownout_stage is a severity, not a quantity: the fleet
+            # reports its most-degraded replica
             agg[key] = (
                 max(vals)
-                if key in ("engine_generation", "weight_generation")
+                if key
+                in ("engine_generation", "weight_generation", "brownout_stage")
                 else sum(vals)
             )
         agg["tokens_per_s_1m"] = sum(s["tokens_per_s_1m"] for s in snaps)
@@ -529,6 +610,14 @@ class EngineFleet:
                 for k in ServingStats.TENANT_KEYS:
                     mine[k] += int(rec.get(k, 0))
         agg["per_tenant"] = tenants
+        # tier-labelled shed counters merge by summing per tier (same
+        # shape as the per-tenant merge: one tier's sheds may come from
+        # several replicas)
+        by_tier: Dict[str, int] = {t: 0 for t in ServingStats.SHED_TIERS}
+        for s in snaps:
+            for t, n in (s.get("requests_shed_by_tier") or {}).items():
+                by_tier[t] = by_tier.get(t, 0) + int(n)
+        agg["requests_shed_by_tier"] = by_tier
         agg["histograms"] = {
             name: h.summary() for name, h in self.merged_histograms().items()
         }

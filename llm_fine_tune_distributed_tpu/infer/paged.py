@@ -103,6 +103,16 @@ class PrefixCache:
     blocks are FULL prompt blocks, and a consumer's writes start at its
     block-aligned divergence point — shared blocks are immutable, divergent
     suffixes land in freshly allocated blocks.
+
+    Preemption banking (KV-pressure overload control, infer/engine.py):
+    when the engine reclaims a low-tier slot, it inserts the victim's FULL
+    context blocks — prompt plus tokens generated so far, all but the last
+    emitted token whose KV was never written — under exactly the keys the
+    resume's admission plan will compute over prompt + banked tokens. The
+    resume re-matches them and re-prefills only the unbanked tail; under
+    continued pressure LRU may reclaim banked blocks first (a slower
+    resume, never a wrong one, by the same lost-reuse guarantee as any
+    eviction).
     """
 
     def __init__(self, allocator: BlockAllocator, block_len: int):

@@ -72,6 +72,11 @@ class ReplicaView:
     slots: int = 1
     prefix_hits: int = 0  # leading full prompt blocks resident on this replica
     adapter_hits: int = 0  # 1 if the request's adapter is resident here
+    # overload control: the replica's brownout stage (0 healthy .. 3
+    # shedding best_effort). Stage-3 replicas leave the candidate set for
+    # best_effort requests — the router sheds that tier fleet-wide before
+    # each engine's own admission gate has to
+    brownout_stage: int = 0
 
     @property
     def available(self) -> bool:
@@ -106,19 +111,24 @@ def choose_replica(
     policy: str,
     views: Sequence[ReplicaView],
     rr_seq: int = 0,
+    best_effort: bool = False,
 ) -> Optional[Placement]:
     """Deterministic placement over the available views; None if none are.
 
     ``rr_seq`` is the router's monotonically increasing placement counter;
     it drives the round-robin rotation AND breaks exact load ties under
     the other policies, so the decision is a pure function of
-    (policy, views, rr_seq).
+    (policy, views, rr_seq, best_effort). ``best_effort`` requests also
+    exclude stage-3 brownout replicas (fleet-wide tier shedding); higher
+    tiers route through brownout normally.
     """
     if policy not in ROUTING_POLICIES:
         raise ValueError(
             f"unknown routing policy {policy!r}; choose from {ROUTING_POLICIES}"
         )
     cands = [v for v in views if v.available]
+    if best_effort:
+        cands = [v for v in cands if v.brownout_stage < 3]
     if not cands:
         return None
     if policy == "round-robin":

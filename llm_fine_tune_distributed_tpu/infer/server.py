@@ -20,13 +20,22 @@ closes that gap with a dependency-free stdlib server exposing:
                   continuous/paged engines — the request's rows gather
                   that adapter's delta inside the shared batch),
                   "trace" (true -> response carries the request's
-                  lifecycle span timeline)}
+                  lifecycle span timeline),
+                  "priority" ("interactive" | "batch" | "best_effort" —
+                  admission tier; continuous/paged engines order by aged
+                  tier and shed/preempt the lowest tier first under
+                  pressure; default --priority-default),
+                  "deadline_ms" (client budget for the whole request —
+                  queue + prefill + decode; on expiry the engine cancels
+                  it wherever it is and the 504 body carries the tokens
+                  generated so far)}
 
 Failures surface through the taxonomy in infer/errors.py: queue overflow
 is a 429 with a finite ``Retry-After`` derived from observed service time,
-engine restarts / drain / queue-deadline sheds are 503s (retryable), and
-fatal engine states are 500s — all with a structured ``{"error": {kind,
-message, retryable, ...}}`` body. SIGTERM starts a graceful drain:
+engine restarts / drain / queue-deadline sheds are 503s (retryable),
+brownout sheds are tier-labelled 429s, client-deadline expiries are 504s
+carrying partial tokens, and fatal engine states are 500s — all with a
+structured ``{"error": {kind, message, retryable, ...}}`` body. SIGTERM starts a graceful drain:
 admission closes (503 + Retry-After), ``/healthz`` reports ``draining``,
 in-flight requests finish up to ``--drain-timeout-s``, then the process
 exits 0.
@@ -97,6 +106,11 @@ def serve(
     prefill_chunk: int = 512,
     max_queue_depth: int = 256,
     queue_deadline_s: Optional[float] = None,
+    priority_default: str = "interactive",
+    age_promote_s: float = 5.0,
+    brownout_queue_wait_s: float = 2.0,
+    brownout_drain_s: float = 10.0,
+    brownout_cap_tokens: int = 32,
     drain_timeout_s: float = 30.0,
     restart_backoff_s: float = 0.5,
     restart_backoff_max_s: float = 30.0,
@@ -124,7 +138,10 @@ def serve(
         load_tokenizer_dir,
     )
 
-    from llm_fine_tune_distributed_tpu.infer.batching import BatchingEngine
+    from llm_fine_tune_distributed_tpu.infer.batching import (
+        PRIORITY_TIERS,
+        BatchingEngine,
+    )
     from llm_fine_tune_distributed_tpu.infer.errors import (
         DrainingError,
         ServingError,
@@ -274,6 +291,14 @@ def serve(
         "speculative_k": speculative_k,
         "flight_dir": flight_dir or None,
         "trace_log": trace_log or None,
+        # overload control (infer/engine.py): default priority tier for
+        # requests that don't name one, anti-starvation aging rate, and the
+        # brownout controller's pressure budgets / best_effort token cap
+        "priority_default": priority_default,
+        "age_promote_s": age_promote_s,
+        "brownout_queue_wait_s": brownout_queue_wait_s,
+        "brownout_drain_s": brownout_drain_s,
+        "brownout_cap_tokens": brownout_cap_tokens,
     }
     if engine_kind in ("continuous", "paged"):
         if coordinator is not None:
@@ -389,6 +414,42 @@ def serve(
             + ")"
         )
     drain_state = {"draining": False}
+
+    def parse_overload_fields(req: dict):
+        """Shared /v1/generate + /v1/stream parsing for the overload-control
+        request fields: ``priority`` (tier name) and ``deadline_ms`` (client
+        budget for the WHOLE request — queue wait, prefill, and decode; on
+        expiry the engine cancels it wherever it is and the 504 carries the
+        tokens generated so far). Both need a slot engine: the window
+        engine's batcher has no scheduler tick to enforce either."""
+        priority = req.get("priority") or None
+        if priority is not None:
+            if priority not in PRIORITY_TIERS:
+                raise ValueError(
+                    f"'priority' must be one of {PRIORITY_TIERS}, "
+                    f"got {priority!r}"
+                )
+            if cont_engine is None:
+                raise ValueError(
+                    "'priority' needs a continuous/paged engine; this "
+                    "server runs the window engine, which admits FIFO"
+                )
+        deadline_s = None
+        if req.get("deadline_ms") is not None:
+            deadline_s = float(req["deadline_ms"]) / 1000.0
+            if not deadline_s > 0:
+                raise ValueError(
+                    f"'deadline_ms' must be a positive number of "
+                    f"milliseconds, got {req['deadline_ms']!r}"
+                )
+            if cont_engine is None:
+                raise ValueError(
+                    "'deadline_ms' needs a continuous/paged engine; this "
+                    "server runs the window engine, which cannot cancel "
+                    "mid-decode"
+                )
+        return priority, deadline_s
+
     print(
         f"Model ready (engine={cont_kind}, "
         + (f"replicas={replicas}, routing={routing}, " if replicas > 1 else "")
@@ -574,6 +635,7 @@ def serve(
                         "(base model), or a server started with "
                         "--engine continuous|paged --adapter-dir DIR"
                     )
+                priority, deadline_s = parse_overload_fields(req)
                 gen_kwargs = {
                     k: cast(req[k])
                     for k, cast in self._FIELD_CASTS.items()
@@ -617,6 +679,8 @@ def serve(
                         seed=seed,
                         timeout=request_timeout_s,
                         adapter=adapter,
+                        priority=priority,
+                        deadline_s=deadline_s,
                     )
                 except (ServingError, TimeoutError) as e:
                     self._send_error(e)
@@ -806,6 +870,22 @@ def serve(
                         "engine, which has no adapter pool); drop "
                         "'speculative' or restart with --speculative K"
                     )
+                priority, deadline_s = parse_overload_fields(req)
+                if (
+                    (priority is not None or deadline_s is not None)
+                    and gen_kwargs.get("speculative_lookup", 0) > 0
+                    and not speculative_k
+                ):
+                    # same fallback trap as 'adapter': a speculative request
+                    # on a K=0 slot engine rides the window engine, which
+                    # has no admission scheduler to honor either field
+                    raise ValueError(
+                        "'priority'/'deadline_ms' with 'speculative' needs "
+                        "the server started with --speculative K (on a K=0 "
+                        "engine speculative requests fall back to the "
+                        "window engine); drop 'speculative' or restart "
+                        "with --speculative K"
+                    )
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"bad request: {e}"})
                 return
@@ -835,6 +915,8 @@ def serve(
                         seed=seed,
                         timeout=request_timeout_s,
                         adapter=adapter,
+                        priority=priority,
+                        deadline_s=deadline_s,
                     )
                 else:
                     pending = engine.submit_full(
@@ -1055,6 +1137,34 @@ def main(argv: Optional[list] = None) -> int:
              "prefill (503, retryable; 0 = no deadline)",
     )
     parser.add_argument(
+        "--priority-default", choices=["interactive", "batch", "best_effort"],
+        default="interactive",
+        help="continuous/paged engines: priority tier assumed for requests "
+             "that send no 'priority' field (admission orders by aged tier; "
+             "under pressure the lowest tier sheds and preempts first)",
+    )
+    parser.add_argument(
+        "--age-promote-s", type=float, default=5.0,
+        help="anti-starvation: every this-many seconds a queued request "
+             "waits, it is ordered as one tier more important (raw tier "
+             "still governs shedding/preemption)",
+    )
+    parser.add_argument(
+        "--brownout-queue-wait-s", type=float, default=2.0,
+        help="brownout pressure budget: queue-wait EWMA at this many "
+             "seconds counts as pressure 1.0",
+    )
+    parser.add_argument(
+        "--brownout-drain-s", type=float, default=10.0,
+        help="brownout pressure budget: predicted queue drain time at this "
+             "many seconds counts as pressure 1.0",
+    )
+    parser.add_argument(
+        "--brownout-cap-tokens", type=int, default=32,
+        help="brownout stage >= 2: max_new_tokens cap applied to "
+             "best_effort requests admitted during the brownout",
+    )
+    parser.add_argument(
         "--drain-timeout-s", type=float, default=30.0,
         help="SIGTERM grace: how long in-flight requests may finish before "
              "the server exits anyway",
@@ -1140,6 +1250,11 @@ def main(argv: Optional[list] = None) -> int:
           prefill_chunk=args.prefill_chunk,
           max_queue_depth=args.max_queue_depth,
           queue_deadline_s=args.queue_deadline_s or None,
+          priority_default=args.priority_default,
+          age_promote_s=args.age_promote_s,
+          brownout_queue_wait_s=args.brownout_queue_wait_s,
+          brownout_drain_s=args.brownout_drain_s,
+          brownout_cap_tokens=args.brownout_cap_tokens,
           drain_timeout_s=args.drain_timeout_s,
           restart_backoff_s=args.restart_backoff_s,
           restart_backoff_max_s=args.restart_backoff_max_s,

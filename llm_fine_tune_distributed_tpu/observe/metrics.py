@@ -78,6 +78,11 @@ class ServingStats:
         # live deployment (infer/deploy.py): checkpoint hot-swaps applied at
         # a tick boundary, and rollbacks to the previous weight buffer
         "weight_swaps", "weight_rollbacks",
+        # overload control (infer/engine.py): slots reclaimed from a
+        # lower-tier request to admit a higher-tier one, and requests
+        # cancelled mid-decode by an expired client deadline (the
+        # pre-prefill expiry stays in requests_shed_deadline)
+        "preemptions", "requests_shed_deadline_decode",
     )
     GAUGES = (
         "queue_depth", "live_slots", "engine_generation",
@@ -87,7 +92,16 @@ class ServingStats:
         # hot-swap (rollbacks included — a rollback is a swap to the previous
         # buffer, not a counter rewind)
         "weight_generation",
+        # staged degradation under pressure (0 = healthy .. 3 = shedding
+        # best_effort); a fleet reports the max across replicas
+        "brownout_stage",
     )
+    # tier-labelled shed counters (``requests_shed_by_tier`` in the
+    # snapshot): every priority tier is always present so the /v1/stats and
+    # /metrics schemas are identical with zero sheds. Mirrors
+    # infer/batching.PRIORITY_TIERS (kept literal here so observe/ stays
+    # import-independent of infer/).
+    SHED_TIERS = ("interactive", "batch", "best_effort")
     # the per-tenant record's exact key set (pinned by
     # tests/test_metrics_schema.py so the /v1/stats schema cannot drift)
     TENANT_KEYS = ("requests", "tokens", "queue_depth")
@@ -109,6 +123,9 @@ class ServingStats:
         }
         # per-tenant multi-tenant counters: tenant -> {TENANT_KEYS: int}
         self._tenants: Dict[str, Dict[str, int]] = {}
+        # tier-labelled sheds (overflow + brownout + displacement), every
+        # tier always present (schema stability with zero sheds)
+        self._tier_shed: Dict[str, int] = {t: 0 for t in self.SHED_TIERS}
         self.hist: Dict[str, Histogram] = {
             name: (
                 Histogram.linear(0.0, 16.0, 1.0)
@@ -158,6 +175,19 @@ class ServingStats:
                 for k in self.TENANT_KEYS:
                     mine[k] += int(rec.get(k, 0))
 
+    def tier_shed_incr(self, tier: str, n: int = 1) -> None:
+        """Bump one priority tier's shed counter (overflow, brownout, or
+        displacement — anything resolved with a tier-labelled 429)."""
+        with self._lock:
+            self._tier_shed[tier] = self._tier_shed.get(tier, 0) + n
+
+    def tier_shed_merge(self, by_tier: Dict[str, int]) -> None:
+        """Fold another snapshot's ``requests_shed_by_tier`` map into this
+        one (fleet aggregation: replica shed counts sum)."""
+        with self._lock:
+            for tier, n in by_tier.items():
+                self._tier_shed[tier] = self._tier_shed.get(tier, 0) + int(n)
+
     def observe(self, name: str, value: float) -> None:
         """Record one histogram observation (histograms carry their own
         locks, so this does not contend with the counter lock)."""
@@ -189,6 +219,7 @@ class ServingStats:
             out["per_tenant"] = {
                 tenant: dict(rec) for tenant, rec in self._tenants.items()
             }
+            out["requests_shed_by_tier"] = dict(self._tier_shed)
         out["uptime_s"] = now - self.started_at
         out["slots"] = self.slots
         out["slot_occupancy"] = (
@@ -238,6 +269,7 @@ FLEET_COUNTERS = (
     "requests_failed_over",
     "requests_rerouted_overflow",
     "requests_shed_fleet_saturated",
+    "requests_shed_fleet_brownout",
 )
 
 
@@ -327,6 +359,16 @@ def prometheus_exposition(
                 f'{name}{{tenant="{tenant}"}} '
                 f"{int(per_tenant[tenant].get(key, 0))}"
             )
+    # tier-labelled shed samples: ``requests_shed_by_tier`` is a dict value
+    # (skipped by the numeric loop), emitted explicitly with a ``tier``
+    # label. TYPE is UNCONDITIONAL and every known tier always has a sample
+    # (ServingStats seeds all tiers at 0), so the schema cannot drift with
+    # load. Snapshots without the key (window engine) emit the bare TYPE.
+    by_tier = snap.get("requests_shed_by_tier") or {}
+    name = f"{prefix}_requests_shed_tier_total"
+    lines.append(f"# TYPE {name} counter")
+    for tier in sorted(by_tier):
+        lines.append(f'{name}{{tier="{tier}"}} {int(by_tier[tier])}')
     # compile-ledger samples: ``compile`` is a nested dict (skipped by the
     # numeric loop), so per-program compile counts/seconds are emitted
     # explicitly with a ``program`` label. TYPE lines are UNCONDITIONAL so

@@ -59,8 +59,15 @@ SNAPSHOT_KEYS = {
     "adapter_loads", "adapter_evictions", "requests_shed_tenant_quota",
     # live deployment (infer/deploy.py): applied hot-swaps / rollback swaps
     "weight_swaps", "weight_rollbacks",
+    # overload control (infer/engine.py): KV-pressure slot preemptions and
+    # client-deadline cancellations that had already consumed decode work
+    "preemptions", "requests_shed_deadline_decode",
+    # overload control: tier name -> requests shed from that tier
+    "requests_shed_by_tier",
     # gauges
     "queue_depth", "live_slots", "engine_generation", "weight_generation",
+    # overload control: the brownout controller's current stage (0-3)
+    "brownout_stage",
     "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
     "adapters_resident",
     # multi-tenant LoRA: tenant -> {requests, tokens, queue_depth}
@@ -121,6 +128,11 @@ EXPECTED_METRICS = {
     ("serving_requests_shed_tenant_quota_total", "counter"),
     ("serving_weight_swaps_total", "counter"),
     ("serving_weight_rollbacks_total", "counter"),
+    # overload control (tier="..." labels on the shed-by-tier counter; TYPE
+    # lines emitted even at stage 0 so the schema is load-independent)
+    ("serving_preemptions_total", "counter"),
+    ("serving_requests_shed_deadline_decode_total", "counter"),
+    ("serving_requests_shed_tier_total", "counter"),
     # per-tenant series (tenant="name" labels; TYPE lines are emitted even
     # with zero tenants so the schema is load-independent)
     ("serving_tenant_requests_total", "counter"),
@@ -146,6 +158,7 @@ EXPECTED_METRICS = {
     ("serving_draft_acceptance_rate", "gauge"),
     ("serving_mean_tokens_per_step", "gauge"),
     ("serving_draining", "gauge"),
+    ("serving_brownout_stage", "gauge"),
     # XLA introspection: per-program compile counters (program="..."
     # labels; TYPE lines emitted even with an empty ledger) + roofline
     # utilization gauges
@@ -212,6 +225,12 @@ def test_metrics_exposition_well_formed():
     assert re.search(r'serving_ttft_seconds_bucket\{le="0\.1024"\} 0', text)
     assert re.search(r'serving_ttft_seconds_bucket\{le="0\.2048"\} 1', text)
     assert "serving_ttft_seconds_count 1" in text
+    # overload control: every tier has a shed sample even with zero sheds,
+    # and the brownout gauge reports stage 0 on a healthy engine
+    assert 'serving_requests_shed_tier_total{tier="interactive"} 0' in text
+    assert 'serving_requests_shed_tier_total{tier="batch"} 0' in text
+    assert 'serving_requests_shed_tier_total{tier="best_effort"} 0' in text
+    assert "serving_brownout_stage 0" in text
 
 
 # The fleet /v1/stats contract: everything a single paged engine reports,
@@ -224,6 +243,7 @@ FLEET_EXTRA_KEYS = {
     "requests_routed_least_loaded",
     "requests_routed_round_robin", "requests_failed_over",
     "requests_rerouted_overflow", "requests_shed_fleet_saturated",
+    "requests_shed_fleet_brownout",
 }
 
 # The fleet /metrics contract: the single-engine TYPE set plus the router
@@ -242,6 +262,7 @@ FLEET_EXPECTED_METRICS = EXPECTED_METRICS | {
     ("serving_requests_failed_over_total", "counter"),
     ("serving_requests_rerouted_overflow_total", "counter"),
     ("serving_requests_shed_fleet_saturated_total", "counter"),
+    ("serving_requests_shed_fleet_brownout_total", "counter"),
 }
 
 
