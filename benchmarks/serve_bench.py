@@ -71,7 +71,12 @@ small paged engine; exits nonzero if interactive p99 TTFT degrades beyond
 SERVE_OVERLOAD_TTFT_FLOOR_S=1.0 — or any request ends without a terminal
 result: tokens, a 504, or a tier-labelled 429;
 SERVE_OVERLOAD_BASE_CLIENTS=3, SERVE_OVERLOAD_BURST=10,
-SERVE_OVERLOAD_REQS_PER_CLIENT=3). Every
+SERVE_OVERLOAD_REQS_PER_CLIENT=3), SERVE_QUANT=1 (quantized-serving arm:
+a memory/slot sweep at a FIXED KV-pool byte budget — bf16 pool vs int8
+pool vs int8 pool + int8 weights — reporting slots sustained, tokens/sec,
+hbm_bandwidth_utilization, and greedy parity vs the bf16 arm; exits
+nonzero if the int8 pool sustains fewer than 1.8x the bf16 arm's decode
+slots at equal bf16-equivalent pool bytes, or any request errors). Every
 engine-backed JSON line also carries the XLA
 introspection gauges: mfu, hbm_bw_util, compiles_total,
 compile_seconds_total.
@@ -1189,6 +1194,149 @@ def main():
             "model": preset,
             "platform": jax.devices()[0].platform,
             "slots": min(slots, 4),
+        }), flush=True)
+        if not ok:
+            sys.exit(1)
+
+    # quantized-serving arm (ISSUE 12): at a FIXED KV-pool byte budget, how
+    # many decode slots does each layout sustain, and at what throughput?
+    # The budget is expressed in bf16-equivalent bytes (2/elem) so the slot
+    # math is platform-independent: the CPU tier's f32 test pool and a
+    # TPU's real bf16 pool size their arms identically. Decode is HBM-
+    # bandwidth-bound, so halving pool bytes/token is the lever that
+    # matters — the int8 arm must convert it into >= 1.8x resident slots.
+    if os.environ.get("SERVE_QUANT", "1") == "1":
+        from llm_fine_tune_distributed_tpu.infer.batching import (
+            GenerationConfig,
+        )
+        from llm_fine_tune_distributed_tpu.ops.int8 import maybe_quantize
+
+        q_block_len = 32
+        q_buf_len = 64
+        q_bucket = 32
+        q_prompt_len = 24
+        q_max_new = 8
+        # per-block element count straight from the model geometry: k + v,
+        # every layer, one block
+        n_layers = int(getattr(mc, "num_layers"))
+        kv_heads = int(getattr(mc, "num_kv_heads"))
+        head_dim = int(
+            getattr(mc, "head_dim", None)
+            or mc.hidden_size // mc.num_heads
+        )
+        elems_per_block = n_layers * q_block_len * kv_heads * head_dim * 2
+        bf16_block_bytes = elems_per_block * 2
+        int8_block_bytes = elems_per_block + n_layers * 2 * kv_heads * 4
+        # table width the engine will allocate per live slot
+        table_blocks = -(-(q_buf_len + q_bucket) // q_block_len)
+        # budget: a bf16 pool of 4 slots' tables + the null block
+        budget = bf16_block_bytes * (1 + 4 * table_blocks)
+        arms = {
+            "bf16": (budget // bf16_block_bytes, generator),
+            "int8_kv": (budget // int8_block_bytes, generator),
+        }
+        int8_gen = Generator(
+            maybe_quantize(
+                init_params(jax.random.PRNGKey(0), mc, dtype=dtype), "int8"
+            ),
+            mc, ByteChatMLTokenizer(), compute_dtype=dtype, eos_token_ids=[],
+        )
+        arms["int8_kv_int8_w"] = (budget // int8_block_bytes, int8_gen)
+
+        q_rng = np.random.RandomState(7)
+        q_cfg = GenerationConfig(max_new_tokens=q_max_new, do_sample=False)
+        arm_slots = {}
+        arm_outputs = {}
+        arm_errors = {}
+        for name, (num_blocks, gen) in arms.items():
+            n_slots = max(1, (num_blocks - 1) // table_blocks)
+            arm_slots[name] = n_slots
+            q_engine = PagedContinuousBatchingEngine(
+                gen, slots=n_slots, buf_len=q_buf_len,
+                prompt_bucket=q_bucket, block_len=q_block_len,
+                prefill_chunk=q_bucket, num_blocks=num_blocks,
+                kv_quant="none" if name == "bf16" else "int8",
+            )
+            prompts = [
+                q_rng.randint(1, mc.vocab_size, size=q_prompt_len).tolist()
+                for _ in range(n_slots * 2)
+            ]
+            q_rng = np.random.RandomState(7)  # same prompts every arm
+            q_engine.submit(prompts[0], q_cfg)  # warm
+            outs = [None] * len(prompts)
+            errs = []
+
+            def q_client(i, p, eng=q_engine, outs=outs, errs=errs):
+                try:
+                    outs[i] = eng.submit(p, q_cfg, timeout=240)
+                except Exception as e:  # noqa: BLE001 — reported in the line
+                    errs.append(f"{type(e).__name__}: {e}")
+
+            t0 = time.monotonic()
+            threads = [
+                threading.Thread(target=q_client, args=(i, p))
+                for i, p in enumerate(prompts)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+            dt = time.monotonic() - t0
+            arm_outputs[name] = outs
+            arm_errors[name] = errs
+            snap = q_engine.stats_snapshot()
+            mem = q_engine.memory_breakdown()
+            print(json.dumps({
+                "metric": f"serve_quant_tokens_per_sec_{name}",
+                "value": round(
+                    sum(len(o) for o in outs if o) / dt if dt > 0 else 0.0, 2
+                ),
+                "unit": "tokens/sec",
+                "arm": name,
+                "slots_sustained": n_slots,
+                "num_blocks": num_blocks,
+                "kv_pool_budget_bytes_bf16_equiv": budget,
+                "kv_pool_bytes": mem["kv_pool_bytes"],
+                "kv_scale_bytes": mem["kv_scale_bytes"],
+                "weight_bytes": mem["weight_bytes"],
+                "bytes_saved_vs_bf16": mem["bytes_saved_vs_bf16"],
+                "hbm_bandwidth_utilization": round(
+                    snap["hbm_bandwidth_utilization"], 6
+                ),
+                "peak_block_pool_occupancy": round(
+                    snap["peak_block_pool_occupancy"], 4
+                ),
+                "errors": errs,
+                "model": preset,
+                "platform": jax.devices()[0].platform,
+            }), flush=True)
+
+        parity = {
+            name: sum(
+                1 for a, b in zip(arm_outputs["bf16"], arm_outputs[name])
+                if a == b
+            ) / max(1, len(arm_outputs["bf16"]))
+            for name in arm_outputs
+        }
+        slot_ratio = arm_slots["int8_kv"] / max(1, arm_slots["bf16"])
+        ok = (
+            slot_ratio >= 1.8
+            and not any(arm_errors.values())
+            and all(o is not None for outs in arm_outputs.values()
+                    for o in outs)
+        )
+        print(json.dumps({
+            "metric": "serve_quant_slot_ratio_guard",
+            "value": 1 if ok else 0,
+            "unit": "1 = int8 KV sustains >= 1.8x bf16 decode slots at "
+                    "equal bf16-equivalent pool bytes, zero errors",
+            "slot_ratio": round(slot_ratio, 3),
+            "slots": arm_slots,
+            "greedy_match_vs_bf16": {
+                k: round(v, 3) for k, v in parity.items()
+            },
+            "model": preset,
+            "platform": jax.devices()[0].platform,
         }), flush=True)
         if not ok:
             sys.exit(1)

@@ -223,6 +223,102 @@ def _cow_swap_tree(params, updates):
     return rec(params, updates, ()), len(updates)
 
 
+def _requantize_updates(params, updates):
+    """Translate published full-precision leaves into the resident quantized
+    serving format (``--quantize-weights int8|nf4``) before the COW swap.
+
+    A trainer publishes plain ``.../kernel`` leaves; a quantized server holds
+    ``kernel_int8``/``kernel_nf4`` sibling leaves instead. For each update
+    whose leaf is absent from the resident parent but whose quantized
+    siblings are present, re-quantize the published array into the SAME
+    layout (int8 per-channel, or NF4 at the resident block size and
+    double-quant setting) — shapes come out identical to the resident
+    leaves, so the warm jit caches survive exactly as for a bf16 swap. A
+    published leaf that cannot be reconciled (quantizer constraint, layout
+    drift) raises ``ServingError`` so the caller sees a clear verdict
+    instead of a KeyError from deep inside the tree walk. Updates that
+    target plain resident leaves pass through untouched.
+    """
+    from llm_fine_tune_distributed_tpu.ops.int8 import (
+        INT8_SUFFIXES,
+        quantize_int8,
+        quantize_int8_stacked,
+    )
+    from llm_fine_tune_distributed_tpu.ops.nf4 import (
+        QUANT_SUFFIXES,
+        quantize_nf4,
+        quantize_nf4_stacked,
+    )
+
+    out = []
+    for where, arr in updates:
+        parent_path, leaf = tuple(where[:-1]), where[-1]
+        node = params
+        for key in parent_path:
+            node = node.get(key) if isinstance(node, dict) else None
+            if node is None:
+                break
+        if not isinstance(node, dict) or leaf in node:
+            # plain resident leaf (or a bad path — _cow_swap_tree raises its
+            # usual error with the full address)
+            out.append((where, arr))
+            continue
+        fmt = (
+            "int8" if f"{leaf}_int8" in node
+            else "nf4" if f"{leaf}_nf4" in node
+            else None
+        )
+        if fmt is None:
+            out.append((where, arr))  # let _cow_swap_tree report the path
+            continue
+        address = "/".join(where)
+        a = np.asarray(arr, dtype=np.float32)
+        try:
+            if fmt == "int8":
+                q = (
+                    quantize_int8(a) if a.ndim == 2 else quantize_int8_stacked(a)
+                )
+                suffixes = INT8_SUFFIXES
+            else:
+                # recover the resident NF4 layout from the sibling shapes:
+                # absmax rows = in-dim / block_size, double-quant iff the
+                # int8 absmax_q form is resident
+                am = node.get(f"{leaf}_absmax_q", node.get(f"{leaf}_absmax"))
+                k_in = a.shape[0] if a.ndim == 2 else a.shape[1]
+                block_size = k_in // int(am.shape[-2])
+                double_quant = f"{leaf}_absmax_q" in node
+                q = (
+                    quantize_nf4(a, block_size=block_size, double_quant=double_quant)
+                    if a.ndim == 2
+                    else quantize_nf4_stacked(
+                        a, block_size=block_size, double_quant=double_quant
+                    )
+                )
+                suffixes = QUANT_SUFFIXES
+        except Exception as e:
+            raise ServingError(
+                f"cannot re-quantize published leaf {address!r} into the "
+                f"resident {fmt} serving format (--quantize-weights {fmt}): "
+                f"{type(e).__name__}: {e}"
+            )
+        for suffix in suffixes:
+            if suffix not in q:
+                continue
+            sib = f"{leaf}_{suffix}"
+            new = np.asarray(q[suffix])
+            old_shape = tuple(getattr(node.get(sib), "shape", ()))
+            if sib not in node or old_shape != tuple(new.shape):
+                raise ServingError(
+                    f"re-quantized leaf {address!r} does not match the "
+                    f"resident {fmt} layout at {sib!r} (resident "
+                    f"{old_shape if sib in node else 'absent'} vs produced "
+                    f"{tuple(new.shape)}) — the published checkpoint and "
+                    f"--quantize-weights {fmt} cannot reconcile"
+                )
+            out.append((parent_path + (sib,), new))
+    return out
+
+
 class ContinuousBatchingEngine:
     """S-slot persistent decode loop with in-flight FIFO admission."""
 
@@ -673,8 +769,73 @@ class ContinuousBatchingEngine:
             return False
         return self._mt.is_resident(name)
 
+    def memory_breakdown(self) -> dict:
+        """Where the resident HBM actually goes: weight bytes, KV-pool bytes,
+        the per-block quantization scales riding alongside the pool, and how
+        many bytes the quantized formats save against an all-bf16 resident
+        set. ``bytes_saved_vs_bf16`` counts only quantized artifacts (int8 /
+        NF4 weight leaves, int8 KV pools) — an unquantized server reports 0
+        even when its test pool happens to be f32."""
+        weight_bytes = 0
+        saved = 0
+        _AUX = (
+            "_int8_scale",
+            "_absmax_offset",
+            "_absmax_scale",
+            "_absmax_q",
+            "_absmax",
+        )
+
+        def walk_weights(node):
+            nonlocal weight_bytes, saved
+            if isinstance(node, dict):
+                for name, child in node.items():
+                    if isinstance(child, dict):
+                        walk_weights(child)
+                        continue
+                    nb = int(getattr(child, "nbytes", 0) or 0)
+                    weight_bytes += nb
+                    if any(name.endswith(s) for s in _AUX):
+                        saved -= nb  # pure quantization overhead
+                    elif name.endswith("_int8"):
+                        saved += 2 * int(child.size) - nb
+                    elif name.endswith("_nf4"):
+                        # packed int32 holds 8 NF4 codes -> 16 bf16 bytes
+                        saved += 16 * int(child.size) - nb
+
+        if self._params is not None:
+            walk_weights(self._params)
+
+        kv_pool_bytes = 0
+        kv_scale_bytes = 0
+        layers = (self._cache or {}).get("layers", {}) if isinstance(
+            self._cache, dict
+        ) else {}
+        for entry in layers.values():
+            if not isinstance(entry, dict):
+                continue
+            quantized = "k_scale" in entry
+            for name, leaf in entry.items():
+                nb = int(getattr(leaf, "nbytes", 0) or 0)
+                if name.endswith("_scale"):
+                    kv_scale_bytes += nb
+                    saved -= nb
+                elif name in ("k", "v"):
+                    kv_pool_bytes += nb
+                    if quantized:
+                        saved += 2 * int(leaf.size) - nb
+        return {
+            "weight_bytes": weight_bytes,
+            "kv_pool_bytes": kv_pool_bytes,
+            "kv_scale_bytes": kv_scale_bytes,
+            "bytes_saved_vs_bf16": saved,
+        }
+
     def stats_snapshot(self) -> dict:
         """Current counters + freshly-read gauges (``GET /v1/stats``)."""
+        mem = self.memory_breakdown()
+        self.stats.gauge("weight_bytes", mem["weight_bytes"])
+        self.stats.gauge("kv_pool_bytes", mem["kv_pool_bytes"])
         self.stats.gauge("queue_depth", self._queue_len())
         self.stats.gauge("live_slots", int(self._live.sum()))
         self.stats.gauge("engine_generation", self.supervisor.generation)
@@ -1314,7 +1475,8 @@ class ContinuousBatchingEngine:
         assert swap is not None
         t0 = time.monotonic()
         try:
-            new_params, updated = _cow_swap_tree(self._params, swap.updates)
+            updates = _requantize_updates(self._params, swap.updates)
+            new_params, updated = _cow_swap_tree(self._params, updates)
             self._params = new_params
             if self._mt is not None:
                 # the adapter registry holds references into the old tree;
@@ -1903,6 +2065,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # past the allocation would NOT drop: the block index clips into the
         # slot's LAST real block (models/transformer.py), corrupting live KV.
         spec_k = max(0, int(kwargs.get("speculative_k", 0) or 0))
+        self._kv_quant = str(kwargs.pop("kv_quant", "none"))
         slack = max(bucket, spec_k + 1) if spec_k else bucket
         self._table_blocks = -(-(int(buf_len) + slack) // self._block_len)
         self._prefill_chunk = max(1, int(prefill_chunk))
@@ -1940,9 +2103,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._table[:, :] = NULL_BLOCK
         self._slot_blocks = [[] for _ in range(self._slots)]
         self._slot_plen = [0] * self._slots
-        self._cache, self._state = gen.init_paged_state(
-            self._slots, self._num_blocks, self._block_len
-        )
+        if self._kv_quant != "none":
+            self._cache, self._state = gen.init_paged_state(
+                self._slots, self._num_blocks, self._block_len,
+                kv_quant=self._kv_quant,
+            )
+        else:
+            # positional-only call keeps stub generators (tests) working
+            self._cache, self._state = gen.init_paged_state(
+                self._slots, self._num_blocks, self._block_len
+            )
         if self._mt is not None:
             self._mt.rebuild()  # resident adapters survive the crash
         self._startup_draft()

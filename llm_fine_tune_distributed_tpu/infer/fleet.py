@@ -537,6 +537,30 @@ class EngineFleet:
             out[name] = merged
         return out
 
+    def memory_breakdown(self) -> dict:
+        """Fleet HBM accounting: weight fields from replica 0 (the resident
+        weight tree is shared across replicas), KV-pool fields summed (each
+        replica owns its own pool). ``bytes_saved_vs_bf16`` follows the same
+        split — one weight share plus every replica's KV share."""
+        per = [rep.memory_breakdown() for rep in self.replicas]
+
+        def kv_saved(m: dict) -> int:
+            # an int8 pool stores 1 byte/elem vs 2 for bf16, so its KV saving
+            # is exactly the pool bytes minus the scale overhead; a bf16 pool
+            # (no scales) saves nothing
+            if m["kv_scale_bytes"] <= 0:
+                return 0
+            return m["kv_pool_bytes"] - m["kv_scale_bytes"]
+
+        first = per[0]
+        weight_saved = first["bytes_saved_vs_bf16"] - kv_saved(first)
+        return {
+            "weight_bytes": first["weight_bytes"],
+            "kv_pool_bytes": sum(m["kv_pool_bytes"] for m in per),
+            "kv_scale_bytes": sum(m["kv_scale_bytes"] for m in per),
+            "bytes_saved_vs_bf16": weight_saved + sum(kv_saved(m) for m in per),
+        }
+
     def stats_snapshot(self) -> dict:
         """Fleet-aggregated view + ``per_replica`` map (``/v1/stats``).
 
@@ -563,7 +587,12 @@ class EngineFleet:
             agg[key] = (
                 max(vals)
                 if key
-                in ("engine_generation", "weight_generation", "brownout_stage")
+                in (
+                    "engine_generation", "weight_generation", "brownout_stage",
+                    # replicas share one resident weight tree — summing
+                    # would count the same HBM once per replica
+                    "weight_bytes",
+                )
                 else sum(vals)
             )
         agg["tokens_per_s_1m"] = sum(s["tokens_per_s_1m"] for s in snaps)

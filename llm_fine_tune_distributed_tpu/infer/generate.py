@@ -737,10 +737,16 @@ class Generator:
     # chunks (cache_pos = chunk start) interleaved with decode, writing
     # straight into the slot's blocks instead of a private buffer + row copy.
 
-    def init_paged_state(self, slots: int, num_blocks: int, block_len: int):
-        """Fresh (pool, state) for a paged ``slots``-wide persistent decode."""
+    def init_paged_state(
+        self, slots: int, num_blocks: int, block_len: int, kv_quant: str = "none"
+    ):
+        """Fresh (pool, state) for a paged ``slots``-wide persistent decode.
+        ``kv_quant="int8"`` builds the quantized pool layout (int8 codes +
+        per-block absmax scale pools) — the step/prefill programs detect it
+        from the pool pytree, so no program variants are needed here."""
         pool = init_paged_cache(
-            self.config, num_blocks, block_len, dtype=self.compute_dtype
+            self.config, num_blocks, block_len, dtype=self.compute_dtype,
+            kv_quant=kv_quant,
         )
         return pool, self._fresh_slot_state(slots)
 

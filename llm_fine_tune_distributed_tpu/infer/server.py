@@ -89,6 +89,7 @@ def serve(
     max_batch: int = 8,
     batch_window_ms: float = 10.0,
     quantize: str = "none",
+    quantize_kv: str = "none",
     template_kwargs: Optional[dict] = None,
     request_timeout_s: Optional[float] = 600.0,
     tp: int = 1,
@@ -159,11 +160,26 @@ def serve(
         CaptureBusyError,
         ProfilerCapture,
     )
-    from llm_fine_tune_distributed_tpu.ops.int8 import QUANTIZE_MODES, maybe_quantize
+    from llm_fine_tune_distributed_tpu.ops.int8 import (
+        KV_QUANT_MODES,
+        QUANTIZE_MODES,
+        maybe_quantize,
+    )
 
     if quantize not in QUANTIZE_MODES:  # fail fast, before the model load
         raise ValueError(
             f"unknown quantize mode {quantize!r} (expected one of {QUANTIZE_MODES})"
+        )
+    if quantize_kv not in KV_QUANT_MODES:
+        raise ValueError(
+            f"unknown --quantize-kv mode {quantize_kv!r} (expected one of "
+            f"{KV_QUANT_MODES})"
+        )
+    if quantize_kv != "none" and engine_kind != "paged":
+        raise ValueError(
+            "--quantize-kv quantizes the PAGED block pool (per-block int8 "
+            "scales indexed by block id); the dense/window caches have no "
+            "blocks to scale — pick --engine paged or drop --quantize-kv"
         )
     # flag-combination validation mirrors infer/cli.py: a bad speculation
     # setup must fail AT STARTUP with a clear message, not at first request
@@ -350,6 +366,7 @@ def serve(
                     return PagedContinuousBatchingEngine(
                         generator, slots=slots, buf_len=kv_buf_len,
                         block_len=kv_block_len, prefill_chunk=prefill_chunk,
+                        kv_quant=quantize_kv,
                         **kw,
                     )
                 return ContinuousBatchingEngine(
@@ -537,6 +554,12 @@ def serve(
                         "max_batch": max_batch,
                     }
                 stats["device_memory"] = device_memory_report()
+                if cont_engine is not None and hasattr(
+                    cont_engine, "memory_breakdown"
+                ):
+                    stats["device_memory_report"] = (
+                        cont_engine.memory_breakdown()
+                    )
                 self._send(200, stats)
             elif self.path == "/metrics":
                 # Prometheus text exposition: every ServingStats counter/
@@ -1114,8 +1137,17 @@ def main(argv: Optional[list] = None) -> int:
         help="how long the batcher waits to fill a group",
     )
     parser.add_argument(
-        "--quantize", choices=["none", "int8"], default="none",
-        help="weight-only inference quantization (ops/int8.py)",
+        "--quantize-weights", "--quantize", dest="quantize",
+        choices=["none", "int8", "nf4"], default="none",
+        help="weight-only inference quantization of the block linears "
+             "(ops/int8.py, ops/nf4.py); adapter pools and the draft model "
+             "stay full precision",
+    )
+    parser.add_argument(
+        "--quantize-kv", choices=["none", "int8"], default="none",
+        help="paged engine only: store the KV block pool as int8 with "
+             "per-block absmax scales (halves HBM per resident token); "
+             "decode reads fuse the dequant into the paged attention",
     )
     parser.add_argument(
         "--tp", type=int, default=1, metavar="N",
@@ -1240,6 +1272,7 @@ def main(argv: Optional[list] = None) -> int:
         return 1
     serve(args.model_dir, args.host, args.port, args.max_batch,
           args.batch_window_ms, args.quantize,
+          quantize_kv=args.quantize_kv,
           request_timeout_s=args.request_timeout_s or None, tp=args.tp,
           draft_dir=args.draft_dir, speculative_k=args.speculative,
           adapter_dir=args.adapter_dir, max_adapters=args.max_adapters,

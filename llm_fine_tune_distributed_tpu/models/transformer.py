@@ -31,6 +31,11 @@ from jax.ad_checkpoint import checkpoint_name
 
 from llm_fine_tune_distributed_tpu.config import ModelConfig
 from llm_fine_tune_distributed_tpu.ops.attention import attention, softcap, xla_attention
+from llm_fine_tune_distributed_tpu.ops.int8 import (
+    KV_QUANT_MODES,
+    dequantize_kv_gather,
+    quantize_kv_write,
+)
 from llm_fine_tune_distributed_tpu.ops.norms import rms_norm
 from llm_fine_tune_distributed_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -241,6 +246,7 @@ def _block(
         q, k = apply_rope(q, k, cos, sin)
 
     new_entry = None
+    paged_quant = None  # int8 paged pool: (ck, cv, k_scale, v_scale, pos)
     if cache_entry is not None and block_tables is not None:
         # Paged cache: the entry is the GLOBAL pool [num_blocks, L, kv_heads,
         # d] and the row's block table maps logical position p to pool cell
@@ -269,12 +275,30 @@ def _block(
         # overwritten.
         blk = jnp.take_along_axis(block_tables, jnp.clip(pos // L, 0, nb - 1), axis=1)
         off = pos % L
-        ck = cache_entry["k"].at[blk, off].set(k.astype(cache_entry["k"].dtype))
-        cv = cache_entry["v"].at[blk, off].set(v.astype(cache_entry["v"].dtype))
-        new_entry = {"k": ck, "v": cv}
-        flat = block_tables.reshape(-1)
-        k = ck[flat].reshape(b, nb * L, ck.shape[2], ck.shape[3])
-        v = cv[flat].reshape(b, nb * L, cv.shape[2], cv.shape[3])
+        if "k_scale" in cache_entry:
+            # Int8 pool (--quantize-kv int8): codes keep the bf16 layout's
+            # [nb, L, h, d] shape, per-(block, kv-head) absmax scales live in
+            # sibling pools indexed by the same block ids. Writes quantize at
+            # insert (growing a block's scale rescales its resident codes;
+            # untouched blocks are bit-stable — ops/int8.quantize_kv_write);
+            # reads either fuse gather+dequant+attention into the Pallas
+            # decode kernel (TPU, s == 1) or fall back to the dequantizing
+            # XLA gather below.
+            ck, k_sc = quantize_kv_write(
+                cache_entry["k"], cache_entry["k_scale"], blk, off, k
+            )
+            cv, v_sc = quantize_kv_write(
+                cache_entry["v"], cache_entry["v_scale"], blk, off, v
+            )
+            new_entry = {"k": ck, "v": cv, "k_scale": k_sc, "v_scale": v_sc}
+            paged_quant = (ck, cv, k_sc, v_sc, pos)
+        else:
+            ck = cache_entry["k"].at[blk, off].set(k.astype(cache_entry["k"].dtype))
+            cv = cache_entry["v"].at[blk, off].set(v.astype(cache_entry["v"].dtype))
+            new_entry = {"k": ck, "v": cv}
+            flat = block_tables.reshape(-1)
+            k = ck[flat].reshape(b, nb * L, ck.shape[2], ck.shape[3])
+            v = cv[flat].reshape(b, nb * L, cv.shape[2], cv.shape[3])
     elif cache_entry is not None:
         # Decode/prefill with a fixed-size KV buffer: write k,v at cache_pos.
         # A scalar cache_pos writes the same slots for every row (single
@@ -306,7 +330,42 @@ def _block(
         if config.query_pre_attn_scalar is None
         else float(config.query_pre_attn_scalar) ** -0.5
     )
-    if explicit_mask is not None:
+    out = None
+    if paged_quant is not None:
+        ck, cv, k_sc, v_sc, pos = paged_quant
+        from llm_fine_tune_distributed_tpu.ops.flash_attention import (
+            paged_decode_attention,
+            paged_decode_mode,
+        )
+
+        mode = paged_decode_mode()
+        if (
+            mode != "xla"
+            and s == 1
+            and padding_mask is None
+            and layer_window is None
+            and config.attn_logit_softcap is None
+        ):
+            # fused Pallas kernel: block-table gather + per-block dequant +
+            # online softmax in one VMEM pass — the gathered [b, nb*L] view
+            # never materializes in HBM. Decode (s == 1) only; prefill
+            # chunks and speculative verify use the XLA gather below.
+            out = paged_decode_attention(
+                q, ck, cv, k_sc, v_sc, block_tables,
+                lengths=pos[:, 0] + 1,
+                scale=(
+                    float(attn_scale)
+                    if attn_scale is not None
+                    else float(d) ** -0.5
+                ),
+                interpret=(mode == "interpret"),
+            )
+        else:
+            k = dequantize_kv_gather(ck, k_sc, block_tables, compute_dtype)
+            v = dequantize_kv_gather(cv, v_sc, block_tables, compute_dtype)
+    if out is not None:
+        pass
+    elif explicit_mask is not None:
         # windowed_mask carries the window restriction; a global layer (no
         # window) uses the plain causal/padding mask
         m = windowed_mask if (layer_window is not None and windowed_mask is not None) else explicit_mask
@@ -692,20 +751,46 @@ def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfl
     }
 
 
-def init_paged_cache(config: ModelConfig, num_blocks: int, block_len: int, dtype=jnp.bfloat16):
+def init_paged_cache(
+    config: ModelConfig,
+    num_blocks: int,
+    block_len: int,
+    dtype=jnp.bfloat16,
+    kv_quant: str = "none",
+):
     """Global paged KV pool for the block-paged continuous engine: per layer
     one [num_blocks, block_len, kv_heads, head_dim] buffer shared by every
     decode slot, addressed through per-slot block tables (``forward``'s
     ``block_tables``). Block 0 is the NULL block (infer/paged.py): never
     allocated, mapped into unused table entries and dead rows so stray writes
-    and gathers hit garbage that the position mask always hides."""
+    and gathers hit garbage that the position mask always hides.
+
+    ``kv_quant="int8"`` keeps the same per-layer ``k``/``v`` shape in int8
+    and adds sibling ``k_scale``/``v_scale`` pools — f32 per-(block, kv-head)
+    absmax, indexed by the same block ids — halving HBM per cached token.
+    ``_block`` detects the layout by the ``k_scale`` key; the allocator and
+    prefix cache (infer/paged.py) deal only in block ids and are untouched.
+    Scales start at 0 ("never written"), so every block — the null block
+    forever — dequantizes to exact zeros until its first real write.
+    """
+    if kv_quant not in KV_QUANT_MODES:
+        raise ValueError(
+            f"unknown kv_quant mode {kv_quant!r} (expected one of {KV_QUANT_MODES})"
+        )
     d = config.resolved_head_dim
     shape = (num_blocks, block_len, config.num_kv_heads, d)
-    return {
-        "layers": {
-            str(i): {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-            for i in range(config.num_layers)
+    if kv_quant == "int8":
+        scale_shape = (num_blocks, config.num_kv_heads)
+        entry = lambda: {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.float32),
+            "v_scale": jnp.zeros(scale_shape, jnp.float32),
         }
+    else:
+        entry = lambda: {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    return {
+        "layers": {str(i): entry() for i in range(config.num_layers)}
     }
 
 

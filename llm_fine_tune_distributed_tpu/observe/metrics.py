@@ -95,6 +95,12 @@ class ServingStats:
         # staged degradation under pressure (0 = healthy .. 3 = shedding
         # best_effort); a fleet reports the max across replicas
         "brownout_stage",
+        # resident HBM accounting (engine.memory_breakdown): weight bytes
+        # cover the serving tree in whatever precision is resident (bf16 or
+        # --quantize-weights int8/nf4 codes + scales); kv_pool_bytes covers
+        # the k/v pools only — the per-block int8 scales ride in the
+        # /v1/stats breakdown, not here
+        "weight_bytes", "kv_pool_bytes",
     )
     # tier-labelled shed counters (``requests_shed_by_tier`` in the
     # snapshot): every priority tier is always present so the /v1/stats and

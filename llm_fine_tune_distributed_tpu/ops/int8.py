@@ -79,7 +79,11 @@ def dequantize_int8_stacked(q: Dict, dtype=jnp.bfloat16):
 
 # the single source of truth for inference quantization modes (CLI choices,
 # server fail-fast check, and maybe_quantize all reference this)
-QUANTIZE_MODES = ("none", "int8")
+QUANTIZE_MODES = ("none", "int8", "nf4")
+
+# paged-KV-pool quantization modes (--quantize-kv): per-block int8 with a
+# sibling absmax-scale pool (models/transformer.init_paged_cache)
+KV_QUANT_MODES = ("none", "int8")
 
 
 def maybe_quantize(params, mode: str):
@@ -91,6 +95,11 @@ def maybe_quantize(params, mode: str):
         )
     if mode == "none":
         return params
+    if mode == "nf4":
+        from llm_fine_tune_distributed_tpu.ops.nf4 import quantize_params_nf4
+
+        print("Quantizing block linears to NF4 (weight-only) ...")
+        return quantize_params_nf4(params)
     print("Quantizing block linears to int8 (weight-only) ...")
     return quantize_params_int8(params)
 
@@ -140,3 +149,76 @@ def quantize_params_int8(params, predicate=None):
                 "leaves and stacked 3-D expert weights have an int8 form"
             )
     return unflatten_dict(out)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV pool quantization (--quantize-kv int8)
+#
+# The pool keeps the bf16 layout's [num_blocks, block_len, kv_heads, head_dim]
+# shape in int8 plus a sibling absmax pool [num_blocks, kv_heads] f32 indexed
+# by the SAME block ids the block tables carry (infer/paged.py allocates ids,
+# never bytes, so it is untouched). Per-(block, kv-head) scales rather than
+# per-block: the k/v magnitude spread across heads is the dominant error term
+# at 8 bits, and the extra scale column costs 4 bytes per head per block
+# against block_len * head_dim codes. Codes are symmetric absmax/127, like
+# the weight path; scale 0 means "never written" and dequantizes to exactly
+# 0.0, which keeps the null block (id 0) all-zero by construction.
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv_write(codes, scales, blk, off, x):
+    """Scatter new K or V tokens into an int8 paged pool, growing per-block
+    scales as needed.
+
+    ``codes`` int8 [num_blocks, block_len, kv_heads, d], ``scales`` f32
+    [num_blocks, kv_heads] (per-block-per-head absmax), ``blk``/``off``
+    int32 [b, s] (pool block id / slot within block per token), ``x``
+    [b, s, kv_heads, d]. Returns ``(new_codes, new_scales)``.
+
+    A write may raise a block's absmax, so the block's EXISTING codes are
+    re-expressed under the grown scale (gather touched blocks, multiply by
+    old/new, round, scatter back). Blocks whose scale did not grow rescale
+    by exactly 1.0 — an int8 -> f32 -> round -> int8 identity — so blocks
+    not written this call (in particular COW-shared prefix blocks, which are
+    never written again after their last prefill token) stay bit-stable.
+    Duplicate block ids within one call (a prefill chunk spanning a block)
+    compute identical rescaled content from the already-maxed new scales, so
+    overlapping scatters agree regardless of order. Writes routed to the
+    null block (id 0 — dead rows, clamped redirects) are forced to zero
+    codes and a zero scale, so block 0 dequantizes to 0.0 forever.
+    """
+    xf = x.astype(jnp.float32)
+    null = blk == 0  # [b, s]
+    tok_amax = jnp.where(
+        null[..., None], 0.0, jnp.max(jnp.abs(xf), axis=-1)
+    )  # [b, s, h]
+    new_scales = scales.at[blk].max(tok_amax)
+    old_blk = scales[blk]  # [b, s, h]
+    new_blk = new_scales[blk]
+    safe_new = jnp.where(new_blk == 0.0, 1.0, new_blk)
+    ratio = jnp.where(new_blk == 0.0, 0.0, old_blk / safe_new)
+    touched = codes[blk].astype(jnp.float32)  # [b, s, L, h, d]
+    rescaled = jnp.clip(
+        jnp.round(touched * ratio[:, :, None, :, None]), -127, 127
+    ).astype(jnp.int8)
+    new_codes = codes.at[blk].set(rescaled)
+    q = jnp.clip(jnp.round(xf * (127.0 / safe_new[..., None])), -127, 127)
+    q = jnp.where(null[..., None, None], 0, q.astype(jnp.int8))
+    new_codes = new_codes.at[blk, off].set(q)
+    return new_codes, new_scales
+
+
+def dequantize_kv_gather(codes, scales, block_tables, dtype=jnp.bfloat16):
+    """Gather a row's table blocks out of an int8 paged pool into the dense
+    [b, nb * block_len, kv_heads, d] view ``models/transformer._block``
+    attends over (the XLA fallback for the fused Pallas decode kernel —
+    ops/flash_attention.paged_decode_attention). The gathered index IS the
+    logical position, exactly like the bf16 layout, so the caller's position
+    mask applies unchanged; null-table entries gather block 0, whose scale
+    is pinned at 0 so they dequantize to exact zeros."""
+    b, nb = block_tables.shape
+    _, L, h, d = codes.shape
+    flat = block_tables.reshape(-1)
+    blocks = codes[flat].astype(jnp.float32).reshape(b, nb, L, h, d)
+    sc = (scales[flat] / 127.0).reshape(b, nb, 1, h, 1)
+    return (blocks * sc).astype(dtype).reshape(b, nb * L, h, d)

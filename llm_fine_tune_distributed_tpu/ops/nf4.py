@@ -404,3 +404,51 @@ def quantized_layout_stacked(shape, block_size: int = DEFAULT_BLOCK_SIZE, double
         if key in flat:
             out[key] = flat[key]
     return out
+
+
+def quantize_params_nf4(params, predicate=None, block_size: int = DEFAULT_BLOCK_SIZE):
+    """Replace every matching transformer-block linear with its NF4 sibling
+    leaves — the NF4 counterpart of ``ops/int8.quantize_params_int8``
+    (``--quantize-weights nf4`` on the inference entry points).
+
+    Same predicate and same exclusions as the int8 path: embeddings, the
+    lm_head and the MoE router gate stay full precision. A leaf whose in-dim
+    does not divide ``block_size`` (small presets) falls back to the largest
+    valid block — the pack-factor minimum of 8 — instead of failing; an
+    in-dim not divisible by 8 has no NF4 form at all and raises, exactly as
+    the int8 quantizer is loud about predicate hits it cannot serve.
+    """
+    from llm_fine_tune_distributed_tpu.ops.int8 import quantize_params_int8  # noqa: F401 (predicate parity documented there)
+    from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict, unflatten_dict
+
+    def is_stacked_expert(path: str) -> bool:
+        return path.endswith(("/experts/w1", "/experts/w2", "/experts/w3"))
+
+    if predicate is None:
+        predicate = lambda path: "/layers/" in path and (
+            (path.endswith("/kernel") and not path.endswith("block_sparse_moe/gate/kernel"))
+            or is_stacked_expert(path)
+        )
+
+    flat = flatten_dict(params)
+    out = {}
+    for path, leaf in flat.items():
+        if not predicate(path):
+            out[path] = leaf
+            continue
+        if getattr(leaf, "ndim", 0) == 2 and path.endswith("/kernel"):
+            k, quantize_fn = leaf.shape[0], quantize_nf4
+        elif getattr(leaf, "ndim", 0) == 3 and is_stacked_expert(path):
+            k, quantize_fn = leaf.shape[1], quantize_nf4_stacked
+        else:
+            raise ValueError(
+                f"predicate matched {path!r} (ndim="
+                f"{getattr(leaf, 'ndim', None)}) but only 2-D .../kernel "
+                "leaves and stacked 3-D expert weights have an NF4 form"
+            )
+        bs = block_size if k % block_size == 0 else 8
+        q = quantize_fn(leaf, block_size=bs)
+        for suffix in QUANT_SUFFIXES:
+            if suffix in q:
+                out[f"{path}_{suffix}"] = jnp.asarray(q[suffix])
+    return unflatten_dict(out)

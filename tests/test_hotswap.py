@@ -425,3 +425,115 @@ def test_swap_under_concurrent_load_drops_nothing(generator, tmp_path):
     # both replicas — the jit caches live on the Generator)
     comp = fleet.replicas[0].stats_snapshot()["compile"]
     assert comp["total_compiles"] == compiles0, comp
+
+
+# ----------------------------------------- quantized-resident swap (ISSUE 12)
+
+
+@pytest.fixture(scope="module")
+def int8_generator():
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    from llm_fine_tune_distributed_tpu.ops.int8 import maybe_quantize
+
+    return Generator(
+        maybe_quantize(params, "int8"), mc, ByteChatMLTokenizer(),
+        compute_dtype=jnp.float32, eos_token_ids=[],
+    )
+
+
+def _quantized_kernel_paths(generator):
+    """Flat paths the trainer would publish (plain .../kernel) whose
+    resident form is quantized (kernel_int8 / kernel_nf4 siblings)."""
+    mc = get_preset("tiny")
+    base = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    flat = flatten_dict(base)
+    return flat, sorted(
+        k for k in flat
+        if "/layers/0/" in k and k.endswith("/kernel") and "gate" not in k
+    )
+
+
+def test_swap_requantizes_into_resident_int8(int8_generator):
+    """A trainer publishes plain bf16 kernels; the int8-serving engine
+    re-quantizes them into the resident format at the drain boundary —
+    shapes preserved, so the swap keeps the zero-recompile guarantee, and
+    the resident codes are exactly quantize_int8 of the published array."""
+    from llm_fine_tune_distributed_tpu.ops.int8 import quantize_int8
+
+    engine = _make(int8_generator, "paged", kv_quant="int8")
+    prompt = _prompt()
+    assert engine.submit(prompt, GREEDY)
+    engine.mark_compile_warm()
+
+    flat, qkeys = _quantized_kernel_paths(int8_generator)
+    published = {k: np.asarray(flat[k]) * 1.5 for k in qkeys[:2]}
+    res = engine.request_weight_swap(
+        published, fingerprint="fp-requant", step=1, timeout=60
+    )
+    assert res["weight_generation"] == 1
+    assert engine.compile_ledger.recompiles_after_warmup == 0
+
+    resident = flatten_dict(engine._params)
+    for path, arr in published.items():
+        want = quantize_int8(jnp.asarray(arr))
+        np.testing.assert_array_equal(
+            np.asarray(resident[f"{path}_int8"]), np.asarray(want["int8"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(resident[f"{path}_int8_scale"]),
+            np.asarray(want["int8_scale"]), rtol=1e-6,
+        )
+    assert engine.submit(prompt, GREEDY)  # still serving on the new codes
+
+
+def test_swap_rejects_unreconcilable_published_leaf(int8_generator):
+    """A published leaf that cannot be re-quantized into the resident
+    layout fails the swap with a message naming --quantize-weights; the
+    engine keeps the old generation and stays healthy."""
+    engine = _make(int8_generator, "paged", kv_quant="int8")
+    prompt = _prompt()
+    assert engine.submit(prompt, GREEDY)
+    _, qkeys = _quantized_kernel_paths(int8_generator)
+    with pytest.raises(RuntimeError, match="--quantize-weights int8"):
+        engine.request_weight_swap(
+            {qkeys[0]: np.zeros((8, 8), np.float32)},
+            fingerprint="fp-bad", step=1, timeout=60,
+        )
+    assert engine.weight_generation == 0
+    assert engine.healthy
+    assert engine.submit(prompt, GREEDY)
+
+
+def test_swap_requantizes_into_resident_nf4():
+    """Same translation for an NF4-resident server: the published bf16
+    kernel lands as packed NF4 codes at the resident block size."""
+    from llm_fine_tune_distributed_tpu.ops.int8 import maybe_quantize
+    from llm_fine_tune_distributed_tpu.ops.nf4 import quantize_nf4
+
+    mc = get_preset("tiny")
+    base = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    gen = Generator(
+        maybe_quantize(base, "nf4"), mc, ByteChatMLTokenizer(),
+        compute_dtype=jnp.float32, eos_token_ids=[],
+    )
+    engine = _make(gen, "continuous")
+    prompt = _prompt()
+    assert engine.submit(prompt, GREEDY)
+
+    flat = flatten_dict(init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32))
+    path = sorted(
+        k for k in flat
+        if "/layers/0/" in k and k.endswith("/kernel") and "gate" not in k
+    )[0]
+    arr = np.asarray(flat[path]) * 1.5
+    res = engine.request_weight_swap(
+        {path: arr}, fingerprint="fp-nf4", step=1, timeout=60
+    )
+    assert res["weight_generation"] == 1
+    resident = flatten_dict(engine._params)
+    want = quantize_nf4(jnp.asarray(arr))
+    np.testing.assert_array_equal(
+        np.asarray(resident[f"{path}_nf4"]), np.asarray(want["nf4"])
+    )
+    assert engine.submit(prompt, GREEDY)

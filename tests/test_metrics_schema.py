@@ -70,6 +70,9 @@ SNAPSHOT_KEYS = {
     "brownout_stage",
     "blocks_in_use", "peak_blocks_in_use", "prefix_cache_blocks",
     "adapters_resident",
+    # quantized serving: resident weight bytes and KV-pool bytes (the full
+    # breakdown with scale overhead rides /v1/stats device_memory_report)
+    "weight_bytes", "kv_pool_bytes",
     # multi-tenant LoRA: tenant -> {requests, tokens, queue_depth}
     "per_tenant",
     # derived
@@ -159,6 +162,8 @@ EXPECTED_METRICS = {
     ("serving_mean_tokens_per_step", "gauge"),
     ("serving_draining", "gauge"),
     ("serving_brownout_stage", "gauge"),
+    ("serving_weight_bytes", "gauge"),
+    ("serving_kv_pool_bytes", "gauge"),
     # XLA introspection: per-program compile counters (program="..."
     # labels; TYPE lines emitted even with an empty ledger) + roofline
     # utilization gauges

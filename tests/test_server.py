@@ -340,6 +340,14 @@ def test_stats_histograms_and_memory(server):
     assert stats["uptime_s"] > 0.0
     assert stats["tokens_per_s_1m"] >= 0.0
     assert isinstance(stats["device_memory"], dict)  # {} on CPU
+    # residency breakdown (engine.memory_breakdown) — platform-independent
+    report = stats["device_memory_report"]
+    assert set(report) == {
+        "weight_bytes", "kv_pool_bytes", "kv_scale_bytes",
+        "bytes_saved_vs_bf16",
+    }
+    assert report["weight_bytes"] > 0
+    assert report["bytes_saved_vs_bf16"] == 0  # unquantized server
 
 
 def test_metrics_endpoint_prometheus(server):
