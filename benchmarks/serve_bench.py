@@ -76,8 +76,15 @@ a memory/slot sweep at a FIXED KV-pool byte budget — bf16 pool vs int8
 pool vs int8 pool + int8 weights — reporting slots sustained, tokens/sec,
 hbm_bandwidth_utilization, and greedy parity vs the bf16 arm; exits
 nonzero if the int8 pool sustains fewer than 1.8x the bf16 arm's decode
-slots at equal bf16-equivalent pool bytes, or any request errors). Every
-engine-backed JSON line also carries the XLA
+slots at equal bf16-equivalent pool bytes, or any request errors),
+SERVE_SLO=1 (SLO/canary arm: two publishes roll through a 2-replica fleet
+with a CanaryJudge armed — a healthy publish must pass the canary window
+and roll BOTH replicas, then a publish degraded by a pure latency fault
+injected into the canary replica (invisible to every error-rate gate, and
+published with IMPROVED eval metrics so the eval gate passes it) must be
+blocked by the per-generation latency verdict and rolled back; exits
+nonzero if the regression reaches the second replica or the healthy roll
+is blocked). Every engine-backed JSON line also carries the XLA
 introspection gauges: mfu, hbm_bw_util, compiles_total,
 compile_seconds_total.
 """
@@ -1335,6 +1342,166 @@ def main():
             "greedy_match_vs_bf16": {
                 k: round(v, 3) for k, v in parity.items()
             },
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+        if not ok:
+            sys.exit(1)
+
+    # SLO/canary arm (ISSUE 13): a CanaryJudge gates a 2-replica rolling
+    # deploy. Publish 1 is healthy: the canary window must pass and the
+    # roll must reach BOTH replicas. Publish 2 is degraded by a pure
+    # latency fault armed on the canary replica — no request fails, and
+    # its manifest eval metrics IMPROVE, so the error-rate backstop and
+    # the eval gate both wave it through; only the per-generation latency
+    # verdict stands between it and the fleet. The arm exits nonzero if
+    # that verdict misses (regression reaches the second replica) or if
+    # it false-positives (the healthy roll is blocked).
+    if os.environ.get("SERVE_SLO", "1") == "1":
+        import shutil
+        import tempfile
+
+        from llm_fine_tune_distributed_tpu.infer.deploy import (
+            CheckpointWatcher,
+            HotSwapManager,
+        )
+        from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
+        from llm_fine_tune_distributed_tpu.observe.slo import CanaryJudge
+        from llm_fine_tune_distributed_tpu.train.checkpoints import (
+            frozen_fingerprint,
+        )
+        from llm_fine_tune_distributed_tpu.train.publish import (
+            CheckpointPublisher,
+        )
+        from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+        slo_gen = Generator(  # fresh generator: isolated compile ledger
+            params, mc, ByteChatMLTokenizer(), compute_dtype=dtype,
+            eos_token_ids=[],
+        )
+        slo_fleet = EngineFleet(
+            [
+                PagedContinuousBatchingEngine(
+                    slo_gen, slots=4, buf_len=256, prompt_bucket=32,
+                    block_len=32, prefill_chunk=64,
+                    slo_sample_interval_s=0.25,
+                )
+                for _ in range(2)
+            ],
+            routing="round-robin",  # guarantees the canary keeps traffic
+        )
+        # short all-greedy requests so plenty settle inside the canary
+        # window even on the latency-degraded replica
+        slo_load = _tenant_workload(
+            np.random.RandomState(11), mc.vocab_size, 32, max_new=8
+        )
+        _run_config(slo_fleet, 4, 8, slo_load)  # warm every shape, both sides
+
+        flat = flatten_dict(params)
+        tr_keys = [k for k in sorted(flat) if k.endswith("kernel")][:4]
+        frozen_fp = frozen_fingerprint(
+            {k: v for k, v in flat.items() if k not in tr_keys}
+        )
+        pub_dir = tempfile.mkdtemp(prefix="serve_bench_slo_")
+        publisher = CheckpointPublisher(pub_dir, keep_last=4)
+        publisher.publish(
+            1,
+            {k: np.asarray(flat[k], np.float32) + 1e-3 for k in tr_keys},
+            frozen_fp=frozen_fp, metrics={"eval_loss": 1.0},
+        )
+        mgr = HotSwapManager(
+            slo_fleet,
+            CheckpointWatcher(pub_dir, base_params=params),
+            canary=CanaryJudge(
+                window_s=2.5, min_requests=4, poll_s=0.1,
+                ttft_ratio=4.0, inter_token_ratio=4.0,
+                max_error_rate=0.5, min_baseline_s=0.005,
+            ),
+        )
+
+        stop = threading.Event()
+        traffic_errors = []
+
+        def _slo_traffic(ci):
+            i = 0
+            while not stop.is_set():
+                prompt, gen, seed = slo_load[(ci * 7 + i) % len(slo_load)]
+                try:
+                    slo_fleet.submit(prompt, gen, seed=seed, timeout=600)
+                except Exception as e:  # pragma: no cover - fails the gate
+                    traffic_errors.append(repr(e))
+                i += 1
+
+        traffic = [
+            threading.Thread(target=_slo_traffic, args=(i,)) for i in range(6)
+        ]
+        for t in traffic:
+            t.start()
+        time.sleep(0.3)  # steady traffic on both replicas first
+
+        healthy = mgr.poll_once()
+        healthy_gens = [
+            int(e.weight_generation) for e in slo_fleet.replicas
+        ]
+        healthy_ok = (
+            healthy is not None
+            and healthy["kind"] == "deploy"
+            and (healthy.get("canary") or {}).get("verdict") == "pass"
+            and mgr.deployed_step == 1
+            and min(healthy_gens) >= 1
+        )
+
+        # pure latency regression on the NEXT canary: every decode tick on
+        # replica 0 now sleeps, but nothing errors
+        slo_fleet.replicas[0].faults.delay_decode_next(
+            k=1_000_000, seconds=0.1
+        )
+        publisher.publish(
+            2,
+            {k: np.asarray(flat[k], np.float32) + 2e-3 for k in tr_keys},
+            frozen_fp=frozen_fp, metrics={"eval_loss": 0.9},
+        )
+        degraded = mgr.poll_once()
+        slo_fleet.replicas[0].faults.clear_delays()
+        stop.set()
+        for t in traffic:
+            t.join()
+
+        blocked_ok = (
+            degraded is not None
+            and degraded["kind"] == "canary_rejected"
+            and mgr.deployed_step == 1
+            and int(slo_fleet.replicas[1].weight_generation)
+            == healthy_gens[1]
+        )
+        slo_report = slo_fleet.slo_report()
+        shutil.rmtree(pub_dir, ignore_errors=True)
+        ok = healthy_ok and blocked_ok and not traffic_errors
+        print(json.dumps({
+            "metric": "serve_slo_canary_guard",
+            "value": 1 if ok else 0,
+            "unit": "1 = healthy publish rolls both replicas, latency-"
+                    "degraded publish blocked by the canary verdict",
+            "healthy_kind": healthy.get("kind") if healthy else None,
+            "healthy_canary_verdict": (
+                (healthy.get("canary") or {}).get("verdict")
+                if healthy else None
+            ),
+            "degraded_kind": degraded.get("kind") if degraded else None,
+            "degraded_canary_verdict": (
+                (degraded.get("canary") or {}).get("verdict")
+                if degraded else None
+            ),
+            "degraded_canary_reason": (
+                (degraded.get("canary") or {}).get("reason")
+                if degraded else None
+            ),
+            "deployed_step": mgr.deployed_step,
+            "weight_generations": [
+                int(e.weight_generation) for e in slo_fleet.replicas
+            ],
+            "slo_compliant": slo_report.get("compliant"),
+            "traffic_errors": traffic_errors,
             "model": preset,
             "platform": jax.devices()[0].platform,
         }), flush=True)

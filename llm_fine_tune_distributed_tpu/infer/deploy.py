@@ -23,11 +23,22 @@ recompiling a program:
   reports ``swap_pending`` and the router sheds its traffic to siblings,
   so the fleet as a whole never stops admitting. If replica k fails to
   swap, replicas 0..k-1 are rolled back best-effort and the deploy raises.
+- **Eval gate.** A publish whose manifest eval metrics regress versus the
+  manifest of the generation currently resident is rejected at the
+  watcher (``publish_rejected_eval`` flight event) — a checkpoint that
+  got worse on its own eval never reaches a swap.
+- **Canary.** With a ``CanaryJudge`` attached (observe/slo.py) a fleet
+  roll pauses after the FIRST replica: the judge compares the canary's
+  per-generation latency/error deltas against the unswapped siblings
+  over a confirmation window, and a regression verdict rolls the canary
+  back and blocks the publish — the PRIMARY quality gate, catching the
+  latency regressions an error-rate threshold is blind to.
 - **Rollback.** The previously-resident values of every swapped path are
   kept in host RAM. ``rollback()`` re-rolls them out (bumping the weight
   generation — a rollback is a forward swap to old values, not a rewind),
   and an optional monitor auto-rolls-back when the post-swap error rate
-  over a trailing window trips the configured threshold.
+  over a trailing window trips the configured threshold (the BACKSTOP
+  behind the canary verdict).
 """
 
 from __future__ import annotations
@@ -38,6 +49,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from llm_fine_tune_distributed_tpu.observe.slo import CanaryJudge
 from llm_fine_tune_distributed_tpu.train.publish import (
     list_published,
     load_manifest,
@@ -71,6 +83,15 @@ class CheckpointWatcher:
     view — extra non-base leaves are ignored) enables frozen-fingerprint
     verification; without it the watcher trusts the manifest
     (``verify_frozen=False`` path, for tests and stub engines).
+
+    ``eval_gate_metric`` names the manifest ``metrics`` key gating
+    promotion (``eval_gate_mode`` "min" = lower is better): a candidate
+    strictly worse than the RESIDENT generation's manifest metric is
+    skipped with a ``publish_rejected_eval`` flight event (recorded once
+    per publish on ``recorder``). The gate only engages when BOTH
+    manifests carry the metric — metric-less publishes (smoke tests, ad
+    hoc rolls) deploy exactly as before. ``HotSwapManager`` feeds the
+    resident side via ``note_deployed``.
     """
 
     def __init__(
@@ -79,14 +100,67 @@ class CheckpointWatcher:
         *,
         base_params=None,
         verify_frozen: bool = True,
+        eval_gate_metric: str = "eval_loss",
+        eval_gate_mode: str = "min",
+        recorder=None,
     ):
         self.publish_dir = publish_dir
         self._base = base_params
         self._verify = bool(verify_frozen) and base_params is not None
+        if eval_gate_mode not in ("min", "max"):
+            raise ValueError(
+                f"eval_gate_mode must be 'min' or 'max', got {eval_gate_mode!r}"
+            )
+        self.eval_gate_metric = eval_gate_metric
+        self.eval_gate_mode = eval_gate_mode
+        self.recorder = recorder
+        # the resident generation's manifest metrics (None until the first
+        # deploy through a manager — boot weights carry no manifest)
+        self._resident_metrics: Optional[Dict[str, Any]] = None
+        # (step, fingerprint) pairs already rejected by the eval gate, so
+        # the warning/flight event fires once per publish, not per poll
+        self._eval_rejected: set = set()
         # resident frozen fingerprint, cached per trainable key-set (the
         # frozen set is "everything the publish does not carry", so it can
         # only change when the published leaf set does)
         self._resident_fp: Dict[frozenset, Dict[str, Any]] = {}
+
+    def note_deployed(self, metrics: Optional[Dict[str, Any]]) -> None:
+        """Record the manifest metrics of the generation now resident —
+        the baseline the eval gate compares future candidates against."""
+        self._resident_metrics = dict(metrics) if metrics else None
+
+    def _eval_regresses(self, manifest: Dict[str, Any], path: str, log) -> bool:
+        metric = self.eval_gate_metric
+        if not metric or self._resident_metrics is None:
+            return False
+        cand = (manifest.get("metrics") or {}).get(metric)
+        resident = self._resident_metrics.get(metric)
+        if cand is None or resident is None:
+            return False
+        worse = (
+            float(cand) > float(resident)
+            if self.eval_gate_mode == "min"
+            else float(cand) < float(resident)
+        )
+        if not worse:
+            return False
+        key = (int(manifest["step"]), str(manifest.get("weight_fingerprint")))
+        if key not in self._eval_rejected:
+            self._eval_rejected.add(key)
+            log.warning(
+                "rejecting publish %s: %s %.6g regresses vs resident %.6g",
+                path, metric, float(cand), float(resident),
+            )
+            if self.recorder is not None:
+                self.recorder.record(
+                    "publish_rejected_eval",
+                    step=int(manifest["step"]),
+                    metric=metric,
+                    candidate=float(cand),
+                    resident=float(resident),
+                )
+        return True
 
     def _resident_frozen_fp(self, trainable_keys: frozenset) -> Dict[str, Any]:
         cached = self._resident_fp.get(trainable_keys)
@@ -119,6 +193,8 @@ class CheckpointWatcher:
             manifest = load_manifest(path)
             if manifest is None:
                 continue  # torn/malformed: already logged by the loader
+            if self._eval_regresses(manifest, path, log):
+                continue  # eval-gated: worse than the resident generation
             try:
                 weights = load_weights(path, manifest)
             except Exception as e:  # noqa: BLE001 — skip, never crash serving
@@ -172,6 +248,7 @@ class HotSwapManager:
         auto_rollback_window_s: float = 0.0,
         auto_rollback_error_rate: float = 0.5,
         auto_rollback_min_requests: int = 8,
+        canary: Optional[CanaryJudge] = None,
     ):
         self.watcher = watcher
         self.engines = list(getattr(target, "replicas", None) or [target])
@@ -181,9 +258,22 @@ class HotSwapManager:
         self.auto_rollback_window_s = float(auto_rollback_window_s)
         self.auto_rollback_error_rate = float(auto_rollback_error_rate)
         self.auto_rollback_min_requests = int(auto_rollback_min_requests)
+        # canary scoring (observe/slo.CanaryJudge): with a judge attached
+        # and >1 replica, every deploy pauses after the first swap for a
+        # confirmation window; a regression verdict blocks the roll
+        self.canary = canary
+        self.last_canary: Optional[Dict[str, Any]] = None
+        # the watcher's eval gate records its rejections on the canary
+        # replica's flight recorder unless the caller wired its own
+        if watcher.recorder is None:
+            watcher.recorder = getattr(self.engines[0], "recorder", None)
         self._lock = threading.Lock()
         self.deployed_step = -1
         self.deployed_fingerprint: Optional[str] = None
+        # manifest metrics mirroring the weight buffers (resident + prev)
+        # so the eval gate's baseline survives rollbacks
+        self._resident_metrics: Optional[Dict[str, Any]] = None
+        self._prev_metrics: Optional[Dict[str, Any]] = None
         # a rollback marks the fled step as held: the poller ignores
         # publishes at or below it (otherwise the next poll would redeploy
         # exactly the generation the rollback rejected). A NEWER publish
@@ -212,6 +302,7 @@ class HotSwapManager:
             return self._deploy(
                 dep["weights"], dep["fingerprint"], dep["step"],
                 kind="deploy",
+                metrics=(dep["manifest"].get("metrics") or None),
             )
 
     def rollback(self) -> Dict[str, Any]:
@@ -227,6 +318,7 @@ class HotSwapManager:
             result = self._deploy(
                 self._prev_weights, self._prev_fingerprint, self._prev_step,
                 kind="rollback",
+                metrics=self._prev_metrics,
             )
             self._hold_step = max(self._hold_step, fled)
             return result
@@ -237,20 +329,26 @@ class HotSwapManager:
         fingerprint: Optional[str],
         step: int,
         kind: str,
+        metrics: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Rolling swap of ``weights`` across every engine (lock held).
 
         Captures the currently-resident values of the affected paths first
         (the NEXT rollback buffer), then swaps one replica at a time so the
-        router always has siblings to shed to. A failure part-way rolls the
-        already-swapped replicas back best-effort and raises — the fleet
-        never ends up split across generations."""
+        router always has siblings to shed to. With a canary judge armed,
+        a deploy pauses after the first replica for the confirmation
+        window; a regression verdict rolls that replica back and returns a
+        ``canary_rejected`` result WITHOUT advancing the deployed step —
+        the regression never reaches a second replica. A failure part-way
+        rolls the already-swapped replicas back best-effort and raises —
+        the fleet never ends up split across generations."""
         prev = self._capture(weights)
         t0 = time.monotonic()
         done: List[Any] = []
         results = []
+        canary_verdict: Optional[Dict[str, Any]] = None
         try:
-            for eng in self.engines:
+            for i, eng in enumerate(self.engines):
                 results.append(
                     eng.request_weight_swap(
                         weights, fingerprint=fingerprint, step=step,
@@ -258,6 +356,21 @@ class HotSwapManager:
                     )
                 )
                 done.append(eng)
+                if (
+                    i == 0
+                    and kind == "deploy"
+                    and self.canary is not None
+                    and len(self.engines) > 1
+                ):
+                    canary_verdict = self.canary.judge(
+                        eng, self.engines[1:],
+                        results[0]["weight_generation"],
+                    )
+                    self.last_canary = canary_verdict
+                    if canary_verdict.get("verdict") == "regression":
+                        return self._reject_canary(
+                            eng, prev, fingerprint, step, canary_verdict
+                        )
         except BaseException:
             for eng in done:  # best-effort: restore the pre-deploy values
                 try:
@@ -274,6 +387,9 @@ class HotSwapManager:
         self._prev_weights = prev
         self._prev_fingerprint = self.deployed_fingerprint
         self._prev_step = self.deployed_step
+        self._prev_metrics = self._resident_metrics
+        self._resident_metrics = dict(metrics) if metrics else None
+        self.watcher.note_deployed(self._resident_metrics)
         self.deployed_step = int(step)
         self.deployed_fingerprint = fingerprint
         self._arm_watch()
@@ -283,7 +399,7 @@ class HotSwapManager:
             f"{len(self.engines)} replica(s) in {dt:.3f}s",
             flush=True,
         )
-        return {
+        result = {
             "kind": kind,
             "step": int(step),
             "fingerprint": fingerprint,
@@ -291,6 +407,49 @@ class HotSwapManager:
             "duration_s": dt,
             "weight_generation": max(r["weight_generation"] for r in results),
             "cache_invalidated": any(r["cache_invalidated"] for r in results),
+        }
+        if canary_verdict is not None:
+            result["canary"] = canary_verdict
+        return result
+
+    def _reject_canary(
+        self,
+        eng,
+        prev: Dict[str, np.ndarray],
+        fingerprint: Optional[str],
+        step: int,
+        verdict: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        """Roll the canary replica back to the pre-deploy values and hold
+        the rejected step (lock held). The deployed step/fingerprint and
+        rollback buffers are untouched — the fleet never left the
+        resident generation."""
+        try:
+            eng.request_weight_swap(
+                prev, fingerprint=self.deployed_fingerprint,
+                step=self.deployed_step, timeout=self.swap_timeout_s,
+            )
+            eng.stats.incr("weight_rollbacks")
+        except Exception as e:  # noqa: BLE001 — verdict still blocks the roll
+            print(f"[deploy] canary rollback failed: {e}", flush=True)
+        recorder = getattr(eng, "recorder", None)
+        if recorder is not None:
+            recorder.record(
+                "canary_rollback", step=int(step),
+                reason=verdict.get("reason"),
+            )
+        self._hold_step = max(self._hold_step, int(step))
+        print(
+            f"[deploy] canary REJECTED step {step} ({fingerprint}): "
+            f"{verdict.get('reason')}",
+            flush=True,
+        )
+        return {
+            "kind": "canary_rejected",
+            "step": int(step),
+            "fingerprint": fingerprint,
+            "replicas": 1,
+            "canary": verdict,
         }
 
     def _capture(self, weights: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -392,4 +551,6 @@ class HotSwapManager:
                 int(getattr(e, "weight_generation", 0)) for e in self.engines
             ],
             "watching": self.watcher.publish_dir,
+            "canary_armed": self.canary is not None,
+            "last_canary": self.last_canary,
         }

@@ -110,6 +110,11 @@ from llm_fine_tune_distributed_tpu.infer.supervisor import (
     FaultInjector,
 )
 from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+from llm_fine_tune_distributed_tpu.observe.slo import (
+    GenerationSlices,
+    MetricRing,
+    SloPolicy,
+)
 from llm_fine_tune_distributed_tpu.observe.tracing import (
     FlightRecorder,
     RequestTrace,
@@ -359,6 +364,11 @@ class ContinuousBatchingEngine:
         brownout_queue_wait_s: float = 2.0,
         brownout_drain_s: float = 10.0,
         brownout_cap_tokens: int = 32,
+        slo_policy: Optional[SloPolicy] = None,
+        slo_sample_interval_s: float = 1.0,
+        slo_ring_capacity: int = 512,
+        slo_generations_kept: int = 8,
+        trace_log_max_mb: float = 0.0,
     ):
         if getattr(generator, "_multihost", False):
             raise ValueError(
@@ -456,7 +466,28 @@ class ContinuousBatchingEngine:
         # the host sync) and shared by every per-token emit on that tick —
         # tracing adds no extra clock reads to the token hot path.
         self.recorder = FlightRecorder(flight_capacity)
-        self._trace_writer = TraceJsonlWriter(trace_log) if trace_log else None
+        self._trace_writer = (
+            TraceJsonlWriter(
+                trace_log,
+                max_bytes=int(max(0.0, float(trace_log_max_mb)) * 1024 * 1024),
+            )
+            if trace_log
+            else None
+        )
+        # SLO engine (observe/slo.py): the ring samples counters/gauges
+        # and histogram deltas on the tick clock already stamped below
+        # (zero extra clock reads per token); the policy edge-detects
+        # burn-rate breaches onto the flight recorder; the slices key
+        # settled-request latency by weight generation so a deploy's tail
+        # story is separable from the generation it replaced.
+        self.slo_policy = slo_policy if slo_policy is not None else SloPolicy()
+        self.metric_ring = MetricRing(
+            capacity=slo_ring_capacity, interval_s=slo_sample_interval_s
+        )
+        self.slo_slices = GenerationSlices(keep=slo_generations_kept)
+        # hot-path cache: the CURRENT generation's slice (re-pointed by
+        # _apply_swap) so per-token observes skip the dict lookup
+        self._gen_slice = self.slo_slices.slice_for(0)
         # XLA compile ledger (observe/xla.py): shared with the Generator so
         # fleet replicas over one Generator count each compilation once.
         # Stub generators (schema tests) have none — give the engine its own.
@@ -852,7 +883,20 @@ class ContinuousBatchingEngine:
         mfu, bw = self._utilization()
         snap["model_flops_utilization"] = mfu
         snap["hbm_bandwidth_utilization"] = bw
+        snap["slo"] = self.slo_report()
+        snap["per_generation"] = self.slo_slices.summaries()
         return snap
+
+    def slo_report(self) -> dict:
+        """Burn-rate evaluation of the SLO policy over the metric ring
+        (``GET /v1/slo``; pure — safe from HTTP handler threads)."""
+        return self.slo_policy.evaluate(self.metric_ring)
+
+    def history(self, metric: str, window_s: Optional[float] = None) -> dict:
+        """Trailing time series of one sampled counter/gauge
+        (``GET /v1/history``). Raises ``ValueError`` for an unknown
+        metric — the server turns that into a 400."""
+        return self.metric_ring.series(metric, window_s)
 
     def _utilization(self) -> "tuple[float, float]":
         """(MFU, HBM-bandwidth utilization) of the steady-state decode tick:
@@ -1097,6 +1141,12 @@ class ContinuousBatchingEngine:
         # drains ahead of a staged hot-swap settles BEFORE the apply, so it
         # visibly finished on the old generation (pinned by tests)
         req.weight_generation = self._weight_generation
+        # per-generation slice accounting (keyed by the stamp just taken;
+        # settles can arrive off the worker thread, so this goes through
+        # the slices' lock, once per request)
+        self.slo_slices.note_settled(
+            req.weight_generation, failed=req.error is not None
+        )
         with self._plock:
             self._pending -= 1
             if req.adapter is not None:
@@ -1490,6 +1540,8 @@ class ContinuousBatchingEngine:
                 self._invalidate_prefix_cache()
             self._weight_fingerprint = swap.fingerprint
             self._weight_generation += 1
+            # re-point the hot-path slice cache at the new generation
+            self._gen_slice = self.slo_slices.slice_for(self._weight_generation)
             dt = time.monotonic() - t0
             self.stats.incr("weight_swaps")
             self.stats.gauge("weight_generation", self._weight_generation)
@@ -1772,6 +1824,27 @@ class ContinuousBatchingEngine:
             dt_ms=round((self._now - t0) * 1000.0, 3),
         )
         self._update_brownout()
+        # SLO sampling rides the tick stamp taken above — the ring and
+        # the burn-rate evaluation add zero clock reads to the hot path
+        if self.metric_ring.due(self._now):
+            self._sample_slo(self._now)
+
+    def _sample_slo(self, now: float) -> None:
+        """Take one MetricRing sample and edge-detect SLO breaches onto
+        the flight recorder (worker thread only)."""
+        self.metric_ring.sample(
+            now,
+            self.stats,
+            gauges={
+                "queue_depth": self._queue_len(),
+                "live_slots": int(self._live.sum()),
+                "brownout_stage": self._brownout_stage,
+                "weight_generation": self._weight_generation,
+            },
+        )
+        report = self.slo_policy.evaluate(self.metric_ring, now=now)
+        for kind, fields in self.slo_policy.observe_transitions(report):
+            self.recorder.record(kind, **fields)
 
     def _decode_once(self, step) -> None:
         gen = self._generator
@@ -1943,11 +2016,21 @@ class ContinuousBatchingEngine:
         if req.first_token_t is None:
             req.first_token_t = now
             if req.enqueued_at:
-                self.stats.observe("ttft_s", now - req.enqueued_at)
+                ttft = now - req.enqueued_at
+                self.stats.observe("ttft_s", ttft)
+                # per-generation slice and per-tenant histogram reuse the
+                # SAME computed value — still zero extra clock reads
+                self._gen_slice.ttft.observe(ttft)
+                if req.adapter is not None:
+                    self.stats.tenant_observe(req.adapter, "ttft_s", ttft)
             if req.trace is not None:
                 req.trace.mark("first_token", now)
         elif req.last_token_t is not None:
-            self.stats.observe("inter_token_s", now - req.last_token_t)
+            gap = now - req.last_token_t
+            self.stats.observe("inter_token_s", gap)
+            self._gen_slice.inter_token.observe(gap)
+            if req.adapter is not None:
+                self.stats.tenant_observe(req.adapter, "inter_token_s", gap)
         req.last_token_t = now
         if req.tokens_q is not None:
             req.tokens_q.put(tok)

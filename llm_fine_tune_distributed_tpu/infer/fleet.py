@@ -83,6 +83,10 @@ from llm_fine_tune_distributed_tpu.infer.routing import (
 )
 from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
 from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+from llm_fine_tune_distributed_tpu.observe.slo import (
+    GenerationSlices,
+    SloPolicy,
+)
 from llm_fine_tune_distributed_tpu.observe.tracing import Histogram, RequestTrace
 from llm_fine_tune_distributed_tpu.observe.xla import CompileLedger
 
@@ -537,6 +541,46 @@ class EngineFleet:
             out[name] = merged
         return out
 
+    def merged_tenant_histograms(self) -> Dict[str, Dict[str, Histogram]]:
+        """Fleet-wide per-tenant latency histograms: one tenant's traffic
+        may land on several replicas, so each tenant's series is the
+        exact merge of its per-replica histograms."""
+        out: Dict[str, Dict[str, Histogram]] = {}
+        for rep in self.replicas:
+            for tenant, hists in rep.stats.tenant_histograms().items():
+                mine = out.setdefault(tenant, {})
+                for name, h in hists.items():
+                    if name not in mine:
+                        mine[name] = Histogram(h.bounds)
+                    mine[name].merge(h)
+        return out
+
+    def slo_report(self) -> dict:
+        """Fleet SLO view (``GET /v1/slo``): merged burn-rate report plus
+        each replica's own."""
+        per = {
+            str(i): rep.slo_report() for i, rep in enumerate(self.replicas)
+        }
+        merged = SloPolicy.merge_reports(list(per.values()))
+        merged["per_replica"] = per
+        return merged
+
+    def history(self, metric: str, window_s=None) -> dict:
+        """Per-replica trailing series of one sampled metric
+        (``GET /v1/history``). Rings are per-replica (their sample clocks
+        are independent), so the fleet answer is keyed by replica."""
+        per = {
+            str(i): rep.history(metric, window_s)
+            for i, rep in enumerate(self.replicas)
+        }
+        first = next(iter(per.values()))
+        return {
+            "metric": metric,
+            "kind": first["kind"],
+            "window_s": first["window_s"],
+            "replicas": per,
+        }
+
     def memory_breakdown(self) -> dict:
         """Fleet HBM accounting: weight fields from replica 0 (the resident
         weight tree is shared across replicas), KV-pool fields summed (each
@@ -664,6 +708,18 @@ class EngineFleet:
         agg["hbm_bandwidth_utilization"] = max(
             (s.get("hbm_bandwidth_utilization", 0.0) for s in snaps),
             default=0.0,
+        )
+        # SLO burn rates: compliant iff every replica is, per-window burn
+        # is the hottest replica's (observe/slo.SloPolicy.merge_reports)
+        agg["slo"] = SloPolicy.merge_reports(
+            [s.get("slo") for s in snaps if s.get("slo")]
+        )
+        # per-generation slices merge exactly (fixed-bucket histograms
+        # sum); mid-roll the generations legitimately differ per replica
+        agg["per_generation"] = GenerationSlices.merged_summaries(
+            rep.slo_slices
+            for rep in self.replicas
+            if getattr(rep, "slo_slices", None) is not None
         )
         agg["circuit_state"] = self.circuit_state
         agg["draining"] = self.draining

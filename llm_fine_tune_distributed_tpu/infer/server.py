@@ -80,6 +80,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 
 def serve(
@@ -120,11 +121,21 @@ def serve(
     watchdog_timeout_s: float = 0.0,
     flight_dir: Optional[str] = "outputs/flight_recorder",
     trace_log: Optional[str] = None,
+    trace_log_max_mb: float = 0.0,
     profile_dir: Optional[str] = None,
     publish_watch_dir: Optional[str] = None,
     publish_poll_s: float = 2.0,
     auto_rollback_window_s: float = 0.0,
     auto_rollback_error_rate: float = 0.5,
+    canary_window_s: float = 0.0,
+    canary_min_requests: int = 8,
+    slo_ttft_p99_s: float = 2.0,
+    slo_inter_token_p99_s: float = 0.5,
+    slo_error_rate: float = 0.01,
+    slo_availability: float = 0.999,
+    slo_fast_window_s: float = 60.0,
+    slo_slow_window_s: float = 600.0,
+    slo_sample_interval_s: float = 1.0,
     control: Optional[dict] = None,
 ) -> None:
     """``control``, when given, is populated with the drain entry points
@@ -315,6 +326,11 @@ def serve(
         "brownout_queue_wait_s": brownout_queue_wait_s,
         "brownout_drain_s": brownout_drain_s,
         "brownout_cap_tokens": brownout_cap_tokens,
+        # SLO engine (observe/slo.py): trace-log rotation bound and the
+        # metric-ring sample cadence; each replica gets its OWN SloPolicy
+        # in _make_replica (the policy carries breach-transition state)
+        "trace_log_max_mb": trace_log_max_mb,
+        "slo_sample_interval_s": slo_sample_interval_s,
     }
     if engine_kind in ("continuous", "paged"):
         if coordinator is not None:
@@ -342,6 +358,18 @@ def serve(
                 # supervisor, and stats. Crash artifacts get per-replica
                 # paths so two replicas' dumps cannot clobber each other.
                 kw = dict(engine_kwargs)
+                from llm_fine_tune_distributed_tpu.observe.slo import (
+                    SloPolicy,
+                )
+
+                kw["slo_policy"] = SloPolicy(
+                    ttft_p99_s=slo_ttft_p99_s,
+                    inter_token_p99_s=slo_inter_token_p99_s,
+                    error_rate=slo_error_rate,
+                    availability=slo_availability,
+                    fast_window_s=slo_fast_window_s,
+                    slow_window_s=slo_slow_window_s,
+                )
                 if adapter_dir:
                     # per-replica registry: pool residency is a replica-
                     # local property (the fleet routes tenants to the
@@ -411,12 +439,21 @@ def serve(
             HotSwapManager,
         )
 
+        canary_judge = None
+        if canary_window_s > 0:
+            from llm_fine_tune_distributed_tpu.observe.slo import CanaryJudge
+
+            canary_judge = CanaryJudge(
+                window_s=canary_window_s,
+                min_requests=canary_min_requests,
+            )
         deploy_mgr = HotSwapManager(
             cont_engine,
             CheckpointWatcher(publish_watch_dir, base_params=generator.params),
             poll_s=publish_poll_s,
             auto_rollback_window_s=auto_rollback_window_s,
             auto_rollback_error_rate=auto_rollback_error_rate,
+            canary=canary_judge,
         )
         deploy_mgr.start()
         print(
@@ -516,7 +553,10 @@ def serve(
             self._send(status, payload, headers=headers)
 
         def do_GET(self):  # noqa: N802 (stdlib casing)
-            if self.path == "/healthz":
+            # /v1/history takes a query string; every other route matches
+            # on the bare path
+            path, _, query = self.path.partition("?")
+            if path == "/healthz":
                 # a multi-host fleet whose followers died on a mirrored
                 # decode failure cannot serve again — report unhealthy so
                 # the orchestrator restarts every host (multihost.py)
@@ -540,7 +580,7 @@ def serve(
                     })
                 else:
                     self._send(200, "ok")
-            elif self.path == "/v1/stats":
+            elif path == "/v1/stats":
                 # serving-side observability: queue depth, live slots, slot
                 # occupancy, cumulative tokens — the continuous engine's
                 # counters (observe/metrics.ServingStats). Window mode
@@ -561,7 +601,7 @@ def serve(
                         cont_engine.memory_breakdown()
                     )
                 self._send(200, stats)
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 # Prometheus text exposition: every ServingStats counter/
                 # gauge/histogram plus per-device HBM gauges, scrape-ready.
                 # A fleet emits the aggregate series (unlabelled) followed
@@ -580,9 +620,11 @@ def serve(
                         for label in sorted(per, key=int)
                     ]
                     hists = cont_engine.merged_histograms()
+                    tenant_hists = cont_engine.merged_tenant_histograms()
                 elif cont_engine is not None:
                     snap = {"engine": cont_kind, **cont_engine.stats_snapshot()}
                     hists = cont_engine.stats.hist
+                    tenant_hists = cont_engine.stats.tenant_histograms()
                 else:
                     snap = {
                         "engine": "window",
@@ -590,11 +632,90 @@ def serve(
                         "max_batch": max_batch,
                     }
                     hists = None
+                    tenant_hists = None
                 text = prometheus_exposition(
                     snap, hists, memory=device_memory_report(),
                     replicas=replica_series,
+                    tenant_histograms=tenant_hists,
                 )
                 self._send(200, text, content_type=PROMETHEUS_CONTENT_TYPE)
+            elif path == "/v1/slo":
+                # burn-rate report per objective/window (observe/slo.py):
+                # a fleet answers with the merged view + per_replica
+                if cont_engine is None:
+                    self._send(404, {
+                        "error": "SLO evaluation needs a continuous/paged "
+                        "engine (the window engine has no metric ring)"
+                    })
+                    return
+                self._send(200, {
+                    "engine": cont_kind, **cont_engine.slo_report(),
+                })
+            elif path == "/v1/history":
+                # trailing time series of one sampled counter/gauge from
+                # the in-process metric ring: ?metric=<name>[&window=<s>]
+                if cont_engine is None:
+                    self._send(404, {
+                        "error": "metric history needs a continuous/paged "
+                        "engine (the window engine has no metric ring)"
+                    })
+                    return
+                qs = parse_qs(query)
+                metric = (qs.get("metric") or [None])[0]
+                if not metric:
+                    self._send(400, {
+                        "error": "missing ?metric=<name> "
+                        "(GET /v1/history?metric=queue_depth&window=60)"
+                    })
+                    return
+                window_s = None
+                try:
+                    if qs.get("window"):
+                        window_s = float(qs["window"][0])
+                        if not window_s > 0:
+                            raise ValueError
+                except ValueError:
+                    self._send(400, {
+                        "error": f"'window' must be a positive number of "
+                        f"seconds, got {qs['window'][0]!r}"
+                    })
+                    return
+                try:
+                    self._send(200, cont_engine.history(metric, window_s))
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+            elif path == "/v1/flight":
+                # the flight recorder, live: the same bounded event ring
+                # the supervisor dumps post-crash, readable before one
+                if cont_engine is None:
+                    self._send(404, {
+                        "error": "flight events need a continuous/paged "
+                        "engine (the window engine has no flight recorder)"
+                    })
+                    return
+                qs = parse_qs(query)
+                try:
+                    limit = int((qs.get("limit") or [256])[0])
+                    if limit <= 0:
+                        raise ValueError
+                except ValueError:
+                    self._send(400, {
+                        "error": f"'limit' must be a positive integer, "
+                        f"got {qs['limit'][0]!r}"
+                    })
+                    return
+                if isinstance(cont_engine, EngineFleet):
+                    self._send(200, {
+                        "replicas": {
+                            str(i): rep.recorder.events()[-limit:]
+                            for i, rep in enumerate(cont_engine.replicas)
+                        }
+                    })
+                else:
+                    self._send(
+                        200,
+                        {"events": cont_engine.recorder.events()[-limit:]},
+                    )
             else:
                 self._send(404, {"error": "not found"})
 
@@ -1237,6 +1358,11 @@ def main(argv: Optional[list] = None) -> int:
              "default",
     )
     parser.add_argument(
+        "--trace-log-max-mb", type=float, default=0.0,
+        help="rotate --trace-log when it exceeds this many MB (keeping the "
+             "last 5 rotated files); 0 = unbounded append",
+    )
+    parser.add_argument(
         "--profile-dir", default=None,
         help="enable POST /v1/profile: on-demand jax.profiler captures "
              "written to fresh subdirectories of this path (view with "
@@ -1265,6 +1391,53 @@ def main(argv: Optional[list] = None) -> int:
         "--auto-rollback-error-rate", type=float, default=0.5,
         help="failed-request fraction within the post-swap window that "
              "triggers the automatic rollback",
+    )
+    parser.add_argument(
+        "--canary-window-s", type=float, default=0.0,
+        help="canary-scored deploys (needs --replicas > 1): after swapping "
+             "the FIRST replica, compare its per-generation latency/error "
+             "deltas against the unswapped siblings for this many seconds; "
+             "a regression verdict rolls the canary back and blocks the "
+             "publish. 0 = roll all replicas without a canary window",
+    )
+    parser.add_argument(
+        "--canary-min-requests", type=int, default=8,
+        help="settled requests the canary (and the sibling baseline) must "
+             "see inside --canary-window-s for the verdict to bind; below "
+             "it the roll proceeds (the error-rate backstop still guards)",
+    )
+    parser.add_argument(
+        "--slo-ttft-p99-s", type=float, default=2.0,
+        help="SLO objective: p99 time-to-first-token target in seconds "
+             "(GET /v1/slo burn rates, serving_slo_* gauges)",
+    )
+    parser.add_argument(
+        "--slo-inter-token-p99-s", type=float, default=0.5,
+        help="SLO objective: p99 inter-token gap target in seconds",
+    )
+    parser.add_argument(
+        "--slo-error-rate", type=float, default=0.01,
+        help="SLO objective: max failed-request fraction (the error "
+             "budget burned by requests_failed)",
+    )
+    parser.add_argument(
+        "--slo-availability", type=float, default=0.999,
+        help="SLO objective: availability target; sheds (overflow, "
+             "deadline, quota) burn the 1 - target budget",
+    )
+    parser.add_argument(
+        "--slo-fast-window-s", type=float, default=60.0,
+        help="fast burn-rate window in seconds (a breach needs BOTH "
+             "windows hot: fast catches cliffs, slow catches bleeds)",
+    )
+    parser.add_argument(
+        "--slo-slow-window-s", type=float, default=600.0,
+        help="slow burn-rate window in seconds",
+    )
+    parser.add_argument(
+        "--slo-sample-interval-s", type=float, default=1.0,
+        help="seconds between metric-ring samples (taken on the scheduler "
+             "tick clock — zero extra clock reads on the token hot path)",
     )
     args = parser.parse_args(argv)
     if not os.path.isdir(args.model_dir):
@@ -1296,11 +1469,21 @@ def main(argv: Optional[list] = None) -> int:
           watchdog_timeout_s=args.watchdog_timeout_s,
           flight_dir=args.flight_dir or None,
           trace_log=args.trace_log,
+          trace_log_max_mb=args.trace_log_max_mb,
           profile_dir=args.profile_dir,
           publish_watch_dir=args.publish_watch_dir,
           publish_poll_s=args.publish_poll_s,
           auto_rollback_window_s=args.auto_rollback_window_s,
-          auto_rollback_error_rate=args.auto_rollback_error_rate)
+          auto_rollback_error_rate=args.auto_rollback_error_rate,
+          canary_window_s=args.canary_window_s,
+          canary_min_requests=args.canary_min_requests,
+          slo_ttft_p99_s=args.slo_ttft_p99_s,
+          slo_inter_token_p99_s=args.slo_inter_token_p99_s,
+          slo_error_rate=args.slo_error_rate,
+          slo_availability=args.slo_availability,
+          slo_fast_window_s=args.slo_fast_window_s,
+          slo_slow_window_s=args.slo_slow_window_s,
+          slo_sample_interval_s=args.slo_sample_interval_s)
     return 0
 
 

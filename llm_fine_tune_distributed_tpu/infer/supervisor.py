@@ -157,6 +157,8 @@ class FaultInjector:
         self._decode_at: set = set()  # absolute decode step indices
         self._decode_next = 0
         self._prefill_next = 0
+        # latency (not failure) injection: (remaining ticks, seconds each)
+        self._decode_delay = (0, 0.0)
 
     def fail_decode_at(self, *steps: int) -> None:
         """Fail the decode tick whose absolute step index (1-based, counted
@@ -174,7 +176,30 @@ class FaultInjector:
         with self._lock:
             self._prefill_next += int(k)
 
+    def delay_decode_next(self, k: int = 1, seconds: float = 0.05) -> None:
+        """Slow (don't fail) the next ``k`` decode ticks by ``seconds``
+        each — a pure latency regression, invisible to error-rate gates.
+        This is what the SERVE_SLO bench arm injects into a canary to
+        prove the latency verdict catches what the error backstop can't."""
+        with self._lock:
+            self._decode_delay = (
+                self._decode_delay[0] + int(k), float(seconds)
+            )
+
+    def clear_delays(self) -> None:
+        """Disarm any pending decode delays (bench cleanup)."""
+        with self._lock:
+            self._decode_delay = (0, 0.0)
+
     def maybe_fail_decode(self, step_index: int) -> None:
+        delay = 0.0
+        with self._lock:
+            remaining, seconds = self._decode_delay
+            if remaining > 0:
+                self._decode_delay = (remaining - 1, seconds)
+                delay = seconds
+        if delay > 0.0:
+            time.sleep(delay)
         with self._lock:
             if step_index in self._decode_at:
                 self._decode_at.discard(step_index)

@@ -119,6 +119,11 @@ class ServingStats:
         "ttft_s", "inter_token_s", "queue_wait_s",
         "decode_tick_s", "prefill_chunk_s", "spec_run_len",
     )
+    # per-tenant latency histograms (tenant-labelled series in /metrics):
+    # only the tails a tenant actually feels — TTFT and inter-token gaps.
+    # Lazily created on a tenant's first observation so the base-model
+    # path pays nothing.
+    TENANT_HIST_SPECS = ("ttft_s", "inter_token_s")
 
     def __init__(self, slots: int = 0, total_blocks: int = 0):
         self._lock = threading.Lock()
@@ -129,6 +134,8 @@ class ServingStats:
         }
         # per-tenant multi-tenant counters: tenant -> {TENANT_KEYS: int}
         self._tenants: Dict[str, Dict[str, int]] = {}
+        # per-tenant latency histograms: tenant -> {TENANT_HIST_SPECS: Histogram}
+        self._tenant_hist: Dict[str, Dict[str, Histogram]] = {}
         # tier-labelled sheds (overflow + brownout + displacement), every
         # tier always present (schema stability with zero sheds)
         self._tier_shed: Dict[str, int] = {t: 0 for t in self.SHED_TIERS}
@@ -198,6 +205,33 @@ class ServingStats:
         """Record one histogram observation (histograms carry their own
         locks, so this does not contend with the counter lock)."""
         self.hist[name].observe(value)
+
+    def tenant_observe(self, tenant: str, name: str, value: float) -> None:
+        """Record one observation into a tenant's latency histogram
+        (``TENANT_HIST_SPECS``), creating the tenant's set on first use.
+        The counter lock only guards the (rare) dict insert; the observe
+        itself rides the histogram's own lock."""
+        with self._lock:
+            hists = self._tenant_hist.get(tenant)
+            if hists is None:
+                hists = self._tenant_hist[tenant] = {
+                    k: Histogram.exponential() for k in self.TENANT_HIST_SPECS
+                }
+        hists[name].observe(value)
+
+    def tenant_histograms(self) -> Dict[str, Dict[str, Histogram]]:
+        """Shallow copy of the per-tenant latency histogram map (the
+        Histogram objects themselves are shared and internally locked —
+        exposition reads them live)."""
+        with self._lock:
+            return {t: dict(h) for t, h in self._tenant_hist.items()}
+
+    def values(self, names) -> Dict[str, int]:
+        """One consistent read of several counters/gauges (the MetricRing
+        sampler's entry point — one lock acquisition per sample, not per
+        name)."""
+        with self._lock:
+            return {n: self._values.get(n, 0) for n in names}
 
     def _tokens_rate(self, now: float, tokens_served: int) -> float:
         # irregular-interval EWMA: weight = 1 - exp(-dt/60s), so the gauge
@@ -287,6 +321,7 @@ def prometheus_exposition(
     replicas: Optional[
         List[Tuple[str, Dict[str, Any], Optional[Dict[str, Histogram]]]]
     ] = None,
+    tenant_histograms: Optional[Dict[str, Dict[str, Histogram]]] = None,
 ) -> str:
     """Render a ``ServingStats.snapshot()`` (plus the live histogram
     objects and an optional ``device_memory_report()``) as Prometheus text
@@ -401,6 +436,67 @@ def prometheus_exposition(
     lines.append(
         f"{name} {int(compile_snap.get('recompiles_after_warmup', 0))}"
     )
+    # SLO burn-rate samples: ``slo`` is a nested report dict (skipped by
+    # the numeric loop), emitted explicitly as one compliance gauge and
+    # one burn-rate gauge per {objective, window}. TYPE lines are
+    # UNCONDITIONAL when the snapshot carries the key, so the schema is
+    # identical with an idle ring (window-engine fallback has no key and
+    # emits nothing — same contract as ``compile``).
+    slo = snap.get("slo")
+    if slo is not None:
+        name = f"{prefix}_slo_compliant"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {int(bool(slo.get('compliant', True)))}")
+        name = f"{prefix}_slo_burn_rate"
+        lines.append(f"# TYPE {name} gauge")
+        for obj in sorted(slo.get("objectives") or {}):
+            for label, w in sorted(
+                (slo["objectives"][obj].get("windows") or {}).items()
+            ):
+                lines.append(
+                    f'{name}{{objective="{obj}",window="{label}"}} '
+                    f'{float(w.get("burn_rate", 0.0)):.10g}'
+                )
+    # per-weight-generation slices: settled-request counts and latency
+    # p99s labelled by the generation the request resolved under — the
+    # series a deploy's tail-latency story is read from.
+    per_gen = snap.get("per_generation")
+    if per_gen is not None:
+        gen_series = (
+            ("generation_requests_completed_total", "counter",
+             lambda rec: int(rec.get("completed", 0))),
+            ("generation_requests_failed_total", "counter",
+             lambda rec: int(rec.get("failed", 0))),
+            ("generation_ttft_p99_seconds", "gauge",
+             lambda rec: float((rec.get("ttft") or {}).get("p99", 0.0))),
+            ("generation_inter_token_p99_seconds", "gauge",
+             lambda rec: float((rec.get("inter_token") or {}).get("p99", 0.0))),
+        )
+        for base, kind, get in gen_series:
+            name = f"{prefix}_{base}"
+            lines.append(f"# TYPE {name} {kind}")
+            for gen in sorted(per_gen, key=lambda g: int(g)):
+                lines.append(
+                    f'{name}{{generation="{gen}"}} {get(per_gen[gen]):.10g}'
+                )
+    # per-tenant latency histograms: tenant-labelled bucket series for
+    # the tails each tenant actually feels. TYPE lines are UNCONDITIONAL
+    # whenever the caller passes a map (possibly empty) so the schema is
+    # identical with zero tenants; the window-engine fallback passes
+    # None and emits nothing.
+    if tenant_histograms is not None:
+        for key in ServingStats.TENANT_HIST_SPECS:
+            name = _prom_name(f"tenant_{key}", prefix)
+            lines.append(f"# TYPE {name} histogram")
+            for tenant in sorted(tenant_histograms):
+                h = tenant_histograms[tenant].get(key)
+                if h is None:
+                    continue
+                lines.extend(
+                    h.prometheus_lines(
+                        name, labels=f'tenant="{tenant}"', include_type=False
+                    )
+                )
     for key in histograms or {}:
         name = _prom_name(key, prefix)
         lines.extend(histograms[key].prometheus_lines(name))

@@ -219,18 +219,53 @@ class TraceJsonlWriter:
     Writes happen on the engine scheduler thread at terminal settle; the
     lock only matters for the window-engine case where settles can race a
     drain, and it is uncontended in steady state.
+
+    ``max_bytes`` > 0 bounds the file: when the next line would push the
+    active file past the limit it is rotated to ``path.1`` (existing
+    ``path.N`` shift to ``path.N+1``) and only the newest ``keep``
+    rotated files survive — a long-lived server cannot fill its disk
+    with traces. Size is tracked in-process (one ``tell()`` at open), so
+    the hot path never stats the file.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: int = 0, keep: int = 5):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        self._max_bytes = max(0, int(max_bytes))
+        self._keep = max(1, int(keep))
         self._f = open(path, "a")
+        self._size = self._f.tell()
         self._lock = threading.Lock()
 
     def write(self, record: Dict[str, Any]) -> None:
         line = json.dumps(record) + "\n"
         with self._lock:
+            if (
+                self._max_bytes
+                and self._size > 0
+                and self._size + len(line) > self._max_bytes
+            ):
+                self._rotate()
             self._f.write(line)
             self._f.flush()
+            self._size += len(line)
+
+    def _rotate(self) -> None:
+        """Shift ``path.N`` -> ``path.N+1`` (dropping past ``keep``),
+        move the active file to ``path.1``, reopen fresh. Lock held by
+        the caller."""
+        self._f.close()
+        for i in range(self._keep, 0, -1):
+            src = f"{self._path}.{i}"
+            if not os.path.exists(src):
+                continue
+            if i >= self._keep:
+                os.remove(src)
+            else:
+                os.replace(src, f"{self._path}.{i + 1}")
+        os.replace(self._path, f"{self._path}.1")
+        self._f = open(self._path, "a")
+        self._size = 0
 
     def close(self) -> None:
         with self._lock:

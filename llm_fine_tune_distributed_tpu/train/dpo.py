@@ -478,8 +478,16 @@ class DPOTrainer(SFTTrainer):
     def _prepare_steps(self) -> None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from llm_fine_tune_distributed_tpu.observe.xla import (
+            CompileLedger,
+            instrument,
+        )
+
         act = self._make_shardings()
         self._pair_mask_sharding = NamedSharding(self.mesh, P(("data", "fsdp")))
+        # train() reads this ledger for compile_total/recompiles_after_warmup
+        # step logs; aot=False throughout — the train step donates its state.
+        self.compile_ledger = CompileLedger()
 
         if getattr(self, "_pipe_size", 1) > 1:
             # pipe mesh axis: both DPO forwards run as GPipe schedules over
@@ -488,10 +496,19 @@ class DPOTrainer(SFTTrainer):
                 self.model_config, self.config, self.optimizer, self.mesh,
                 self._layer_vec,
             )
-            jitted = jax.jit(step, donate_argnums=(0,))
+            jitted = instrument(
+                "dpo_train_step", jax.jit(step, donate_argnums=(0,)),
+                self.compile_ledger, aot=False,
+            )
             self.train_step = lambda state, batch: jitted(state, self.ref_trainable, batch)
-            self._dpo_eval = jax.jit(
-                build_pipeline_dpo_eval_step(self.model_config, self.config, self.mesh)
+            self._dpo_eval = instrument(
+                "dpo_eval_step",
+                jax.jit(
+                    build_pipeline_dpo_eval_step(
+                        self.model_config, self.config, self.mesh
+                    )
+                ),
+                self.compile_ledger, aot=False,
             )
             return
 
@@ -500,11 +517,19 @@ class DPOTrainer(SFTTrainer):
             self.model_config, self.config, self.optimizer, activation_sharding=act,
             quant_impl=quant_impl,
         )
-        jitted = jax.jit(step, donate_argnums=(0,))
+        jitted = instrument(
+            "dpo_train_step", jax.jit(step, donate_argnums=(0,)),
+            self.compile_ledger, aot=False,
+        )
         self.train_step = lambda state, batch: jitted(state, self.ref_trainable, batch)
-        self._dpo_eval = jax.jit(
-            build_dpo_eval_step(self.model_config, self.config, activation_sharding=act,
-                                quant_impl=quant_impl)
+        self._dpo_eval = instrument(
+            "dpo_eval_step",
+            jax.jit(
+                build_dpo_eval_step(self.model_config, self.config,
+                                    activation_sharding=act,
+                                    quant_impl=quant_impl)
+            ),
+            self.compile_ledger, aot=False,
         )
 
     # ------------------------------------------------------------------ eval

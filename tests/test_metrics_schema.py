@@ -84,6 +84,10 @@ SNAPSHOT_KEYS = {
     # XLA introspection (engine.stats_snapshot): the compile-ledger
     # sub-snapshot and the roofline utilization gauges
     "compile", "model_flops_utilization", "hbm_bandwidth_utilization",
+    # SLO engine (observe/slo.py): the burn-rate report over the metric
+    # ring, and settled-request latency/error slices keyed by the weight
+    # generation the request resolved under
+    "slo", "per_generation",
 }
 PAGED_ONLY_KEYS = {
     "total_blocks", "block_pool_occupancy", "peak_block_pool_occupancy",
@@ -172,6 +176,18 @@ EXPECTED_METRICS = {
     ("serving_recompiles_after_warmup_total", "counter"),
     ("serving_model_flops_utilization", "gauge"),
     ("serving_hbm_bandwidth_utilization", "gauge"),
+    # SLO engine: overall compliance + one burn-rate sample per
+    # {objective, window}; per-generation settled counts and latency p99s
+    ("serving_slo_compliant", "gauge"),
+    ("serving_slo_burn_rate", "gauge"),
+    ("serving_generation_requests_completed_total", "counter"),
+    ("serving_generation_requests_failed_total", "counter"),
+    ("serving_generation_ttft_p99_seconds", "gauge"),
+    ("serving_generation_inter_token_p99_seconds", "gauge"),
+    # per-tenant latency histograms (tenant="name" bucket series; TYPE
+    # lines emitted whenever a tenant-histogram map is passed, even empty)
+    ("serving_tenant_ttft_seconds", "histogram"),
+    ("serving_tenant_inter_token_seconds", "histogram"),
     # histograms (trailing _s -> _seconds; spec_run_len is unitless)
     ("serving_ttft_seconds", "histogram"),
     ("serving_inter_token_seconds", "histogram"),
@@ -194,7 +210,10 @@ FAKE_MEMORY = {
 def test_metrics_exposition_schema():
     engine = _make("paged")
     snap = {"engine": "paged", **engine.stats_snapshot()}
-    text = prometheus_exposition(snap, engine.stats.hist, memory=FAKE_MEMORY)
+    text = prometheus_exposition(
+        snap, engine.stats.hist, memory=FAKE_MEMORY,
+        tenant_histograms=engine.stats.tenant_histograms(),
+    )
     typed = {
         (m.group(1), m.group(2))
         for m in re.finditer(r"^# TYPE (\S+) (\S+)$", text, re.M)
@@ -209,8 +228,12 @@ def test_metrics_exposition_well_formed():
     engine = _make("paged")
     engine.stats.incr("tokens_served", 5)
     engine.stats.observe("ttft_s", 0.12)
+    engine.stats.tenant_observe("acme", "ttft_s", 0.12)
     snap = {"engine": "paged", **engine.stats_snapshot()}
-    text = prometheus_exposition(snap, engine.stats.hist, memory=FAKE_MEMORY)
+    text = prometheus_exposition(
+        snap, engine.stats.hist, memory=FAKE_MEMORY,
+        tenant_histograms=engine.stats.tenant_histograms(),
+    )
     assert text.endswith("\n")
     sample = re.compile(
         r'^[a-zA-Z_][a-zA-Z0-9_]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
@@ -305,7 +328,8 @@ def test_fleet_metrics_exposition_replica_labels():
         for label in sorted(per, key=int)
     ]
     text = prometheus_exposition(
-        snap, fleet.merged_histograms(), memory=FAKE_MEMORY, replicas=series
+        snap, fleet.merged_histograms(), memory=FAKE_MEMORY, replicas=series,
+        tenant_histograms=fleet.merged_tenant_histograms(),
     )
     typed = {
         (m.group(1), m.group(2))
@@ -359,6 +383,99 @@ def test_tenant_series_schema_and_labels():
     # tenant_incr floors at zero (double-release guard)
     engine.stats.tenant_incr("acme", "queue_depth", -5)
     assert engine.stats_snapshot()["per_tenant"]["acme"]["queue_depth"] == 0
+
+
+def test_tenant_histogram_series_labels():
+    """Per-tenant latency histograms: TYPE lines appear whenever a map is
+    passed (even empty), and a tenant's observations render as
+    tenant-labelled cumulative buckets under them."""
+    engine = _make("paged")
+    snap = {"engine": "paged", **engine.stats_snapshot()}
+    # zero tenants: bare TYPE lines, no bucket samples
+    text = prometheus_exposition(
+        snap, engine.stats.hist, memory=FAKE_MEMORY, tenant_histograms={}
+    )
+    assert "# TYPE serving_tenant_ttft_seconds histogram" in text
+    assert "# TYPE serving_tenant_inter_token_seconds histogram" in text
+    assert "serving_tenant_ttft_seconds_bucket{" not in text
+    # observed tenants get labelled buckets; an engine-level histogram
+    # observation must NOT leak into the tenant series
+    engine.stats.observe("ttft_s", 0.12)
+    engine.stats.tenant_observe("acme", "ttft_s", 0.12)
+    engine.stats.tenant_observe("acme", "inter_token_s", 0.01)
+    engine.stats.tenant_observe("beta", "ttft_s", 3.0)
+    snap = {"engine": "paged", **engine.stats_snapshot()}
+    text = prometheus_exposition(
+        snap, engine.stats.hist, memory=FAKE_MEMORY,
+        tenant_histograms=engine.stats.tenant_histograms(),
+    )
+    assert re.search(
+        r'serving_tenant_ttft_seconds_bucket\{tenant="acme",le="0\.2048"\} 1',
+        text,
+    )
+    assert 'serving_tenant_ttft_seconds_count{tenant="acme"} 1' in text
+    assert 'serving_tenant_ttft_seconds_count{tenant="beta"} 1' in text
+    assert 'serving_tenant_inter_token_seconds_count{tenant="acme"} 1' in text
+    assert 'serving_tenant_inter_token_seconds_count{tenant="beta"} 0' in text
+
+
+def test_slo_and_generation_exposition_samples():
+    """SLO engine surfaces: an idle engine reports a compliant SLO over
+    the four pinned objectives, a generation-0 slice exists from boot, and
+    both render as the pinned gauge/series names."""
+    engine = _make("paged")
+    snap = {"engine": "paged", **engine.stats_snapshot()}
+    assert snap["slo"]["compliant"] is True
+    assert set(snap["slo"]["objectives"]) == {
+        "ttft_p99", "inter_token_p99", "error_rate", "availability",
+    }
+    for obj in snap["slo"]["objectives"].values():
+        assert set(obj["windows"]) == {"fast", "slow"}
+    assert "0" in snap["per_generation"]
+    text = prometheus_exposition(
+        snap, engine.stats.hist, memory=FAKE_MEMORY,
+        tenant_histograms=engine.stats.tenant_histograms(),
+    )
+    assert "serving_slo_compliant 1" in text
+    assert (
+        'serving_slo_burn_rate{objective="error_rate",window="fast"} 0'
+        in text
+    )
+    assert (
+        'serving_slo_burn_rate{objective="ttft_p99",window="slow"} 0'
+        in text
+    )
+    assert (
+        'serving_generation_requests_completed_total{generation="0"} 0'
+        in text
+    )
+    assert (
+        'serving_generation_ttft_p99_seconds{generation="0"} 0' in text
+    )
+
+
+def test_every_stats_counter_and_gauge_is_exported():
+    """Coverage guard: every ServingStats counter renders as a typed
+    ``serving_<name>_total`` counter and every gauge as a typed gauge in
+    the exposition — adding a stat without exporting it breaks here, not
+    on a dashboard."""
+    from llm_fine_tune_distributed_tpu.observe.metrics import _prom_name
+
+    engine = _make("paged")
+    snap = {"engine": "paged", **engine.stats_snapshot()}
+    text = prometheus_exposition(
+        snap, engine.stats.hist, memory=FAKE_MEMORY,
+        tenant_histograms=engine.stats.tenant_histograms(),
+    )
+    for name in ServingStats.COUNTERS:
+        prom = _prom_name(name, "serving")
+        assert f"# TYPE {prom}_total counter" in text, name
+    for name in ServingStats.GAUGES:
+        prom = _prom_name(name, "serving")
+        assert f"# TYPE {prom} gauge" in text, name
+    for name in ServingStats.HISTOGRAM_SPECS:
+        prom = _prom_name(name, "serving")
+        assert f"# TYPE {prom} histogram" in text, name
 
 
 def test_fleet_merges_per_tenant_across_replicas():

@@ -404,6 +404,83 @@ def test_generate_with_trace(server):
         assert "trace" not in json.loads(r.read())
 
 
+def test_slo_endpoint(server):
+    """GET /v1/slo: the burn-rate report over the four pinned objectives,
+    each with a fast and slow window."""
+    with urllib.request.urlopen(f"{server}/v1/slo", timeout=30) as r:
+        report = json.loads(r.read())
+    assert report["engine"] == "continuous"
+    assert report["compliant"] in (True, False)
+    assert set(report["objectives"]) == {
+        "ttft_p99", "inter_token_p99", "error_rate", "availability",
+    }
+    for obj in report["objectives"].values():
+        assert set(obj["windows"]) == {"fast", "slow"}
+        for w in obj["windows"].values():
+            assert w["burn_rate"] >= 0.0
+            assert 0.0 <= w["bad_fraction"] <= 1.0
+
+
+def test_history_endpoint_series_and_errors(server):
+    """GET /v1/history?metric=&window=: counter series carry per-sample
+    deltas, gauges don't; bad queries are 400s naming the problem."""
+    url = f"{server}/v1/history?metric=queue_depth&window=60"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        series = json.loads(r.read())
+    assert series["metric"] == "queue_depth"
+    assert series["kind"] == "gauge"
+    assert series["window_s"] == 60.0
+    assert isinstance(series["samples"], list)
+    url = f"{server}/v1/history?metric=tokens_served"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        series = json.loads(r.read())
+    assert series["kind"] == "counter"
+    assert series["window_s"] is None
+    for point in series["samples"]:
+        assert {"age_s", "value", "delta"} <= set(point)
+    for bad in (
+        "/v1/history",  # missing ?metric
+        "/v1/history?metric=not_a_metric",
+        "/v1/history?metric=queue_depth&window=-5",
+        "/v1/history?metric=queue_depth&window=abc",
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{server}{bad}", timeout=30)
+        assert e.value.code == 400
+
+
+def test_flight_endpoint(server):
+    """GET /v1/flight: the live flight-recorder ring — admissions from
+    served requests appear, ?limit= truncates, limit<=0 is a 400."""
+    req = urllib.request.Request(
+        f"{server}/v1/generate",
+        data=json.dumps(
+            {"question": "q?", "max_new_tokens": 4, "greedy": True}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        r.read()
+    with urllib.request.urlopen(f"{server}/v1/flight", timeout=30) as r:
+        events = json.loads(r.read())["events"]
+    assert events and all("kind" in e and "t_s" in e for e in events)
+    with urllib.request.urlopen(f"{server}/v1/flight?limit=2", timeout=30) as r:
+        assert len(json.loads(r.read())["events"]) <= 2
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(f"{server}/v1/flight?limit=0", timeout=30)
+    assert e.value.code == 400
+
+
+def test_slo_history_flight_404_on_window_engine(model_dir):
+    """The window engine has no metric ring / flight recorder; the SLO
+    surfaces answer 404, not 500."""
+    base = _start_server(model_dir, engine_kind="window")
+    for path in ("/v1/slo", "/v1/history?metric=queue_depth", "/v1/flight"):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}{path}", timeout=30)
+        assert e.value.code == 404
+
+
 # ------------------------------------------------- engine-level speculation
 
 

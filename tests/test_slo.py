@@ -1,0 +1,643 @@
+"""SLO engine (ISSUE 13, observe/slo.py): metric ring, per-generation
+slices, burn-rate objectives, canary-scored deploys, plus the satellite
+guards that ride the same PR:
+
+- ``MetricRing`` samples on the caller's clock stamps (no clock reads of
+  its own), delta-decodes histograms exactly, and answers windowed
+  counter/histogram/series queries including the wrap/baseline edge cases;
+- ``GenerationSlices`` keys latency/error accounting by weight
+  generation, prunes to ``keep``, and its delta/merge math is exact;
+- ``SloPolicy`` burn rates follow the SRE convention (burn = bad fraction
+  / budget), a breach needs EVERY window hot with ``min_events``, and
+  ``observe_transitions`` edge-detects breach/recovery flight events;
+- ``CanaryJudge`` verdicts (pass / regression / insufficient traffic /
+  no siblings) from per-generation deltas under live-ish traffic, and
+  ``HotSwapManager`` blocks + rolls back a canary-rejected deploy without
+  advancing the deployed step;
+- ``CheckpointWatcher`` eval gate: a publish whose manifest metrics
+  regress vs the resident generation is skipped with a
+  ``publish_rejected_eval`` flight event (satellite 3);
+- ``TraceJsonlWriter`` size-based rotation keeps the last K segments
+  (satellite 1).
+
+Everything here is host-side (stub stats, fake engines, no model), so the
+whole file runs jax-free.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
+from llm_fine_tune_distributed_tpu.observe.slo import (
+    RING_COUNTERS,
+    RING_GAUGES,
+    CanaryJudge,
+    GenerationSlices,
+    MetricRing,
+    SloPolicy,
+    _frac_above,
+)
+from llm_fine_tune_distributed_tpu.observe.tracing import (
+    FlightRecorder,
+    Histogram,
+    TraceJsonlWriter,
+)
+
+
+# ------------------------------------------------------------- MetricRing
+
+
+def test_ring_due_and_sample_cadence():
+    ring = MetricRing(capacity=8, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    assert ring.due(10.0)  # first sample is always due
+    ring.sample(10.0, stats)
+    assert not ring.due(10.5)
+    assert ring.due(11.0)
+    ring.sample(11.0, stats)
+    assert len(ring) == 2
+
+
+def test_ring_window_counters_baselines():
+    ring = MetricRing(capacity=8, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    for t in (10.0, 11.0, 12.0):
+        stats.incr("tokens_served", 5)
+        ring.sample(t, stats)
+    # cumulative at samples: 5, 10, 15. A 1.5s window from t=12 baselines
+    # at the t=10.5-or-older sample (t=10, value 5) -> delta 10.
+    assert ring.window_counters(1.5, now=12.0)["tokens_served"] == 10
+    # a window wider than the (unwrapped) history baselines at engine
+    # start (zero): the full cumulative value counts
+    assert ring.window_counters(100.0, now=12.0)["tokens_served"] == 15
+
+
+def test_ring_window_counters_wrapped_baseline():
+    ring = MetricRing(capacity=2, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    for t in (10.0, 11.0, 12.0):  # first sample falls off the ring
+        stats.incr("tokens_served", 5)
+        ring.sample(t, stats)
+    # wrapped: the oldest RETAINED sample (t=11, cum 10) is the honest
+    # baseline — not zero, which would double-count the evicted history
+    assert ring.window_counters(100.0, now=12.0)["tokens_served"] == 5
+
+
+def test_ring_histogram_deltas_are_exact():
+    ring = MetricRing(capacity=8, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    stats.observe("ttft_s", 0.1)
+    ring.sample(10.0, stats)
+    stats.observe("ttft_s", 0.2)
+    stats.observe("ttft_s", 0.2)
+    ring.sample(11.0, stats)
+    stats.observe("ttft_s", 0.4)
+    ring.sample(12.0, stats)
+    # trailing 1.5s from t=12 covers the t=11 and t=12 samples: 3 obs
+    counts, total, s = ring.window_histogram("ttft_s", 1.5, now=12.0)
+    assert total == 3
+    assert sum(counts) == 3
+    assert s == pytest.approx(0.8)
+    # full history: all 4
+    _, total, s = ring.window_histogram("ttft_s", 100.0, now=12.0)
+    assert total == 4
+    assert s == pytest.approx(0.9)
+
+
+def test_ring_series_counter_and_gauge():
+    ring = MetricRing(capacity=8, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    for t, depth in ((10.0, 2), (11.0, 7)):
+        stats.incr("requests_admitted", 3)
+        ring.sample(t, stats, gauges={"queue_depth": depth})
+    series = ring.series("requests_admitted", now=11.0)
+    assert series["kind"] == "counter"
+    assert [p["value"] for p in series["samples"]] == [3, 6]
+    assert [p["delta"] for p in series["samples"]] == [0, 3]
+    assert [p["age_s"] for p in series["samples"]] == [1.0, 0.0]
+    series = ring.series("queue_depth", now=11.0)
+    assert series["kind"] == "gauge"
+    assert [p["value"] for p in series["samples"]] == [2, 7]
+    with pytest.raises(ValueError):
+        ring.series("not_a_metric")
+
+
+def test_ring_metric_names_cover_counters_and_gauges():
+    ring = MetricRing()
+    assert set(ring.metrics()) == set(RING_COUNTERS) | set(RING_GAUGES)
+
+
+def test_frac_above_interpolates():
+    h = Histogram.exponential()
+    for v in (0.01, 0.02, 0.04, 10.0):
+        h.observe(v)
+    counts, total, _ = h._state()
+    # everything above a tiny threshold; past the last finite bound only
+    # the overflow bucket counts (10.0 < 400 lives in a finite bucket)
+    assert _frac_above(h.bounds, counts, total, 1e-6) == pytest.approx(1.0)
+    assert _frac_above(h.bounds, counts, total, 1e6) == pytest.approx(0.0)
+    # one of four observations sits above 1.0
+    assert _frac_above(h.bounds, counts, total, 1.0) == pytest.approx(
+        0.25, abs=0.05
+    )
+
+
+# ------------------------------------------------------ GenerationSlices
+
+
+def test_generation_slices_settle_and_summaries():
+    slices = GenerationSlices(keep=4)
+    s0 = slices.slice_for(0)
+    s0.ttft.observe(0.1)
+    s0.inter_token.observe(0.02)
+    slices.note_settled(0, failed=False)
+    slices.note_settled(0, failed=True)
+    out = slices.summaries()
+    assert set(out) == {"0"}
+    assert out["0"]["completed"] == 1
+    assert out["0"]["failed"] == 1
+    assert out["0"]["error_rate"] == pytest.approx(0.5)
+    assert out["0"]["ttft"]["count"] == 1
+
+
+def test_generation_slices_prune_to_keep():
+    slices = GenerationSlices(keep=2)
+    for gen in range(5):
+        slices.slice_for(gen)
+    assert slices.generations() == [3, 4]
+    # a late settle into a long-pruned generation (swap storm straggler)
+    # must not crash and must not grow the slice set past ``keep``
+    slices.note_settled(0, failed=False)
+    assert slices.generations() == [3, 4]
+
+
+def test_generation_slices_delta_and_merge():
+    slices = GenerationSlices()
+    s = slices.slice_for(1)
+    s.ttft.observe(0.1)
+    slices.note_settled(1, failed=False)
+    then = slices.state(1)
+    s.ttft.observe(0.4)
+    s.ttft.observe(0.4)
+    slices.note_settled(1, failed=False)
+    slices.note_settled(1, failed=True)
+    d = GenerationSlices.delta(slices.state(1), then)
+    assert d["completed"] == 1 and d["failed"] == 1
+    assert d["error_rate"] == pytest.approx(0.5)
+    assert d["ttft"]["count"] == 2  # only the post-snapshot observations
+    assert d["ttft"]["mean"] == pytest.approx(0.4, rel=0.01)
+
+    other = GenerationSlices()
+    o = other.slice_for(1)
+    o.ttft.observe(0.2)
+    other.note_settled(1, failed=False)
+    merged = GenerationSlices.merge_states(
+        [slices.state(1), other.state(1)]
+    )
+    assert merged["completed"] == 3 and merged["failed"] == 1
+    assert merged["ttft"][1] == 4  # histogram totals sum
+
+    fleet = GenerationSlices.merged_summaries([slices, other])
+    assert fleet["1"]["completed"] == 3
+    assert fleet["1"]["ttft"]["count"] == 4
+
+
+# ------------------------------------------------------------- SloPolicy
+
+
+def _ring_with_errors(n_ok, n_bad, window_t=(10.0, 660.0, 700.0)):
+    """A ring whose history shows n_ok completions / n_bad failures landed
+    inside BOTH the fast (60s) and slow (600s) windows as of t=700: the
+    baseline sample at t=10 predates both cutoffs, the activity samples
+    sit inside them."""
+    ring = MetricRing(capacity=16, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    ring.sample(window_t[0], stats)
+    stats.incr("requests_completed", n_ok)
+    stats.incr("requests_failed", n_bad)
+    for t in window_t[1:]:
+        ring.sample(t, stats)
+    return ring
+
+
+def test_slo_error_rate_burn_math():
+    policy = SloPolicy(
+        error_rate=0.01, fast_window_s=60.0, slow_window_s=600.0,
+        min_events=8,
+    )
+    # 10% failures against a 1% budget -> burn 10 on every window
+    report = policy.evaluate(_ring_with_errors(90, 10), now=700.0)
+    obj = report["objectives"]["error_rate"]
+    assert not obj["compliant"]
+    assert not report["compliant"]
+    for w in obj["windows"].values():
+        assert w["burn_rate"] == pytest.approx(10.0)
+        assert w["events"] == 100
+    # zero failures: compliant, zero burn
+    report = policy.evaluate(_ring_with_errors(100, 0), now=700.0)
+    assert report["compliant"]
+    assert report["objectives"]["error_rate"]["windows"]["fast"][
+        "burn_rate"
+    ] == 0.0
+
+
+def test_slo_breach_needs_every_window_hot():
+    """Failures entirely OUTSIDE the fast window burn only the slow one;
+    the multi-window conjunction keeps the objective compliant (the blip
+    already passed) — the suppression multi-window burn exists for."""
+    ring = MetricRing(capacity=16, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    ring.sample(10.0, stats)
+    stats.incr("requests_completed", 50)
+    stats.incr("requests_failed", 50)
+    ring.sample(200.0, stats)  # the bad minute: in the slow window only
+    stats.incr("requests_completed", 20)
+    ring.sample(690.0, stats)  # fast window sees only clean traffic
+    policy = SloPolicy(error_rate=0.01, fast_window_s=60.0,
+                       slow_window_s=600.0, min_events=8)
+    report = policy.evaluate(ring, now=695.0)
+    obj = report["objectives"]["error_rate"]
+    assert obj["windows"]["slow"]["burn_rate"] > 1.0
+    assert obj["windows"]["fast"]["burn_rate"] == 0.0
+    assert obj["compliant"]
+
+
+def test_slo_min_events_suppresses_thin_traffic():
+    # 1 failure out of 2 requests is a 50% error rate but only 2 events:
+    # below min_events on every window, so no breach
+    policy = SloPolicy(error_rate=0.01, min_events=8)
+    report = policy.evaluate(_ring_with_errors(1, 1), now=700.0)
+    assert report["compliant"]
+
+
+def test_slo_latency_objective_from_histogram_windows():
+    ring = MetricRing(capacity=16, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    ring.sample(10.0, stats)
+    for _ in range(20):
+        stats.observe("ttft_s", 10.0)  # every first token way over target
+    ring.sample(660.0, stats)  # delta lands inside both windows at t=700
+    ring.sample(700.0, stats)
+    policy = SloPolicy(ttft_p99_s=2.0, min_events=8)
+    report = policy.evaluate(ring, now=700.0)
+    obj = report["objectives"]["ttft_p99"]
+    assert not obj["compliant"]
+    assert obj["windows"]["fast"]["bad_fraction"] == pytest.approx(1.0)
+    assert obj["windows"]["slow"]["bad_fraction"] == pytest.approx(1.0)
+
+
+def test_slo_availability_counts_sheds():
+    ring = MetricRing(capacity=16, interval_s=1.0)
+    stats = ServingStats(slots=4)
+    ring.sample(10.0, stats)
+    stats.incr("requests_admitted", 80)
+    stats.incr("requests_shed_overflow", 15)
+    stats.incr("requests_shed_deadline", 5)
+    ring.sample(660.0, stats)
+    ring.sample(700.0, stats)
+    policy = SloPolicy(availability=0.999, min_events=8)
+    report = policy.evaluate(ring, now=700.0)
+    obj = report["objectives"]["availability"]
+    # 20 turned away of 100 offered = 20% bad vs a 0.1% budget
+    assert obj["windows"]["fast"]["bad_fraction"] == pytest.approx(0.2)
+    assert not obj["compliant"]
+
+
+def test_slo_observe_transitions_edges():
+    policy = SloPolicy(error_rate=0.01, min_events=8)
+    bad = policy.evaluate(_ring_with_errors(90, 10), now=700.0)
+    events = policy.observe_transitions(bad)
+    assert [k for k, _ in events] == ["slo_breach"]
+    assert events[0][1]["objective"] == "error_rate"
+    # still breached: no duplicate event
+    assert policy.observe_transitions(bad) == []
+    good = policy.evaluate(_ring_with_errors(100, 0), now=700.0)
+    events = policy.observe_transitions(good)
+    assert [k for k, _ in events] == ["slo_recovered"]
+    assert policy.observe_transitions(good) == []
+
+
+def test_slo_merge_reports_takes_hottest_replica():
+    policy = SloPolicy(error_rate=0.01, min_events=8)
+    hot = policy.evaluate(_ring_with_errors(90, 10), now=700.0)
+    cold = policy.evaluate(_ring_with_errors(100, 0), now=700.0)
+    merged = SloPolicy.merge_reports([hot, cold])
+    assert not merged["compliant"]
+    w = merged["objectives"]["error_rate"]["windows"]["fast"]
+    assert w["burn_rate"] == pytest.approx(10.0)  # max across replicas
+    assert w["events"] == 200  # events sum
+    assert SloPolicy.merge_reports([])["compliant"]
+
+
+# ------------------------------------------------------------ CanaryJudge
+
+
+class _FakeEngine:
+    """The surface CanaryJudge and HotSwapManager touch: slo_slices,
+    weight_generation, recorder, stats, _params, request_weight_swap."""
+
+    def __init__(self, params=None):
+        self.slo_slices = GenerationSlices()
+        self.weight_generation = 0
+        self.recorder = FlightRecorder(capacity=64)
+        self.stats = ServingStats(slots=2)
+        self._params = params if params is not None else {}
+        self.swaps = []
+
+    def request_weight_swap(self, weights, fingerprint=None, step=None,
+                            timeout=None):
+        self.swaps.append((dict(weights), fingerprint, step))
+        for k, v in weights.items():
+            self._params[k] = v
+        self.weight_generation += 1
+        return {
+            "weight_generation": self.weight_generation,
+            "cache_invalidated": False,
+        }
+
+
+def _feed(engine, gen, ttfts, inter=0.01, failed=0, delay=0.03):
+    """Feed settled traffic into one engine's generation slice after a
+    short delay — lands inside the judge's confirmation window."""
+
+    def run():
+        time.sleep(delay)
+        s = engine.slo_slices.slice_for(gen)
+        for t in ttfts:
+            s.ttft.observe(t)
+            s.inter_token.observe(inter)
+            engine.slo_slices.note_settled(gen, failed=False)
+        for _ in range(failed):
+            engine.slo_slices.note_settled(gen, failed=True)
+
+    th = threading.Thread(target=run)
+    th.start()
+    return th
+
+
+def test_canary_pass_and_flight_events():
+    judge = CanaryJudge(window_s=0.25, min_requests=4, poll_s=0.02,
+                        ttft_ratio=2.0, min_baseline_s=0.001)
+    canary, sib = _FakeEngine(), _FakeEngine()
+    canary.weight_generation = 1
+    threads = [
+        _feed(canary, 1, [0.05] * 6),
+        _feed(sib, 0, [0.05] * 6),
+    ]
+    verdict = judge.judge(canary, [sib], generation=1)
+    for t in threads:
+        t.join()
+    assert verdict["verdict"] == "pass"
+    assert verdict["canary_requests"] == 6
+    assert verdict["baseline_requests"] == 6
+    kinds = [e["kind"] for e in canary.recorder.events()]
+    assert "canary_begin" in kinds and "canary_verdict" in kinds
+
+
+def test_canary_latency_regression_verdict():
+    judge = CanaryJudge(window_s=0.25, min_requests=4, poll_s=0.02,
+                        ttft_ratio=2.0, min_baseline_s=0.001)
+    canary, sib = _FakeEngine(), _FakeEngine()
+    canary.weight_generation = 1
+    threads = [
+        _feed(canary, 1, [0.5] * 6),  # 10x the sibling baseline
+        _feed(sib, 0, [0.05] * 6),
+    ]
+    verdict = judge.judge(canary, [sib], generation=1)
+    for t in threads:
+        t.join()
+    assert verdict["verdict"] == "regression"
+    assert "ttft" in verdict["reason"]
+
+
+def test_canary_error_rate_regression_verdict():
+    judge = CanaryJudge(window_s=0.25, min_requests=4, poll_s=0.02,
+                        max_error_rate=0.25)
+    canary, sib = _FakeEngine(), _FakeEngine()
+    canary.weight_generation = 1
+    threads = [
+        _feed(canary, 1, [0.05] * 4, failed=4),  # 50% errors
+        _feed(sib, 0, [0.05] * 6),
+    ]
+    verdict = judge.judge(canary, [sib], generation=1)
+    for t in threads:
+        t.join()
+    assert verdict["verdict"] == "regression"
+    assert "error rate" in verdict["reason"]
+
+
+def test_canary_insufficient_traffic_and_no_siblings():
+    judge = CanaryJudge(window_s=0.05, min_requests=4, poll_s=0.01)
+    canary, sib = _FakeEngine(), _FakeEngine()
+    assert judge.judge(canary, [], generation=1)["verdict"] == "no_siblings"
+    verdict = judge.judge(canary, [sib], generation=1)
+    assert verdict["verdict"] == "insufficient_traffic"
+
+
+# -------------------------------------- HotSwapManager canary integration
+
+
+def _publish(tmp_path, step, value, metrics=None):
+    import numpy as np
+
+    from llm_fine_tune_distributed_tpu.train.publish import (
+        CheckpointPublisher,
+    )
+
+    return CheckpointPublisher(str(tmp_path), keep_last=8).publish(
+        step, {"w": np.full(3, float(value), np.float32)}, frozen_fp={},
+        metrics=metrics,
+    )
+
+
+def _manager(tmp_path, engines, judge):
+    from llm_fine_tune_distributed_tpu.infer.deploy import (
+        CheckpointWatcher,
+        HotSwapManager,
+    )
+
+    return HotSwapManager(
+        type("Fleet", (), {"replicas": engines})(),
+        CheckpointWatcher(str(tmp_path), verify_frozen=False),
+        canary=judge,
+    )
+
+
+def test_manager_blocks_canary_regression(tmp_path):
+    import numpy as np
+
+    engines = [
+        _FakeEngine({"w": np.zeros(3, np.float32)}) for _ in range(2)
+    ]
+    judge = CanaryJudge(window_s=0.25, min_requests=4, poll_s=0.02,
+                        ttft_ratio=2.0, min_baseline_s=0.001)
+    mgr = _manager(tmp_path, engines, judge)
+    _publish(tmp_path, 1, 1.0)
+    threads = [
+        _feed(engines[0], 1, [0.5] * 6),  # canary regresses after the swap
+        _feed(engines[1], 0, [0.05] * 6),
+    ]
+    res = mgr.poll_once()
+    for t in threads:
+        t.join()
+    assert res["kind"] == "canary_rejected"
+    assert res["canary"]["verdict"] == "regression"
+    # the canary swapped then rolled back; the sibling never swapped
+    assert engines[0].weight_generation == 2
+    assert engines[1].weight_generation == 0
+    assert len(engines[1].swaps) == 0
+    # the deployed step did not advance and the step is held
+    assert mgr.deployed_step == -1
+    assert mgr.poll_once() is None  # rejected publish is not retried
+    kinds = [e["kind"] for e in engines[0].recorder.events()]
+    assert "canary_rollback" in kinds
+    # status surfaces the verdict for /v1/deploy readers
+    assert mgr.status()["last_canary"]["verdict"] == "regression"
+
+
+def test_manager_rolls_fleet_on_canary_pass(tmp_path):
+    import numpy as np
+
+    engines = [
+        _FakeEngine({"w": np.zeros(3, np.float32)}) for _ in range(2)
+    ]
+    judge = CanaryJudge(window_s=0.2, min_requests=4, poll_s=0.02,
+                        ttft_ratio=3.0, min_baseline_s=0.001)
+    mgr = _manager(tmp_path, engines, judge)
+    _publish(tmp_path, 1, 1.0)
+    threads = [
+        _feed(engines[0], 1, [0.05] * 6),
+        _feed(engines[1], 0, [0.05] * 6),
+    ]
+    res = mgr.poll_once()
+    for t in threads:
+        t.join()
+    assert res["kind"] == "deploy"
+    assert res["canary"]["verdict"] == "pass"
+    assert [e.weight_generation for e in engines] == [1, 1]
+    assert mgr.deployed_step == 1
+
+
+def test_manager_insufficient_traffic_passes_through(tmp_path):
+    """A canary window with no traffic cannot verdict; the roll proceeds
+    (the error-rate backstop still guards) rather than wedging deploys."""
+    import numpy as np
+
+    engines = [
+        _FakeEngine({"w": np.zeros(3, np.float32)}) for _ in range(2)
+    ]
+    judge = CanaryJudge(window_s=0.05, min_requests=4, poll_s=0.01)
+    mgr = _manager(tmp_path, engines, judge)
+    _publish(tmp_path, 1, 1.0)
+    res = mgr.poll_once()
+    assert res["kind"] == "deploy"
+    assert res["canary"]["verdict"] == "insufficient_traffic"
+    assert [e.weight_generation for e in engines] == [1, 1]
+
+
+# ------------------------------------------- eval-gated promotion (sat. 3)
+
+
+def test_watcher_eval_gate_rejects_regressing_publish(tmp_path):
+    from llm_fine_tune_distributed_tpu.infer.deploy import CheckpointWatcher
+
+    recorder = FlightRecorder(capacity=16)
+    watcher = CheckpointWatcher(
+        str(tmp_path), verify_frozen=False, recorder=recorder
+    )
+    _publish(tmp_path, 1, 1.0, metrics={"eval_loss": 0.5})
+    dep = watcher.check()
+    assert dep["step"] == 1
+    watcher.note_deployed(dep["manifest"]["metrics"])
+
+    # a worse eval_loss is skipped — repeatedly, with ONE flight event
+    _publish(tmp_path, 2, 2.0, metrics={"eval_loss": 0.9})
+    assert watcher.check(min_step=1) is None
+    assert watcher.check(min_step=1) is None
+    rejected = [
+        e for e in recorder.events() if e["kind"] == "publish_rejected_eval"
+    ]
+    assert len(rejected) == 1
+    assert rejected[0]["step"] == 2
+    assert rejected[0]["candidate"] == pytest.approx(0.9)
+    assert rejected[0]["resident"] == pytest.approx(0.5)
+
+    # an improving publish deploys
+    _publish(tmp_path, 3, 3.0, metrics={"eval_loss": 0.4})
+    assert watcher.check(min_step=1)["step"] == 3
+
+
+def test_watcher_eval_gate_needs_both_sides(tmp_path):
+    """Metric-less publishes (smoke tests, ad hoc rolls) bypass the gate
+    in BOTH directions: no resident baseline, or no candidate metric."""
+    from llm_fine_tune_distributed_tpu.infer.deploy import CheckpointWatcher
+
+    watcher = CheckpointWatcher(str(tmp_path), verify_frozen=False)
+    # no resident metrics yet: anything deploys
+    _publish(tmp_path, 1, 1.0, metrics={"eval_loss": 0.5})
+    assert watcher.check()["step"] == 1
+    watcher.note_deployed({"eval_loss": 0.5})
+    # candidate without metrics: deploys despite a resident baseline
+    _publish(tmp_path, 2, 2.0)
+    assert watcher.check(min_step=1)["step"] == 2
+
+
+def test_watcher_eval_gate_max_mode(tmp_path):
+    from llm_fine_tune_distributed_tpu.infer.deploy import CheckpointWatcher
+
+    watcher = CheckpointWatcher(
+        str(tmp_path), verify_frozen=False,
+        eval_gate_metric="accuracy", eval_gate_mode="max",
+    )
+    watcher.note_deployed({"accuracy": 0.8})
+    _publish(tmp_path, 1, 1.0, metrics={"accuracy": 0.7})
+    assert watcher.check() is None  # lower accuracy regresses under max
+    _publish(tmp_path, 2, 2.0, metrics={"accuracy": 0.9})
+    assert watcher.check()["step"] == 2
+    with pytest.raises(ValueError):
+        CheckpointWatcher(str(tmp_path), eval_gate_mode="sideways")
+
+
+# --------------------------------------------- trace log rotation (sat. 1)
+
+
+def test_trace_writer_rotates_and_keeps_last_k(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    writer = TraceJsonlWriter(path, max_bytes=300, keep=2)
+    for i in range(50):
+        writer.write(
+            {"event": "request_done", "request_id": f"req-{i:04d}",
+             "tokens": i}
+        )
+    writer.close()
+    # live file plus at most ``keep`` rotated segments
+    files = sorted(os.listdir(tmp_path))
+    assert "trace.jsonl" in files
+    assert "trace.jsonl.1" in files
+    assert set(files) <= {"trace.jsonl", "trace.jsonl.1", "trace.jsonl.2"}
+    # every surviving segment stays line-valid JSONL under rotation
+    newest_ids = []
+    for name in files:
+        with open(tmp_path / name) as f:
+            for line in f:
+                rec = json.loads(line)
+                assert rec["event"] == "request_done"
+                if name == "trace.jsonl":
+                    newest_ids.append(rec["request_id"])
+    # the newest events live in the live file
+    assert newest_ids and newest_ids[-1] == "req-0049"
+    # no rotated segment exceeds the cap (the live file may briefly)
+    assert os.path.getsize(tmp_path / "trace.jsonl.1") <= 400
+
+
+def test_trace_writer_unbounded_by_default(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    writer = TraceJsonlWriter(path)
+    for i in range(100):
+        writer.write({"event": "e", "i": i})
+    writer.close()
+    assert os.listdir(tmp_path) == ["trace.jsonl"]
