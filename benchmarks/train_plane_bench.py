@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""TRAIN_PLANE CI arm: the training control plane + lineage round-trip.
+
+Runs a short CPU train (tiny preset, synthetic QA parquet) with the
+control plane enabled (``train_port=0``) and a publish directory, and
+asserts the three observability surfaces the plane promises:
+
+1. ``GET /metrics`` scraped LIVE mid-run carries every pinned
+   ``training_*`` line (loss gauge, step histogram buckets, the seeded
+   kind-labelled anomaly counter, publish counters, the info line).
+2. ``GET /v1/train/status`` carries every pinned status key — identity
+   (run_id / hparams_digest), progress (step / total_steps / epoch /
+   eta_s), and the bookkeeping blocks (counters / anomalies /
+   checkpoints / publishes).
+3. After training, a server booted on ``best_model`` with
+   ``publish_watch_dir`` deploys the published checkpoint over
+   ``POST /v1/deploy`` and ``GET /v1/lineage`` maps the resident weight
+   generation back to THIS run's ``run_id``/``step`` with
+   ``anomaly_clean: true``.
+
+One JSON line per check, perf_ledger-style; exits nonzero if any pinned
+key is missing. CPU-only, no accelerator required:
+
+    python benchmarks/train_plane_bench.py
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import json  # noqa: E402
+import socket  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+import urllib.request  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FAILURES = []
+
+
+def emit(metric, value, **extra):
+    line = {"bench": "train_plane", "metric": metric, "value": value}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def check(surface, ok, detail):
+    if ok:
+        emit(f"{surface}_ok", True)
+    else:
+        emit(f"{surface}_ok", False, detail=detail)
+        FAILURES.append(f"{surface}: {detail}")
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def make_dataset(tmp):
+    from llm_fine_tune_distributed_tpu.data.convert import (
+        convert_jsonl_to_parquet,
+    )
+
+    jsonl = os.path.join(tmp, "qa.jsonl")
+    rng = np.random.RandomState(0)
+    with open(jsonl, "w") as f:
+        for i in range(96):
+            f.write(json.dumps({
+                "topic": "Knots",
+                "question": f"question number {i} about knots?",
+                "answer": f"answer {i}: " + " ".join(
+                    ["word"] * int(rng.randint(3, 10))
+                ),
+            }) + "\n")
+    path = convert_jsonl_to_parquet(
+        jsonl, os.path.join(tmp, "qa_dataset.parquet"), verbose=False
+    )
+    return os.path.basename(path)
+
+
+# Pinned /metrics substrings: schema drift here breaks CI, not a dashboard.
+METRICS_PINNED = (
+    "# TYPE training_info gauge",
+    "# TYPE training_loss gauge",
+    "# TYPE training_steps_per_second gauge",
+    "# TYPE training_publishes_total counter",
+    "# TYPE training_checkpoints_saved_total counter",
+    'training_anomalies_total{kind="non_finite"}',
+    'training_anomalies_total{kind="loss_spike"}',
+    'training_anomalies_total{kind="grad_explosion"}',
+    "training_step_seconds_bucket",
+    "training_data_wait_seconds_bucket",
+)
+
+STATUS_PINNED = (
+    "run_id", "hparams_digest", "state", "step", "total_steps", "epoch",
+    "epochs", "eta_s", "preempted", "counters", "anomalies",
+    "checkpoints", "publishes", "flight_events",
+)
+
+LINEAGE_RECORD_PINNED = (
+    "run_id", "hparams_digest", "step", "anomaly_clean", "fingerprint",
+    "kind", "metrics",
+)
+
+
+def main():
+    from llm_fine_tune_distributed_tpu.config import MeshConfig, TrainConfig
+    from llm_fine_tune_distributed_tpu.train.publish import list_published
+    from llm_fine_tune_distributed_tpu.train.trainer import SFTTrainer
+
+    tmp = tempfile.mkdtemp(prefix="train_plane_bench_")
+    dataset_file = make_dataset(tmp)
+    out = os.path.join(tmp, "out")
+    publish_dir = os.path.join(tmp, "publish")
+    config = TrainConfig(
+        model_name="tiny-random",
+        model_preset="tiny",
+        tokenizer_path="byte-chatml",
+        data_dir=tmp,
+        dataset_file=dataset_file,
+        output_dir=out,
+        epochs=1,
+        per_device_batch_size=2,
+        gradient_accumulation_steps=2,
+        learning_rate=2e-3,
+        max_seq_length=128,
+        eval_steps=5,
+        logging_steps=2,
+        save_steps=8,
+        mesh=MeshConfig(data=1, fsdp=2, tensor=1, seq=1),
+        train_port=0,
+        publish_dir=publish_dir,
+    )
+    trainer = SFTTrainer(config)
+    t0 = time.monotonic()
+    th = threading.Thread(target=trainer.train, daemon=True)
+    th.start()
+
+    # --- surface 1+2: live plane mid-run -------------------------------
+    plane = None
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        plane = getattr(trainer, "train_plane", None)
+        if plane is not None and plane.port:
+            break
+        time.sleep(0.1)
+    check("plane_started", plane is not None and bool(plane.port),
+          "control plane never came up")
+    if plane is None or not plane.port:
+        print("FAIL: " + "; ".join(FAILURES), file=sys.stderr)
+        return 1
+    base = f"http://127.0.0.1:{plane.port}"
+
+    live_step = 0
+    while time.monotonic() < deadline and th.is_alive():
+        status = json.loads(_get(f"{base}/v1/train/status"))
+        live_step = max(live_step, int(status.get("step", 0)))
+        if live_step >= 2:
+            break
+        time.sleep(0.2)
+    check("live_progress", live_step >= 2,
+          f"live step over HTTP reached {live_step}")
+
+    metrics = _get(f"{base}/metrics")
+    missing = [p for p in METRICS_PINNED if p not in metrics]
+    check("metrics", not missing, f"missing pinned lines: {missing}")
+    emit("metrics_lines", len(metrics.splitlines()))
+
+    status = json.loads(_get(f"{base}/v1/train/status"))
+    missing = [k for k in STATUS_PINNED if k not in status]
+    check("status", not missing, f"missing pinned keys: {missing}")
+
+    flight = json.loads(_get(f"{base}/v1/train/flight?limit=256"))
+    kinds = {e.get("kind") for e in flight.get("events", [])}
+    check("flight", "step" in kinds, f"no step events in flight ring: {kinds}")
+
+    th.join(600)
+    check("train_finished", not th.is_alive(), "training run hung")
+    emit("train_wall_s", round(time.monotonic() - t0, 2))
+    pubs = list_published(publish_dir)
+    check("published", bool(pubs), "no checkpoint was published")
+    if FAILURES:
+        print("FAIL: " + "; ".join(FAILURES), file=sys.stderr)
+        return 1
+
+    # --- surface 3: post-publish lineage through a serving deploy -------
+    from llm_fine_tune_distributed_tpu.infer.server import serve
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    threading.Thread(
+        target=serve,
+        args=(os.path.join(out, "best_model"), "127.0.0.1", port),
+        kwargs=dict(publish_watch_dir=publish_dir, publish_poll_s=3600.0),
+        daemon=True,
+    ).start()
+    sbase = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 300
+    up = False
+    while time.monotonic() < deadline:
+        try:
+            if _get(f"{sbase}/healthz", timeout=2) == "ok":
+                up = True
+                break
+        except OSError:
+            time.sleep(0.25)
+    check("server_started", up, "serving endpoint never became healthy")
+    if not up:
+        print("FAIL: " + "; ".join(FAILURES), file=sys.stderr)
+        return 1
+
+    req = urllib.request.Request(
+        f"{sbase}/v1/deploy", data=b"{}", method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=600) as r:
+        dep = json.loads(r.read())
+    check("deploy", dep.get("kind") == "deploy", f"deploy result: {dep}")
+
+    lineage = json.loads(_get(f"{sbase}/v1/lineage"))
+    missing = [
+        k for k in ("resident_generation", "generations", "history")
+        if k not in lineage
+    ]
+    check("lineage_shape", not missing, f"missing pinned keys: {missing}")
+    gen = str(lineage.get("resident_generation"))
+    rec = (lineage.get("generations") or {}).get(gen) or {}
+    missing = [k for k in LINEAGE_RECORD_PINNED if k not in rec]
+    check("lineage_record", not missing,
+          f"generation {gen} record missing: {missing}")
+    check(
+        "lineage_identity",
+        rec.get("run_id") == trainer.telemetry.run_id
+        and rec.get("anomaly_clean") is True,
+        f"resident generation maps to {rec.get('run_id')} "
+        f"clean={rec.get('anomaly_clean')}, trained as "
+        f"{trainer.telemetry.run_id}",
+    )
+
+    if FAILURES:
+        print("FAIL: " + "; ".join(FAILURES), file=sys.stderr)
+        return 1
+    emit("train_plane_arm", "ok", run_id=trainer.telemetry.run_id)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,17 @@ def train_main(argv: Optional[list] = None) -> int:
         help="with --platform cpu: number of virtual host devices "
              "(XLA_FLAGS --xla_force_host_platform_device_count)",
     )
+    parser.add_argument(
+        "--train-port", type=int, default=None,
+        help="serve the training control plane (/metrics, /v1/train/status, "
+             "/v1/train/flight, POST /v1/train/profile) on this port from "
+             "the primary host (0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--publish-require-clean", action="store_true", default=None,
+        help="skip publishing checkpoints whose trailing anomaly window is "
+             "dirty instead of stamping anomaly_clean=false",
+    )
     args = parser.parse_args(argv)
 
     if args.virtual_devices:
@@ -77,6 +88,10 @@ def train_main(argv: Optional[list] = None) -> int:
         config.model_preset = args.model_preset
     if args.resume is not None:
         config.resume_from_checkpoint = args.resume
+    if args.train_port is not None:
+        config.train_port = args.train_port
+    if args.publish_require_clean:
+        config.publish_require_clean = True
     mesh_env = {
         k: os.environ.get(f"MESH_{k.upper()}")
         for k in ("data", "fsdp", "tensor", "seq", "expert", "pipe")

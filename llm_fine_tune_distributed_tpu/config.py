@@ -341,6 +341,16 @@ class TrainConfig:
     aim_repo: Optional[str] = None
     experiment_name: str = "smollm3-wilderness-finetuning-distributed"
     profile_dir: Optional[str] = None
+    # training control plane (observe/trainplane.py): primary-host HTTP
+    # server exposing /metrics, /v1/train/status, /v1/train/flight and
+    # POST /v1/train/profile while the run steps. None = off; 0 = bind an
+    # ephemeral port (tests/benches read it back from the plane object).
+    train_port: Optional[int] = None
+    # anomaly sentinels: trailing window (steps) a publish must keep clean
+    # to get anomaly_clean=true, and the EWMA band width (sigmas) for the
+    # loss-spike / grad-explosion detectors.
+    anomaly_window_steps: int = 100
+    anomaly_band_sigma: float = 6.0
 
     # native runtime (C++ layer, native/*.cc)
     use_native_loader: bool = True   # prefetching C++ batch pipeline, auto-fallback
@@ -379,6 +389,11 @@ class TrainConfig:
     # newest K publishes survive retention.
     publish_dir: Optional[str] = None
     publish_keep_last: int = 3
+    # refuse to publish a checkpoint whose trailing anomaly window is
+    # dirty (non-finite loss, loss spike, grad explosion) instead of
+    # stamping it anomaly_clean=false — keeps diverging weights from ever
+    # reaching the deployment watch dir.
+    publish_require_clean: bool = False
 
     # resume
     resume_from_checkpoint: Optional[str] = None  # "latest" or a path
@@ -447,6 +462,10 @@ class TrainConfig:
         "CHECKPOINT_ASYNC_SNAPSHOT": ("checkpoint_async_snapshot", "_env_bool"),
         "PUBLISH_DIR": ("publish_dir", str),
         "PUBLISH_KEEP_LAST": ("publish_keep_last", int),
+        "PUBLISH_REQUIRE_CLEAN": ("publish_require_clean", "_env_bool"),
+        "TRAIN_PORT": ("train_port", int),
+        "ANOMALY_WINDOW_STEPS": ("anomaly_window_steps", int),
+        "ANOMALY_BAND_SIGMA": ("anomaly_band_sigma", float),
         "WATCHDOG_TIMEOUT_S": ("watchdog_timeout_s", float),
         "WATCHDOG_ACTION": ("watchdog_action", str),
         "OBJECTIVE": ("objective", str),

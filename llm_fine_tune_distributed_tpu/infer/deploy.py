@@ -274,6 +274,13 @@ class HotSwapManager:
         # so the eval gate's baseline survives rollbacks
         self._resident_metrics: Optional[Dict[str, Any]] = None
         self._prev_metrics: Optional[Dict[str, Any]] = None
+        # full manifests mirroring the same buffers, feeding the lineage
+        # records (run_id / hparams_digest / anomaly_clean) — GET
+        # /v1/lineage answers "which training run is generation N?"
+        self._resident_manifest: Optional[Dict[str, Any]] = None
+        self._prev_manifest: Optional[Dict[str, Any]] = None
+        self._lineage_by_gen: Dict[int, Dict[str, Any]] = {}
+        self._lineage_history: List[Dict[str, Any]] = []
         # a rollback marks the fled step as held: the poller ignores
         # publishes at or below it (otherwise the next poll would redeploy
         # exactly the generation the rollback rejected). A NEWER publish
@@ -303,6 +310,7 @@ class HotSwapManager:
                 dep["weights"], dep["fingerprint"], dep["step"],
                 kind="deploy",
                 metrics=(dep["manifest"].get("metrics") or None),
+                manifest=dep["manifest"],
             )
 
     def rollback(self) -> Dict[str, Any]:
@@ -319,6 +327,7 @@ class HotSwapManager:
                 self._prev_weights, self._prev_fingerprint, self._prev_step,
                 kind="rollback",
                 metrics=self._prev_metrics,
+                manifest=self._prev_manifest,
             )
             self._hold_step = max(self._hold_step, fled)
             return result
@@ -330,6 +339,7 @@ class HotSwapManager:
         step: int,
         kind: str,
         metrics: Optional[Dict[str, Any]] = None,
+        manifest: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Rolling swap of ``weights`` across every engine (lock held).
 
@@ -369,7 +379,8 @@ class HotSwapManager:
                     self.last_canary = canary_verdict
                     if canary_verdict.get("verdict") == "regression":
                         return self._reject_canary(
-                            eng, prev, fingerprint, step, canary_verdict
+                            eng, prev, fingerprint, step, canary_verdict,
+                            manifest=manifest,
                         )
         except BaseException:
             for eng in done:  # best-effort: restore the pre-deploy values
@@ -388,7 +399,9 @@ class HotSwapManager:
         self._prev_fingerprint = self.deployed_fingerprint
         self._prev_step = self.deployed_step
         self._prev_metrics = self._resident_metrics
+        self._prev_manifest = self._resident_manifest
         self._resident_metrics = dict(metrics) if metrics else None
+        self._resident_manifest = dict(manifest) if manifest else None
         self.watcher.note_deployed(self._resident_metrics)
         self.deployed_step = int(step)
         self.deployed_fingerprint = fingerprint
@@ -408,9 +421,69 @@ class HotSwapManager:
             "weight_generation": max(r["weight_generation"] for r in results),
             "cache_invalidated": any(r["cache_invalidated"] for r in results),
         }
+        lineage = self._lineage_note(
+            result["weight_generation"], kind, step, fingerprint, manifest,
+            extra={"replicas": len(self.engines), "duration_s": round(dt, 4)},
+        )
+        result["run_id"] = lineage["run_id"]
+        result["anomaly_clean"] = lineage["anomaly_clean"]
         if canary_verdict is not None:
             result["canary"] = canary_verdict
         return result
+
+    def _lineage_note(
+        self,
+        generation: Optional[int],
+        kind: str,
+        step: int,
+        fingerprint: Optional[str],
+        manifest: Optional[Dict[str, Any]],
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """One queryable lineage record per deploy outcome (lock held):
+        which training run/step produced this weight generation, whether
+        its anomaly window was clean, and what its eval metrics said.
+        Canary rejections land in the history with ``generation`` None —
+        the candidate never became fleet-resident."""
+        m = manifest or {}
+        rec: Dict[str, Any] = {
+            "generation": int(generation) if generation is not None else None,
+            "kind": kind,
+            "step": int(step),
+            "fingerprint": fingerprint,
+            "run_id": m.get("run_id"),
+            "hparams_digest": m.get("hparams_digest"),
+            "anomaly_clean": m.get("anomaly_clean"),
+            "metrics": dict(m.get("metrics") or {}) or None,
+            "deployed_unix": time.time(),
+        }
+        if extra:
+            rec.update(extra)
+        if generation is not None:
+            self._lineage_by_gen[int(generation)] = rec
+        self._lineage_history.append(rec)
+        if len(self._lineage_history) > 128:
+            del self._lineage_history[: len(self._lineage_history) - 128]
+        return rec
+
+    def lineage(self) -> Dict[str, Any]:
+        """``GET /v1/lineage`` payload: the resident generation, the
+        per-generation train→serve records, and the bounded deploy history
+        (deploys, rollbacks, canary rejections, newest last)."""
+        with self._lock:
+            gens = [
+                int(getattr(e, "weight_generation", 0)) for e in self.engines
+            ]
+            return {
+                "resident_generation": max(gens) if gens else 0,
+                "weight_generations": gens,
+                "deployed_step": self.deployed_step,
+                "deployed_fingerprint": self.deployed_fingerprint,
+                "generations": {
+                    str(g): dict(r) for g, r in self._lineage_by_gen.items()
+                },
+                "history": [dict(r) for r in self._lineage_history],
+            }
 
     def _reject_canary(
         self,
@@ -419,6 +492,7 @@ class HotSwapManager:
         fingerprint: Optional[str],
         step: int,
         verdict: Dict[str, Any],
+        manifest: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Roll the canary replica back to the pre-deploy values and hold
         the rejected step (lock held). The deployed step/fingerprint and
@@ -444,12 +518,18 @@ class HotSwapManager:
             f"{verdict.get('reason')}",
             flush=True,
         )
+        lineage = self._lineage_note(
+            None, "canary_rejected", step, fingerprint, manifest,
+            extra={"canary_reason": verdict.get("reason")},
+        )
         return {
             "kind": "canary_rejected",
             "step": int(step),
             "fingerprint": fingerprint,
             "replicas": 1,
             "canary": verdict,
+            "run_id": lineage["run_id"],
+            "anomaly_clean": lineage["anomaly_clean"],
         }
 
     def _capture(self, weights: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

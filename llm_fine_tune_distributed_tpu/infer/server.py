@@ -716,6 +716,34 @@ def serve(
                         200,
                         {"events": cont_engine.recorder.events()[-limit:]},
                     )
+            elif path == "/v1/lineage":
+                # train→serve lineage: which training run/step produced
+                # each resident weight generation, was its anomaly window
+                # clean, and how has each generation served (per-generation
+                # SLO slices joined in) — a canary rejection is one record
+                if deploy_mgr is None:
+                    self._send(404, {
+                        "error": "lineage needs live deployment: start the "
+                        "server with --publish-watch-dir"
+                    })
+                    return
+                payload = deploy_mgr.lineage()
+                slices = None
+                if cont_engine is not None:
+                    if isinstance(cont_engine, EngineFleet):
+                        slices = cont_engine.stats_snapshot().get(
+                            "per_generation"
+                        )
+                    else:
+                        slo = getattr(cont_engine, "slo_slices", None)
+                        if slo is not None:
+                            slices = slo.summaries()
+                if slices:
+                    payload["serving"] = slices
+                    for gen, rec in payload["generations"].items():
+                        if gen in slices:
+                            rec["slo"] = slices[gen]
+                self._send(200, payload)
             else:
                 self._send(404, {"error": "not found"})
 

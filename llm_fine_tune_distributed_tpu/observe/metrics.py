@@ -322,6 +322,7 @@ def prometheus_exposition(
         List[Tuple[str, Dict[str, Any], Optional[Dict[str, Histogram]]]]
     ] = None,
     tenant_histograms: Optional[Dict[str, Dict[str, Histogram]]] = None,
+    counters: Optional[set] = None,
 ) -> str:
     """Render a ``ServingStats.snapshot()`` (plus the live histogram
     objects and an optional ``device_memory_report()``) as Prometheus text
@@ -340,8 +341,12 @@ def prometheus_exposition(
     per metric name, all samples grouped under it, as the format
     requires); per-replica string values collapse into one
     ``<prefix>_replica_info{replica=...} 1`` line each.
+
+    ``counters`` — override the counter-typed key set; defaults to the
+    serving union above. The trainer exposition passes its own set.
     """
-    counters = set(ServingStats.COUNTERS) | set(FLEET_COUNTERS)
+    if counters is None:
+        counters = set(ServingStats.COUNTERS) | set(FLEET_COUNTERS)
     replicas = replicas or []
     lines: List[str] = []
     labels = []
@@ -631,10 +636,21 @@ class MetricLogger:
             print(f"[train] {rendered}", flush=True)
 
     def save_history(self, path: str) -> None:
-        """``training_history.json`` artifact (reference ``training.py:315-316``)."""
-        if self.primary:
-            with open(path, "w") as f:
-                json.dump(self.history, f, indent=2)
+        """``training_history.json`` artifact (reference ``training.py:315-316``).
+
+        Written atomically (tmp + rename) because the trainer now flushes
+        it at every eval/checkpoint boundary, not just at exit — a crash
+        or preemption mid-write must never leave a truncated file where
+        the previous good history used to be. Primary host only.
+        """
+        if not self.primary:
+            return
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.history, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def close(self) -> None:
         for sink in self.sinks:

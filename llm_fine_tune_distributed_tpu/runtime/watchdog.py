@@ -38,16 +38,22 @@ class StepWatchdog:
         on_trip=None,
         poll_s: Optional[float] = None,
         start_paused: bool = False,
+        recorder=None,
     ):
         """``start_paused=True``: stay disarmed until the FIRST poke — the
         trainer uses this so the startup window (mid-epoch resume
         fast-forward + multi-minute first-step compile) can never
-        false-trip into an unrecoverable abort/restart loop."""
+        false-trip into an unrecoverable abort/restart loop.
+
+        ``recorder``: optional FlightRecorder; trips are recorded from the
+        poller thread and re-arms from ``poke`` only on the paused->armed
+        transition, so the per-step poke stays a bare timestamp store."""
         if action not in ("warn", "abort"):
             raise ValueError(f"watchdog action must be warn|abort, got {action!r}")
         self.timeout_s = float(timeout_s)
         self.action = action
         self._on_trip = on_trip  # test hook; called instead of os._exit
+        self._recorder = recorder
         self._poll_s = poll_s if poll_s is not None else min(self.timeout_s / 4, 10.0)
         self._last_poke = time.monotonic()
         self._last_step = 0
@@ -66,7 +72,12 @@ class StepWatchdog:
         A poke is definite progress, so it also re-arms a paused watchdog."""
         self._last_poke = time.monotonic()
         self._last_step = step
-        self._paused = False
+        if self._paused:
+            # paused->armed happens only at eval/checkpoint boundaries, so
+            # the flight event (a clock read) never rides the hot path
+            self._paused = False
+            if self._recorder is not None:
+                self._recorder.record("watchdog_rearm", step=step)
 
     def pause(self) -> None:
         """Disarm during legitimately long host-side phases (checkpoint
@@ -94,6 +105,13 @@ class StepWatchdog:
             if silent < self.timeout_s:
                 continue
             self._tripped += 1
+            if self._recorder is not None:
+                self._recorder.record(
+                    "watchdog_trip",
+                    silent_s=round(silent, 1),
+                    last_step=self._last_step,
+                    action=self.action,
+                )
             print(
                 f"[watchdog] no training-loop progress for {silent:.0f}s "
                 f"(last step {self._last_step}, timeout {self.timeout_s:.0f}s) "

@@ -193,10 +193,19 @@ class CheckpointPublisher:
         *,
         frozen_fp: Dict[str, Any],
         metrics: Optional[Dict[str, float]] = None,
+        run_id: Optional[str] = None,
+        hparams_digest: Optional[str] = None,
+        anomaly_clean: Optional[bool] = None,
     ) -> str:
         """Publish ``trainable`` (flat ``{path: array}``, device or host) as
         ``step``'s deployment candidate; returns the published directory.
-        Weights first, manifest last, both atomically — see module doc."""
+        Weights first, manifest last, both atomically — see module doc.
+
+        ``run_id`` / ``hparams_digest`` / ``anomaly_clean`` are the lineage
+        stamps the serving side threads through to ``GET /v1/lineage``:
+        which training run produced this candidate, with which knobs, and
+        whether its trailing metric window was anomaly-free. All optional
+        — older manifests (and callers) stay valid without them."""
         host = {k: np.asarray(v) for k, v in trainable.items()}
         final = os.path.join(self.publish_dir, step_dir_name(step))
         os.makedirs(final, exist_ok=True)
@@ -217,6 +226,12 @@ class CheckpointPublisher:
             },
             "metrics": {k: float(v) for k, v in (metrics or {}).items()},
         }
+        if run_id is not None:
+            manifest["run_id"] = str(run_id)
+        if hparams_digest is not None:
+            manifest["hparams_digest"] = str(hparams_digest)
+        if anomaly_clean is not None:
+            manifest["anomaly_clean"] = bool(anomaly_clean)
         atomic_write_json(os.path.join(final, MANIFEST_NAME), manifest)
         log.info(
             "published step %d (%d leaves, %d bytes) to %s",

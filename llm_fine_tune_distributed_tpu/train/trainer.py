@@ -44,6 +44,10 @@ from llm_fine_tune_distributed_tpu.models.transformer import init_params
 from llm_fine_tune_distributed_tpu.observe.metrics import MetricLogger
 from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter
 from llm_fine_tune_distributed_tpu.observe.tracing import Histogram
+from llm_fine_tune_distributed_tpu.observe.trainplane import (
+    TrainControlPlane,
+    TrainTelemetry,
+)
 from llm_fine_tune_distributed_tpu.observe.xla import CompileLedger, instrument
 from llm_fine_tune_distributed_tpu.parallel.freeze import describe_trainable, trainable_mask
 from llm_fine_tune_distributed_tpu.parallel.optimizer import build_lr_schedule, build_optimizer
@@ -103,6 +107,15 @@ class SFTTrainer:
         }
         hparams["mesh"] = {a: int(s) for a, s in self.mesh.shape.items()}
         self.metrics.set_params(hparams)
+        # training control plane state (observe/trainplane.py): flight
+        # recorder + anomaly sentinels + the status dict the HTTP server
+        # reads. Always constructed (sentinels gate publish even with the
+        # server off); fed only at log/eval/save boundaries.
+        self.telemetry = TrainTelemetry(
+            hparams=hparams,
+            band_sigma=config.anomaly_band_sigma,
+            anomaly_window_steps=config.anomaly_window_steps,
+        )
         if is_primary_host():
             os.makedirs(os.path.join(config.output_dir, "best_model"), exist_ok=True)
         device_preflight()
@@ -826,6 +839,18 @@ class SFTTrainer:
         logged, never fatal: deployment lag must not kill the fine-tune."""
         if not self.config.publish_dir or jax.process_index() != 0:
             return
+        # anomaly gate: stamp (or enforce) trailing-window cleanliness so
+        # the serving side never unknowingly promotes a checkpoint cut
+        # mid-divergence (NaN loss, grad explosion)
+        clean = self.telemetry.publish_clean(step)
+        if not clean and self.config.publish_require_clean:
+            self.telemetry.note_publish(step, clean=False, skipped=True)
+            print(
+                f"[train] skipping publish for step {step}: anomaly window "
+                "dirty and publish_require_clean is set",
+                flush=True,
+            )
+            return
         if self._publisher is None:
             from llm_fine_tune_distributed_tpu.train.publish import (
                 CheckpointPublisher,
@@ -837,8 +862,15 @@ class SFTTrainer:
             )
         try:
             self._publisher.publish(
-                step, self.state.trainable, frozen_fp=fp, metrics=metrics
+                step,
+                self.state.trainable,
+                frozen_fp=fp,
+                metrics=metrics,
+                run_id=self.telemetry.run_id,
+                hparams_digest=self.telemetry.hparams_digest,
+                anomaly_clean=clean,
             )
+            self.telemetry.note_publish(step, clean=clean)
         except Exception as e:  # noqa: BLE001 — advisory side channel
             print(
                 f"[train] checkpoint publish for step {step} failed: {e}",
@@ -901,6 +933,9 @@ class SFTTrainer:
         emergency checkpoint, and return cleanly (exit 0 for the CLI). The
         SIGTERM handler installed by ``train`` calls this; tests and
         embedding processes may call it directly from any thread."""
+        if not self._preempt.is_set():
+            self.telemetry.recorder.record("preemption_requested")
+            self.telemetry.update(preempted=True)
         self._preempt.set()
 
     def train(self) -> Dict[str, Any]:
@@ -963,7 +998,7 @@ class SFTTrainer:
         from llm_fine_tune_distributed_tpu.runtime.desync import DesyncMonitor
 
         desync = DesyncMonitor(cfg.desync_check_steps)
-        profiler = StepProfiler(cfg.profile_dir)
+        profiler = StepProfiler(cfg.profile_dir, recorder=self.telemetry.recorder)
         # wedged-link detector (runtime/watchdog.py): a dead device link
         # under a single-process run otherwise hangs forever with a
         # healthy-looking process (observed on the tunneled flagship)
@@ -974,7 +1009,10 @@ class SFTTrainer:
             # start_paused: the first arm happens at the first step's poke,
             # so resume fast-forward + first-step compile can't false-trip
             watchdog = StepWatchdog(
-                cfg.watchdog_timeout_s, cfg.watchdog_action, start_paused=True
+                cfg.watchdog_timeout_s,
+                cfg.watchdog_action,
+                start_paused=True,
+                recorder=self.telemetry.recorder,
             )
 
         # Preemption safety (k8s node drain / spot reclaim): SIGTERM sets a
@@ -1013,6 +1051,32 @@ class SFTTrainer:
             "step": Histogram.exponential(),
             "checkpoint": Histogram.exponential(),
         }
+
+        # Training control plane (observe/trainplane.py): live /metrics +
+        # /v1/train/status + flight recorder over this run's telemetry,
+        # primary host only. The telemetry itself is fed strictly inside
+        # the do_log/do_eval/do_save branches below (already synced) —
+        # nothing extra rides the per-step path.
+        self.telemetry.attach(
+            phase_hist=phase_hist, compile_ledger=self.compile_ledger
+        )
+        self.telemetry.update(
+            total_steps=self.total_steps,
+            epochs=cfg.epochs,
+            step=step,
+            state="training",
+        )
+        plane = None
+        if cfg.train_port is not None:
+            plane = TrainControlPlane(
+                self.telemetry, cfg.train_port, profile_dir=cfg.profile_dir
+            )
+            if plane.start():
+                print(
+                    f"[train] control plane listening on :{plane.port}",
+                    flush=True,
+                )
+        self.train_plane = plane  # tests/benches read the bound port
 
         def _timed_batches(it):
             it = iter(it)
@@ -1161,6 +1225,18 @@ class SFTTrainer:
                                     for d in mem.values()
                                 )
                         self.metrics.log(step, step / self.steps_per_epoch, logs)
+                        # control plane + sentinels consume the SAME
+                        # already-synced host floats — no extra device sync
+                        self.telemetry.on_step(step, logs)
+                        self.telemetry.update(
+                            epoch=round(step / self.steps_per_epoch, 4)
+                        )
+                        if do_eval and last_eval == best_eval:
+                            self.telemetry.update(best_eval=best_eval)
+                        if watchdog is not None:
+                            self.telemetry.set_counter(
+                                "watchdog_trips", watchdog.trips
+                            )
 
                     if do_save:
                         if watchdog is not None:
@@ -1170,14 +1246,20 @@ class SFTTrainer:
                             watchdog.pause()
                         t_ckpt = time.perf_counter()
                         self._ckpt_save(ckpt, step, {cfg.metric_for_best_model: last_eval} if last_eval is not None else None)
-                        phase_hist["checkpoint"].observe(
-                            time.perf_counter() - t_ckpt
-                        )
+                        ckpt_s = time.perf_counter() - t_ckpt
+                        phase_hist["checkpoint"].observe(ckpt_s)
+                        self.telemetry.note_checkpoint(step, ckpt_s)
                     if do_eval or do_save:
                         # eval sweeps / checkpoint saves must not count
                         # against the NEXT steady-state interval (the
                         # cumulative rate still includes them)
                         meter.rebase()
+                        # crash-safe history: atomic flush at every
+                        # eval/checkpoint boundary so a preempted or killed
+                        # run keeps everything up to here
+                        self.metrics.save_history(
+                            os.path.join(cfg.output_dir, "training_history.json")
+                        )
                 if preempted:
                     break
         finally:
@@ -1214,6 +1296,13 @@ class SFTTrainer:
                     "saved; exiting cleanly for restart+resume",
                     flush=True,
                 )
+            self.telemetry.update(state="preempted", step=step)
+            self.telemetry.recorder.record("emergency_checkpoint", step=step)
+            self.metrics.save_history(
+                os.path.join(cfg.output_dir, "training_history.json")
+            )
+            if plane is not None:
+                plane.stop()
             ckpt.close()
             self.metrics.close()
             return {
@@ -1286,7 +1375,10 @@ class SFTTrainer:
             meter.update(pending_samples, steps=step - synced_step)
         wall = time.perf_counter() - t_start
         throughput = meter.snapshot()
+        self.telemetry.update(state="completed", step=step)
         summary = self._save_artifacts(final_loss, last_eval, wall, throughput)
+        if plane is not None:
+            plane.stop()
         ckpt.close()
         self.metrics.close()
         return summary
@@ -1388,6 +1480,7 @@ class SFTTrainer:
                     f"them. (direct restore: {e})"
                 ) from e2
         resumed_step = int(self.state.step)
+        self.telemetry.note_restore(resumed_step)
         if is_primary_host():
             print(f"Resumed from checkpoint step {resumed_step}")
         return resumed_step

@@ -498,3 +498,151 @@ def test_window_fallback_exposition():
     assert 'serving_info{engine="window"} 1' in text
     assert "serving_queue_depth 0" in text
     assert "histogram" not in text
+
+
+# --------------------------------------------------------------------------
+# Trainer exposition (observe/trainplane.trainer_exposition): the /metrics
+# surface of the training control plane. Same drift-guard contract as the
+# serving set above: pin every # TYPE line, grow-only.
+#
+# The tenant / shed-tier / compile TYPE lines below are NOT trainer metrics
+# — prometheus_exposition emits them unconditionally (load-independence
+# contract), so they appear under the ``training_`` prefix too, bare.
+TRAINER_EXPECTED_METRICS = {
+    ("training_info", "gauge"),
+    # counters (trainplane.TRAIN_COUNTERS)
+    ("training_evals_total", "counter"),
+    ("training_checkpoints_saved_total", "counter"),
+    ("training_publishes_total", "counter"),
+    ("training_publishes_skipped_dirty_total", "counter"),
+    ("training_watchdog_trips_total", "counter"),
+    # kind-labelled anomaly counter, every kind seeded at 0
+    ("training_anomalies_total", "counter"),
+    # gauges (trainplane.TRAIN_GAUGES)
+    ("training_step", "gauge"),
+    ("training_total_steps", "gauge"),
+    ("training_epoch", "gauge"),
+    ("training_epochs", "gauge"),
+    ("training_loss", "gauge"),
+    ("training_learning_rate", "gauge"),
+    ("training_grad_norm", "gauge"),
+    ("training_eval_loss", "gauge"),
+    ("training_best_eval", "gauge"),
+    ("training_samples_per_second", "gauge"),
+    ("training_samples_per_second_per_chip", "gauge"),
+    ("training_steps_per_second", "gauge"),
+    ("training_tokens_per_second_per_chip", "gauge"),
+    ("training_preempted", "gauge"),
+    ("training_model_flops_utilization", "gauge"),
+    ("training_hbm_bandwidth_utilization", "gauge"),
+    # unconditional exposition-machinery TYPE lines (no trainer samples)
+    ("training_tenant_requests_total", "counter"),
+    ("training_tenant_tokens_total", "counter"),
+    ("training_tenant_queue_depth", "gauge"),
+    ("training_requests_shed_tier_total", "counter"),
+    # compile-ledger series (program="..." labels)
+    ("training_compiles_total", "counter"),
+    ("training_compile_seconds_total", "counter"),
+    ("training_recompiles_after_warmup_total", "counter"),
+    # phase histograms (train-loop phase_hist; _s -> _seconds)
+    ("training_data_wait_seconds", "histogram"),
+    ("training_step_seconds", "histogram"),
+    ("training_checkpoint_seconds", "histogram"),
+}
+
+
+def _make_telemetry():
+    from llm_fine_tune_distributed_tpu.observe.tracing import Histogram
+    from llm_fine_tune_distributed_tpu.observe.trainplane import (
+        TRAIN_HIST_KEYS,
+        TrainTelemetry,
+    )
+    from llm_fine_tune_distributed_tpu.observe.xla import CompileLedger
+
+    telemetry = TrainTelemetry(run_id="run-schema", hparams={"lr": 1e-4})
+    telemetry.attach(
+        phase_hist={k: Histogram.exponential() for k in TRAIN_HIST_KEYS},
+        compile_ledger=CompileLedger(),
+    )
+    return telemetry
+
+
+def test_trainer_exposition_schema():
+    from llm_fine_tune_distributed_tpu.observe.trainplane import (
+        trainer_exposition,
+    )
+
+    text = trainer_exposition(_make_telemetry(), memory={})
+    typed = {
+        (m.group(1), m.group(2))
+        for m in re.finditer(r"^# TYPE (\S+) (\S+)$", text, re.M)
+    }
+    assert typed == TRAINER_EXPECTED_METRICS
+    # exactly one TYPE line per metric name (the format forbids repeats)
+    names = re.findall(r"^# TYPE (\S+) ", text, re.M)
+    assert len(names) == len(set(names))
+    # load-independence: every anomaly kind is seeded on a healthy run
+    from llm_fine_tune_distributed_tpu.observe.trainplane import ANOMALY_KINDS
+
+    for kind in ANOMALY_KINDS:
+        assert f'training_anomalies_total{{kind="{kind}"}} 0' in text
+
+
+def test_trainer_exposition_every_counter_and_gauge_exported():
+    """Coverage guard: every TRAIN_COUNTERS entry renders as a typed
+    ``training_<name>_total`` counter with a sample, and every TRAIN_GAUGES
+    entry as a typed gauge with a sample — adding trainer telemetry without
+    exporting it breaks here, not on a dashboard."""
+    from llm_fine_tune_distributed_tpu.observe.metrics import _prom_name
+    from llm_fine_tune_distributed_tpu.observe.trainplane import (
+        TRAIN_COUNTERS,
+        TRAIN_GAUGES,
+        trainer_exposition,
+    )
+
+    text = trainer_exposition(_make_telemetry(), memory={})
+    for name in TRAIN_COUNTERS:
+        prom = _prom_name(name, "training")
+        assert f"# TYPE {prom}_total counter" in text, name
+        assert re.search(rf"^{prom}_total \d", text, re.M), name
+    for name in TRAIN_GAUGES:
+        prom = _prom_name(name, "training")
+        assert f"# TYPE {prom} gauge" in text, name
+        assert re.search(rf"^{prom} ", text, re.M), name
+    # identity strings collapse into the info line
+    assert 'run_id="run-schema"' in text
+    assert 'hparams_digest="' in text and 'state="' in text
+
+
+def test_trainer_exposition_well_formed_and_live_values():
+    """Same scraper-shape contract as the serving exposition, over a
+    telemetry that has actually seen steps, counters, and an anomaly."""
+    telemetry = _make_telemetry()
+    telemetry.on_step(10, {"loss": float("nan")})
+    telemetry.on_step(12, {"loss": 2.0, "learning_rate": 1e-4,
+                           "grad_norm": 1.5})
+    telemetry.incr("checkpoints_saved")
+    telemetry.phase_hist["step"].observe(0.05)
+    from llm_fine_tune_distributed_tpu.observe.trainplane import (
+        trainer_exposition,
+    )
+
+    text = trainer_exposition(telemetry, memory=FAKE_MEMORY)
+    assert text.endswith("\n")
+    sample = re.compile(
+        r'^[a-zA-Z_][a-zA-Z0-9_]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? [^ ]+$'
+    )
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert sample.match(line), line
+        value = line.rsplit(" ", 1)[1]
+        if value != "+Inf":
+            float(value)
+    assert "\ntraining_loss 2\n" in text
+    assert "\ntraining_step 12\n" in text
+    assert "training_checkpoints_saved_total 1" in text
+    assert 'training_anomalies_total{kind="non_finite"} 1' in text
+    assert "training_step_seconds_count 1" in text
+    assert 'device_hbm_bytes_in_use{device="0"} 10' in text
