@@ -84,9 +84,18 @@ injected into the canary replica (invisible to every error-rate gate, and
 published with IMPROVED eval metrics so the eval gate passes it) must be
 blocked by the per-generation latency verdict and rolled back; exits
 nonzero if the regression reaches the second replica or the healthy roll
-is blocked). Every engine-backed JSON line also carries the XLA
-introspection gauges: mfu, hbm_bw_util, compiles_total,
-compile_seconds_total.
+is blocked), SERVE_ELASTIC=1 (elastic arm: a bursty diurnal workload
+swings client load 10x — night, peak, evening — over a fixed fleet
+pinned at SERVE_ELASTIC_MAX_REPLICAS=3 and again over an elastic fleet
+that starts at ONE replica with the signal-driven Autoscaler on; exits
+nonzero unless the elastic run's interactive p99 TTFT stays within 1.5x
+the fixed-max baseline — small absolute floor,
+SERVE_ELASTIC_TTFT_FLOOR_S=1.0 — while its mean replica count stays at
+or below 60% of max, every request ends terminally across scale-ups and
+drain-retires, and nothing recompiles after warmup; per-phase goodput
+fractions ride along in the JSON line). Every engine-backed JSON line
+also carries the XLA introspection gauges: mfu, hbm_bw_util,
+compiles_total, compile_seconds_total.
 """
 
 import json
@@ -1502,6 +1511,193 @@ def main():
             ],
             "slo_compliant": slo_report.get("compliant"),
             "traffic_errors": traffic_errors,
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+        if not ok:
+            sys.exit(1)
+
+    # elastic arm (ISSUE 15): a bursty diurnal workload — a 10x client swing
+    # shaped night -> peak -> evening, with long quiet shoulders around a
+    # short spike — runs twice at identical per-replica geometry: once on a
+    # FIXED fleet pinned at max replicas (the capacity an operator would pay
+    # for around the clock) and once on an elastic fleet that starts at one
+    # replica with the Autoscaler ON. Three gates: the elastic run's
+    # interactive p99 TTFT stays within 1.5x the fixed baseline (small
+    # absolute floor so millisecond-scale CPU baselines don't gate on
+    # scheduler noise), its mean replica count stays <= 60% of max (the
+    # savings the autoscaler exists to bank), and every request ends
+    # terminally across scale-ups AND drain-retires with zero post-warmup
+    # recompiles (replicas share one Generator, so a freshly added
+    # replica's first request must hit warm jit caches).
+    if os.environ.get("SERVE_ELASTIC", "1") == "1":
+        from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
+        from llm_fine_tune_distributed_tpu.observe.capacity import (
+            Autoscaler,
+            LoadForecaster,
+        )
+
+        el_max = int(os.environ.get("SERVE_ELASTIC_MAX_REPLICAS", "3"))
+        el_base = int(os.environ.get("SERVE_ELASTIC_BASE_CLIENTS", "1"))
+        el_swing = int(os.environ.get("SERVE_ELASTIC_SWING", "10"))
+        el_reqs = int(os.environ.get("SERVE_ELASTIC_REQS_PER_CLIENT", "3"))
+        el_floor = float(os.environ.get("SERVE_ELASTIC_TTFT_FLOOR_S", "1.0"))
+        el_gen = Generator(  # fresh generator: isolated compile ledger
+            params, mc, ByteChatMLTokenizer(), compute_dtype=dtype,
+            eos_token_ids=[],
+        )
+
+        def el_replica(rid=0):
+            # deliberately small replicas: the 10x peak must SATURATE one
+            # of them (queue backlog is the scale-up signal) while the
+            # quiet shoulders leave even one replica mostly idle
+            rep = PagedContinuousBatchingEngine(
+                el_gen, slots=2, buf_len=256, prompt_bucket=32, block_len=32,
+                prefill_chunk=64, slo_sample_interval_s=0.05,
+            )
+            # bench-speed EWMA horizons: the diurnal phases last seconds,
+            # not the minutes the production time constants assume
+            rep.load_forecaster = LoadForecaster(
+                short_tau_s=0.5, long_tau_s=5.0
+            )
+            return rep
+
+        # quiet shoulders are interactive-only (they feed the TTFT gate at
+        # trough load); the spike is full mixed-tier traffic so deadline
+        # cancellations and sheds put real waste into the goodput fractions
+        el_low = _overload_workload(
+            np.random.RandomState(12), mc.vocab_size, 32,
+            interactive_only=True,
+        )
+        el_peak = _overload_workload(
+            np.random.RandomState(13), mc.vocab_size, 96
+        )
+        # long quiet shoulders around a short spike: the mean-replica gate
+        # only means something when most of the day is NOT the peak
+        el_phases = (
+            ("night", el_low, el_base, el_reqs * 25),
+            ("peak", el_peak, el_base * el_swing, el_reqs * 3),
+            ("evening", el_low, el_base, el_reqs * 25),
+        )
+
+        def _elastic_phases(fleet):
+            """Run the diurnal schedule; per-phase goodput fractions come
+            from fleet counter DELTAS so each phase owns its own waste."""
+            records, ttfts, unexpected = [], [], []
+            issued = accounted = 0
+            for pname, load, clients, reqs in el_phases:
+                pre = fleet.stats_snapshot()
+                p_ttfts, counts, errs = _overload_run(
+                    fleet, load, clients, reqs
+                )
+                snap = fleet.stats_snapshot()
+                good = snap["goodput_tokens"] - pre["goodput_tokens"]
+                waste = (
+                    sum(snap["wasted_tokens_by_reason"].values())
+                    - sum(pre["wasted_tokens_by_reason"].values())
+                )
+                records.append({
+                    "phase": pname,
+                    "clients": clients,
+                    "goodput_fraction": (
+                        round(good / (good + waste), 4)
+                        if good + waste else 1.0
+                    ),
+                    "interactive_p99_ttft_s": round(
+                        _pctl(sorted(p_ttfts), 0.99), 4
+                    ),
+                    "replicas_at_phase_end": len(fleet.replicas),
+                    **counts,
+                })
+                ttfts.extend(p_ttfts)
+                unexpected.extend(errs)
+                issued += clients * reqs
+                accounted += sum(counts.values())
+            return records, ttfts, unexpected, issued, accounted
+
+        # --- fixed baseline: max replicas for the whole day
+        base_fleet = EngineFleet(
+            [el_replica() for _ in range(el_max)], routing="least-loaded"
+        )
+        # warm BOTH pools end to end on the shared generator: every prompt
+        # bucket / decode width / sampling mode / tier either run will touch
+        # compiles here, so the elastic run's scale-ups land on warm caches
+        _overload_run(base_fleet, el_low, 4, 8)
+        _overload_run(base_fleet, el_peak, 6, 16)
+        base_records, base_ttfts, base_errs, base_issued, base_acct = (
+            _elastic_phases(base_fleet)
+        )
+        base_p99 = _pctl(sorted(base_ttfts), 0.99)
+        base_fleet.replicas[0].mark_compile_warm()  # shared ledger
+        for rep in base_fleet.replicas:  # park the baseline fleet
+            rep.begin_drain()
+
+        # --- elastic: one replica, autoscaler ON, bench-speed control knobs
+        el_fleet = EngineFleet(
+            [el_replica()], routing="least-loaded",
+            replica_factory=el_replica,
+        )
+        scaler = Autoscaler(
+            el_fleet, mode="on", min_replicas=1, max_replicas=el_max,
+            cooldown_s=0.4, interval_s=0.1, horizon_s=5.0,
+        )
+        rep_samples = []
+        el_stop = threading.Event()
+
+        def _replica_monitor():
+            while not el_stop.is_set():
+                rep_samples.append(len(el_fleet.replicas))
+                time.sleep(0.02)
+
+        monitor = threading.Thread(target=_replica_monitor)
+        scaler.start()
+        monitor.start()
+        el_records, el_ttfts, el_errs, el_issued, el_acct = (
+            _elastic_phases(el_fleet)
+        )
+        el_stop.set()
+        monitor.join()
+        scaler.stop()
+
+        el_p99 = _pctl(sorted(el_ttfts), 0.99)
+        mean_reps = sum(rep_samples) / max(1, len(rep_samples))
+        comp = el_fleet.replicas[0].stats_snapshot()["compile"]
+        ttft_limit = max(1.5 * base_p99, el_floor)
+        applied = [d for d in scaler.decisions() if d.get("applied")]
+        ok = (
+            not base_errs
+            and not el_errs
+            and base_acct == base_issued
+            and el_acct == el_issued
+            and bool(el_ttfts)
+            and el_p99 <= ttft_limit
+            and mean_reps <= 0.6 * el_max
+            and comp["recompiles_after_warmup"] == 0
+        )
+        print(json.dumps({
+            "metric": "serve_elastic_guard",
+            "value": 1 if ok else 0,
+            "unit": "1 = elastic fleet rides a 10x diurnal swing: p99 TTFT "
+                    "<= max(1.5x fixed-max baseline, floor), mean replicas "
+                    "<= 60% of max, zero drops, zero post-warmup recompiles",
+            "max_replicas": el_max,
+            "mean_replica_count": round(mean_reps, 3),
+            "peak_replica_count": max(rep_samples, default=1),
+            "baseline_interactive_p99_ttft_s": round(base_p99, 4),
+            "elastic_interactive_p99_ttft_s": round(el_p99, 4),
+            "ttft_limit_s": round(ttft_limit, 4),
+            "scale_ups_applied": sum(
+                1 for d in applied if d["direction"] == "up"
+            ),
+            "scale_downs_applied": sum(
+                1 for d in applied if d["direction"] == "down"
+            ),
+            "recompiles_after_warmup": comp["recompiles_after_warmup"],
+            "requests_issued": base_issued + el_issued,
+            "requests_accounted": base_acct + el_acct,
+            "unexpected_errors": base_errs + el_errs,
+            "baseline_phases": base_records,
+            "elastic_phases": el_records,
             "model": preset,
             "platform": jax.devices()[0].platform,
         }), flush=True)

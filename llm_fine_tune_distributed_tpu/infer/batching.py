@@ -108,6 +108,12 @@ class Request:
     # continues from there), and how many times this request was bumped.
     preempted_tokens: List[int] = field(default_factory=list)
     preemptions: int = 0
+    # goodput accounting (observe/capacity.py): every token this request
+    # ever caused the device to emit, including tokens banked across
+    # preemptions and tokens later discarded by a cancel/failover — the
+    # settle-time classifier charges exactly this many to goodput or to
+    # one waste reason. Worker-thread-only writes.
+    tokens_emitted: int = 0
     # set (GIL-atomic, like ``abandoned``) by an admission thread that
     # displaced this queued lower-priority request to make room; the
     # scheduler resolves it with a tier-labelled 429 at its next admit pass

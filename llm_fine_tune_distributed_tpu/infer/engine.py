@@ -109,6 +109,7 @@ from llm_fine_tune_distributed_tpu.infer.supervisor import (
     EngineSupervisor,
     FaultInjector,
 )
+from llm_fine_tune_distributed_tpu.observe.capacity import LoadForecaster
 from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
 from llm_fine_tune_distributed_tpu.observe.slo import (
     GenerationSlices,
@@ -488,6 +489,10 @@ class ContinuousBatchingEngine:
         # hot-path cache: the CURRENT generation's slice (re-pointed by
         # _apply_swap) so per-token observes skip the dict lookup
         self._gen_slice = self.slo_slices.slice_for(0)
+        # capacity observatory (observe/capacity.py): fed one sample per
+        # metric-ring tick from _sample_slo — rides the same tick stamp,
+        # zero extra clock reads on the token hot path
+        self.load_forecaster = LoadForecaster()
         # XLA compile ledger (observe/xla.py): shared with the Generator so
         # fleet replicas over one Generator count each compilation once.
         # Stub generators (schema tests) have none — give the engine its own.
@@ -915,6 +920,31 @@ class ContinuousBatchingEngine:
             flops, nbytes, mean_tick_s, peak_flops, peak_bw
         )
 
+    def capacity_snapshot(self) -> dict:
+        """Raw capacity measurements for the observatory
+        (observe/capacity.py): the forecaster's load view plus the
+        saturation model's inputs — slot count, measured mean decode-tick
+        time, tokens per step, and the roofline gauges. Every field is
+        well-defined on a cold or stub-backed engine (zeros, not NaNs)."""
+        hist = self.stats.hist.get("decode_tick_s")
+        ticks = int(getattr(hist, "total", 0) or 0) if hist is not None else 0
+        mean_tick_s = float(hist.sum) / ticks if ticks else 0.0
+        vals = self.stats.values(("tokens_served", "decode_steps"))
+        steps = vals["decode_steps"]
+        mfu, bw = self._utilization()
+        return {
+            "slots": int(self._slots),
+            "decode_ticks": ticks,
+            "mean_decode_tick_s": mean_tick_s,
+            "mean_tokens_per_step": (
+                vals["tokens_served"] / steps if steps else 0.0
+            ),
+            "live_slots_mean": self.load_forecaster.live_slots_mean,
+            "model_flops_utilization": mfu,
+            "hbm_bandwidth_utilization": bw,
+            "forecaster": self.load_forecaster.snapshot(),
+        }
+
     def mark_compile_warm(self) -> None:
         """Declare jit warmup over: from here on, every compilation the
         ledger sees counts as ``recompiles_after_warmup`` — a steady-state
@@ -1147,6 +1177,33 @@ class ContinuousBatchingEngine:
         self.slo_slices.note_settled(
             req.weight_generation, failed=req.error is not None
         )
+        # goodput taxonomy (observe/capacity.py): every token this request
+        # caused the device to emit is charged exactly once, here, to
+        # goodput or to one waste reason — the settle point is the only
+        # place the terminal outcome is known.
+        n = req.tokens_emitted
+        if n:
+            if req.abandoned:
+                # the waiter is gone (timeout/disconnect) — covers
+                # preempted-then-abandoned banked tokens too
+                self.stats.waste_incr("abandoned", n)
+            elif req.error is None:
+                self.stats.incr("goodput_tokens", n)
+            elif isinstance(req.error, DeadlineExceededError):
+                # cancelled mid-decode (or at prefill) by a client deadline
+                self.stats.waste_incr("deadline", n)
+            elif isinstance(
+                req.error,
+                (RetryableEngineError, CircuitOpenError,
+                 FatalEngineError, DrainingError),
+            ):
+                # restart/circuit casualty: a fleet re-runs the request on
+                # a sibling, so this replica's tokens are duplicate work
+                self.stats.waste_incr("failover", n)
+            else:
+                # shed after work had been done (displacement/overflow of
+                # a preempted request with banked tokens, quota, ...)
+                self.stats.waste_incr("shed", n)
         with self._plock:
             self._pending -= 1
             if req.adapter is not None:
@@ -1845,6 +1902,28 @@ class ContinuousBatchingEngine:
         report = self.slo_policy.evaluate(self.metric_ring, now=now)
         for kind, fields in self.slo_policy.observe_transitions(report):
             self.recorder.record(kind, **fields)
+        # capacity observatory feed: one counter read per ring sample (the
+        # forecaster converts cumulative totals to rates itself). Arrivals
+        # approximate offered load: admissions plus at-the-door sheds.
+        vals = self.stats.values((
+            "requests_admitted", "requests_shed_overflow",
+            "requests_shed_deadline", "requests_shed_tenant_quota",
+            "tokens_served",
+        ))
+        self.load_forecaster.update(
+            now,
+            arrivals=(
+                vals["requests_admitted"]
+                + vals["requests_shed_overflow"]
+                + vals["requests_shed_deadline"]
+                + vals["requests_shed_tenant_quota"]
+            ),
+            admitted=vals["requests_admitted"],
+            tokens=vals["tokens_served"],
+            queue_depth=self._queue_len(),
+            queue_wait_s=self._queue_wait_ewma,
+            live_slots=int(self._live.sum()),
+        )
 
     def _decode_once(self, step) -> None:
         gen = self._generator
@@ -2005,6 +2084,7 @@ class ContinuousBatchingEngine:
             self._finish(slot, req)
             return
         self._slot_tokens[slot].append(tok)
+        req.tokens_emitted += 1
         self.stats.incr("tokens_served")
         if req.adapter is not None:
             self.stats.tenant_incr(req.adapter, "tokens")

@@ -82,12 +82,20 @@ from llm_fine_tune_distributed_tpu.infer.routing import (
     prefix_block_keys,
 )
 from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+from llm_fine_tune_distributed_tpu.observe.capacity import (
+    SaturationModel,
+    report_from_capacity_snapshots,
+)
 from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
 from llm_fine_tune_distributed_tpu.observe.slo import (
     GenerationSlices,
     SloPolicy,
 )
-from llm_fine_tune_distributed_tpu.observe.tracing import Histogram, RequestTrace
+from llm_fine_tune_distributed_tpu.observe.tracing import (
+    FlightRecorder,
+    Histogram,
+    RequestTrace,
+)
 from llm_fine_tune_distributed_tpu.observe.xla import CompileLedger
 
 # Replica failures that do not implicate the request: the fleet re-places
@@ -100,6 +108,12 @@ _FAILOVER_ERRORS = (
     FatalEngineError,
     DrainingError,
 )
+
+# Slack past a client deadline before the fleet's own wait gives up. The
+# replica enforces the deadline on its tick clock (admission shed or
+# mid-decode cancel, both DeadlineExceededError); the fleet-side wait only
+# backstops a hung replica, so it must lose any race at the deadline itself.
+DEADLINE_TIMEOUT_GRACE_S = 1.0
 
 
 class EngineFleet:
@@ -121,6 +135,7 @@ class EngineFleet:
         replicas: Sequence,
         routing: str = "prefix",
         prefix_home_capacity: int = 8192,
+        replica_factory=None,
     ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -129,25 +144,180 @@ class EngineFleet:
                 f"unknown routing policy {routing!r}; "
                 f"choose from {ROUTING_POLICIES}"
             )
-        self.replicas = list(replicas)
+        # STABLE replica ids: the set can grow (add_replica) and shrink
+        # (retire_replica) mid-flight, so every cross-thread reference —
+        # Placement.index, the intent map, exclusion sets, /metrics labels —
+        # holds an id, never a list position. Ids are never reused.
+        self._by_id: "OrderedDict[int, object]" = OrderedDict(
+            (i, rep) for i, rep in enumerate(replicas)
+        )
+        self._next_id = len(self._by_id)
+        # builds one more replica on demand (infer/server.py passes its
+        # _make_replica closure); None disables add_replica
+        self._replica_factory = replica_factory
         self.routing = routing
         # affinity keys use the replicas' prefix-cache granularity; dense
         # replicas have none (block_len 0 -> no keys -> affinity never fires)
-        self._block_len = int(getattr(self.replicas[0], "block_len", 0) or 0)
+        self._block_len = int(getattr(replicas[0], "block_len", 0) or 0)
         # router state: one lock covers the rotation counter, the intent
-        # map, the counters, and the placement log. Held only for host-side
-        # bookkeeping — never across a replica submit (which blocks).
+        # map, the counters, the placement log, and the replica map. Held
+        # only for host-side bookkeeping — never across a replica submit
+        # (which blocks) and never across a replica build or drain.
         self._lock = threading.Lock()
         self._rr_seq = 0
-        # prefix intent map: block key -> replica index it was last routed
+        # prefix intent map: block key -> replica id it was last routed
         # to (LRU-bounded). Covers queued-but-unprefilled prefixes that the
         # replicas' caches cannot know about yet.
         self._prefix_home: "OrderedDict[bytes, int]" = OrderedDict()
         self._prefix_cap = max(0, int(prefix_home_capacity))
         self._counters: Dict[str, int] = {k: 0 for k in self.ROUTER_COUNTERS}
-        # bounded decision log: (replica index, reason) per placement, in
+        # bounded decision log: (replica id, reason) per placement, in
         # placement order — what the determinism tests replay against
         self._placements: "deque[Tuple[int, str]]" = deque(maxlen=4096)
+        # retired-replica accumulator: a retiring replica's final counters,
+        # histograms, tenant/tier/waste maps, SLO slices, and compile
+        # ledger fold in here BEFORE the replica leaves the map, so fleet
+        # aggregates (and /metrics totals) never go backwards on scale-down
+        self._retired_counters: Dict[str, int] = {}
+        self._retired_hist: Dict[str, Histogram] = {}
+        self._retired_tenants: Dict[str, Dict[str, int]] = {}
+        self._retired_tenant_hist: Dict[str, Dict[str, Histogram]] = {}
+        self._retired_tiers: Dict[str, int] = {}
+        self._retired_waste: Dict[str, int] = {}
+        self._retired_slices: List[GenerationSlices] = []
+        self._retired_ledgers: List[CompileLedger] = []
+        self._retired_count = 0
+        # fleet-level lifecycle timeline: scale_up / scale_down /
+        # scale_decision events (GET /v1/flight merges it with replicas')
+        self.recorder = FlightRecorder(1024)
+        self._saturation = SaturationModel()
+
+    # --------------------------------------------------------- replica set
+
+    @property
+    def replicas(self) -> List:
+        """Live replicas in id order. A fresh list each read (callers
+        iterate without holding the router lock; ``list()`` over the dict
+        is atomic under the GIL)."""
+        return list(self._by_id.values())
+
+    def replica_items(self) -> List[Tuple[int, object]]:
+        """(stable id, replica) pairs in id order — the ONLY correct way
+        to label per-replica output (/metrics, /v1/flight): positions
+        shift when the fleet scales, ids never do."""
+        return list(self._by_id.items())
+
+    def add_replica(self):
+        """Grow the fleet by one replica (cheap: replicas share the one
+        resident Generator, so a new replica is a supervisor + KV/block
+        pool + stats — no weight load, no recompile). Returns
+        ``(new_id, replica)``. Raises RuntimeError when the fleet was
+        built without a ``replica_factory``."""
+        if self._replica_factory is None:
+            raise RuntimeError(
+                "fleet has no replica_factory; add_replica is disabled"
+            )
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        # build OUTSIDE the lock: pool allocation may take a while and the
+        # router must keep placing on the existing replicas meanwhile
+        rep = self._replica_factory(rid)
+        with self._lock:
+            self._by_id[rid] = rep
+            n = len(self._by_id)
+        self.recorder.record("scale_up", replica=rid, replicas=n)
+        return rid, rep
+
+    def retire_replica(self, rid: Optional[int] = None, timeout_s: float = 60.0):
+        """Shrink the fleet by one replica, gracefully: close the
+        replica's admission (the router stops choosing it the moment
+        ``draining`` flips), let in-flight work finish via the drain
+        machinery, fold its final stats into the retired accumulator
+        (fleet totals never go backwards), THEN drop it from the map and
+        purge its intent-map entries. Defaults to the newest replica.
+        Returns the retired id. Refuses to retire the last replica.
+
+        On drain timeout the replica is torn down anyway — its waiters
+        still hold a reference and settle normally, but tokens they emit
+        after the fold are not added to fleet totals (undercount, never
+        a decrease)."""
+        with self._lock:
+            if len(self._by_id) <= 1:
+                raise ValueError("cannot retire the last replica")
+            if rid is None:
+                rid = next(reversed(self._by_id))
+            if rid not in self._by_id:
+                raise KeyError(f"no replica with id {rid}")
+            rep = self._by_id[rid]
+        # drain outside the lock: the replica stays in the map (and keeps
+        # settling its queue) while it drains; _route already excludes
+        # draining replicas at decision time
+        rep.begin_drain()
+        drained = rep.wait_drained(timeout_s)
+        self._fold_retired(rep)
+        with self._lock:
+            self._by_id.pop(rid, None)
+            self._retired_count += 1
+            # satellite: intent-map entries pointing at a retired id are
+            # dead weight — drop them so the LRU holds only live homes
+            for key in [
+                k for k, home in self._prefix_home.items() if home == rid
+            ]:
+                del self._prefix_home[key]
+            n = len(self._by_id)
+        self.recorder.record(
+            "scale_down", replica=rid, replicas=n, drained=bool(drained)
+        )
+        return rid
+
+    def _fold_retired(self, rep) -> None:
+        """Merge a retiring replica's final stats into the persistent
+        accumulator (tolerates bare scripted stubs: anything the replica
+        does not expose simply does not fold)."""
+        stats = getattr(rep, "stats", None)
+        if stats is not None:
+            snap = stats.snapshot()
+            with self._lock:
+                for key in ServingStats.COUNTERS:
+                    self._retired_counters[key] = (
+                        self._retired_counters.get(key, 0)
+                        + int(snap.get(key, 0))
+                    )
+                for tenant, rec in (snap.get("per_tenant") or {}).items():
+                    mine = self._retired_tenants.setdefault(
+                        tenant, {k: 0 for k in ServingStats.TENANT_KEYS}
+                    )
+                    for k in ServingStats.TENANT_KEYS:
+                        mine[k] += int(rec.get(k, 0))
+                for t, n in (snap.get("requests_shed_by_tier") or {}).items():
+                    self._retired_tiers[t] = (
+                        self._retired_tiers.get(t, 0) + int(n)
+                    )
+                for r, n in (
+                    snap.get("wasted_tokens_by_reason") or {}
+                ).items():
+                    self._retired_waste[r] = (
+                        self._retired_waste.get(r, 0) + int(n)
+                    )
+                for name in ServingStats.HISTOGRAM_SPECS:
+                    h = stats.hist[name]
+                    if name not in self._retired_hist:
+                        self._retired_hist[name] = Histogram(h.bounds)
+                    self._retired_hist[name].merge(h)
+                for tenant, hists in stats.tenant_histograms().items():
+                    mine_h = self._retired_tenant_hist.setdefault(tenant, {})
+                    for name, h in hists.items():
+                        if name not in mine_h:
+                            mine_h[name] = Histogram(h.bounds)
+                        mine_h[name].merge(h)
+        slices = getattr(rep, "slo_slices", None)
+        ledger = getattr(rep, "compile_ledger", None)
+        with self._lock:
+            if slices is not None:
+                self._retired_slices.append(slices)
+            if ledger is not None:
+                self._retired_ledgers.append(ledger)
 
     # ---------------------------------------------------------------- routing
 
@@ -178,7 +348,11 @@ class EngineFleet:
         time, not completion time — a same-prefix burst must see the first
         request's intent while it is still queued."""
         views = []
-        for i, rep in enumerate(self.replicas):
+        # snapshot of the live (id, replica) pairs: the set may change
+        # size mid-flight (add/retire), so the decision works over ids —
+        # a retiring replica reads draining=True and leaves the candidate
+        # set; a retired one is simply absent
+        for i, rep in self.replica_items():
             if i in excluded:
                 continue
             views.append(
@@ -250,24 +424,26 @@ class EngineFleet:
         last_err: Optional[BaseException],
     ) -> BaseException:
         """No candidate left: decide what the FLEET's answer is."""
-        if not any(rep.healthy for rep in self.replicas):
+        items = self.replica_items()
+        if not any(rep.healthy for _, rep in items):
             err: ServingError = NoHealthyReplicaError(
-                f"all {len(self.replicas)} replicas are terminally dead "
+                f"all {len(items)} replicas are terminally dead "
                 "(circuit open or fatal); the pod needs a recycle"
             )
             err.__cause__ = last_err
             return err
-        admitting = {
-            i
-            for i, rep in enumerate(self.replicas)
+        admitting_reps = {
+            i: rep
+            for i, rep in items
             if rep.healthy and not rep.draining
         }
+        admitting = set(admitting_reps)
         # minimum predicted drain across still-serving replicas: the
         # soonest ANY replica can absorb the retry (a per-replica hint
         # would quote the rejecting replica's backlog even when a sibling
         # drains sooner)
         retry_after = min(
-            (self.replicas[i].predicted_drain_s() for i in admitting),
+            (rep.predicted_drain_s() for rep in admitting_reps.values()),
             default=None,
         )
         if admitting and admitting <= set(overflowed):
@@ -314,10 +490,14 @@ class EngineFleet:
         one timeline under one propagated trace id."""
         if deadline_s is not None:
             # the failover budget derives from the client deadline: a retry
-            # against a sibling past the deadline can only waste its slots
-            timeout = (
-                deadline_s if timeout is None else min(timeout, deadline_s)
-            )
+            # against a sibling past the deadline can only waste its slots.
+            # The grace past the deadline keeps the client-side wait a hang
+            # BACKSTOP rather than the enforcer — the replica's own deadline
+            # machinery must win that race and surface DeadlineExceededError
+            # (the client's 504 with its partial tokens), not a bare
+            # stream-starved TimeoutError
+            budget = deadline_s + DEADLINE_TIMEOUT_GRACE_S
+            timeout = budget if timeout is None else min(timeout, budget)
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
@@ -338,7 +518,7 @@ class EngineFleet:
                 if best_effort:
                     browned = [
                         rep
-                        for i, rep in enumerate(self.replicas)
+                        for i, rep in self.replica_items()
                         if i not in excluded
                         and rep.healthy
                         and not rep.draining
@@ -367,7 +547,15 @@ class EngineFleet:
                 f"policy={self.routing} reason={placement.reason} "
                 f"score={placement.score:g}"
             )
-            replica = self.replicas[placement.index]
+            # by id, not position: the replica set may have shrunk since
+            # the decision. A replica retired between decision and
+            # dispatch is just another failover hop.
+            replica = self._by_id.get(placement.index)
+            if replica is None:
+                excluded.add(placement.index)
+                trace.mark(f"failover replica={placement.index} error=retired")
+                self._count("requests_failed_over")
+                continue
             remaining: Optional[float] = None
             if deadline is not None:
                 remaining = deadline - time.monotonic()
@@ -531,10 +719,14 @@ class EngineFleet:
 
     def merged_histograms(self) -> Dict[str, Histogram]:
         """Fleet-wide latency histograms: exact merges of the replicas'
-        (identical fixed buckets — the property they were designed for)."""
+        (identical fixed buckets — the property they were designed for),
+        plus everything retired replicas observed before teardown."""
         out: Dict[str, Histogram] = {}
         for name in ServingStats.HISTOGRAM_SPECS:
             hists = [rep.stats.hist[name] for rep in self.replicas]
+            retired = self._retired_hist.get(name)
+            if retired is not None:
+                hists.append(retired)
             merged = Histogram(hists[0].bounds)
             for h in hists:
                 merged.merge(h)
@@ -543,11 +735,19 @@ class EngineFleet:
 
     def merged_tenant_histograms(self) -> Dict[str, Dict[str, Histogram]]:
         """Fleet-wide per-tenant latency histograms: one tenant's traffic
-        may land on several replicas, so each tenant's series is the
-        exact merge of its per-replica histograms."""
+        may land on several replicas (and on replicas since retired), so
+        each tenant's series is the exact merge across all of them."""
         out: Dict[str, Dict[str, Histogram]] = {}
-        for rep in self.replicas:
-            for tenant, hists in rep.stats.tenant_histograms().items():
+        sources = [rep.stats.tenant_histograms() for rep in self.replicas]
+        with self._lock:
+            sources.append(
+                {
+                    t: dict(hists)
+                    for t, hists in self._retired_tenant_hist.items()
+                }
+            )
+        for tenant_hists in sources:
+            for tenant, hists in tenant_hists.items():
                 mine = out.setdefault(tenant, {})
                 for name, h in hists.items():
                     if name not in mine:
@@ -557,9 +757,9 @@ class EngineFleet:
 
     def slo_report(self) -> dict:
         """Fleet SLO view (``GET /v1/slo``): merged burn-rate report plus
-        each replica's own."""
+        each replica's own (keyed by stable replica id)."""
         per = {
-            str(i): rep.slo_report() for i, rep in enumerate(self.replicas)
+            str(i): rep.slo_report() for i, rep in self.replica_items()
         }
         merged = SloPolicy.merge_reports(list(per.values()))
         merged["per_replica"] = per
@@ -568,10 +768,10 @@ class EngineFleet:
     def history(self, metric: str, window_s=None) -> dict:
         """Per-replica trailing series of one sampled metric
         (``GET /v1/history``). Rings are per-replica (their sample clocks
-        are independent), so the fleet answer is keyed by replica."""
+        are independent), so the fleet answer is keyed by replica id."""
         per = {
             str(i): rep.history(metric, window_s)
-            for i, rep in enumerate(self.replicas)
+            for i, rep in self.replica_items()
         }
         first = next(iter(per.values()))
         return {
@@ -615,12 +815,24 @@ class EngineFleet:
         """
         per = {
             str(i): {"replica": i, **rep.stats_snapshot()}
-            for i, rep in enumerate(self.replicas)
+            for i, rep in self.replica_items()
         }
         snaps = list(per.values())
+        with self._lock:
+            retired_counters = dict(self._retired_counters)
+            retired_tenants = {
+                t: dict(rec) for t, rec in self._retired_tenants.items()
+            }
+            retired_tiers = dict(self._retired_tiers)
+            retired_waste = dict(self._retired_waste)
+            retired_count = self._retired_count
         agg: dict = {}
+        # counters include every replica that EVER served (live + retired
+        # accumulator): fleet totals are monotone across scale-down
         for key in ServingStats.COUNTERS:
-            agg[key] = sum(s[key] for s in snaps)
+            agg[key] = sum(s[key] for s in snaps) + retired_counters.get(
+                key, 0
+            )
         for key in ServingStats.GAUGES:
             vals = [s[key] for s in snaps]
             # generations are epochs, not occupancy: the fleet's restart
@@ -673,8 +885,11 @@ class EngineFleet:
             else 0.0
         )
         # per-tenant maps merge by summing each tenant's keys across
-        # replicas (a tenant's traffic may land on several replicas)
-        tenants: Dict[str, Dict[str, int]] = {}
+        # replicas (a tenant's traffic may land on several replicas —
+        # including ones since retired)
+        tenants: Dict[str, Dict[str, int]] = {
+            t: dict(rec) for t, rec in retired_tenants.items()
+        }
         for s in snaps:
             for tenant, rec in (s.get("per_tenant") or {}).items():
                 mine = tenants.setdefault(
@@ -687,18 +902,37 @@ class EngineFleet:
         # shape as the per-tenant merge: one tier's sheds may come from
         # several replicas)
         by_tier: Dict[str, int] = {t: 0 for t in ServingStats.SHED_TIERS}
+        for t, n in retired_tiers.items():
+            by_tier[t] = by_tier.get(t, 0) + int(n)
         for s in snaps:
             for t, n in (s.get("requests_shed_by_tier") or {}).items():
                 by_tier[t] = by_tier.get(t, 0) + int(n)
         agg["requests_shed_by_tier"] = by_tier
+        # goodput/waste split (observe/capacity.py): waste reasons merge
+        # like tiers; the fraction is recomputed from the SUMMED totals
+        waste: Dict[str, int] = {r: 0 for r in ServingStats.WASTE_REASONS}
+        for r, n in retired_waste.items():
+            waste[r] = waste.get(r, 0) + int(n)
+        for s in snaps:
+            for r, n in (s.get("wasted_tokens_by_reason") or {}).items():
+                waste[r] = waste.get(r, 0) + int(n)
+        agg["wasted_tokens_by_reason"] = waste
+        wasted_total = sum(waste.values())
+        emitted = agg["goodput_tokens"] + wasted_total
+        agg["goodput_fraction"] = (
+            agg["goodput_tokens"] / emitted if emitted else 1.0
+        )
         agg["histograms"] = {
             name: h.summary() for name, h in self.merged_histograms().items()
         }
         # compile ledgers dedup by identity: replicas over one shared
         # Generator share one ledger, so a shared compilation counts once
-        agg["compile"] = CompileLedger.merge(
-            getattr(rep, "compile_ledger", None) for rep in self.replicas
-        )
+        # (retired replicas' ledgers ride along — usually the same object)
+        with self._lock:
+            ledgers = [
+                getattr(rep, "compile_ledger", None) for rep in self.replicas
+            ] + list(self._retired_ledgers)
+        agg["compile"] = CompileLedger.merge(ledgers)
         # utilization is per-device, not additive — the fleet-level gauge
         # reports the busiest replica (stub replicas report nothing)
         agg["model_flops_utilization"] = max(
@@ -715,15 +949,19 @@ class EngineFleet:
             [s.get("slo") for s in snaps if s.get("slo")]
         )
         # per-generation slices merge exactly (fixed-bucket histograms
-        # sum); mid-roll the generations legitimately differ per replica
-        agg["per_generation"] = GenerationSlices.merged_summaries(
-            rep.slo_slices
-            for rep in self.replicas
-            if getattr(rep, "slo_slices", None) is not None
-        )
+        # sum); mid-roll the generations legitimately differ per replica.
+        # Retired replicas' slices keep contributing their settled history.
+        with self._lock:
+            all_slices = [
+                rep.slo_slices
+                for rep in self.replicas
+                if getattr(rep, "slo_slices", None) is not None
+            ] + list(self._retired_slices)
+        agg["per_generation"] = GenerationSlices.merged_summaries(all_slices)
         agg["circuit_state"] = self.circuit_state
         agg["draining"] = self.draining
         agg["replicas"] = len(self.replicas)
+        agg["replicas_retired"] = retired_count
         agg["routing"] = self.routing
         agg["healthy_replicas"] = sum(
             1 for rep in self.replicas if rep.healthy
@@ -737,3 +975,32 @@ class EngineFleet:
             agg.update(self._counters)
         agg["per_replica"] = per
         return agg
+
+    # -------------------------------------------------------------- capacity
+
+    def capacity_report(
+        self,
+        horizon_s: float = 60.0,
+        min_replicas: int = 1,
+        max_replicas: Optional[int] = None,
+    ) -> dict:
+        """One decision-ready capacity view (``GET /v1/capacity``; the
+        Autoscaler's input): per-replica load forecasts summed to fleet
+        demand, per-replica sustainable throughput from the saturation
+        model, headroom, and the hysteresis-banded replica recommendation
+        (observe/capacity.report_from_capacity_snapshots — pure once the
+        snapshots are taken). Replicas without a ``capacity_snapshot``
+        (scripted stubs) contribute no signal."""
+        snaps = []
+        for _, rep in self.replica_items():
+            snap_fn = getattr(rep, "capacity_snapshot", None)
+            if snap_fn is not None:
+                snaps.append(snap_fn())
+        return report_from_capacity_snapshots(
+            snaps,
+            len(self._by_id),
+            model=self._saturation,
+            horizon_s=horizon_s,
+            min_replicas=min_replicas,
+            max_replicas=max_replicas,
+        )

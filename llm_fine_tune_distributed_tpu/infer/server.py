@@ -13,6 +13,13 @@ closes that gap with a dependency-free stdlib server exposing:
                                         (JSON)
   GET  /metrics                      -> the same telemetry as Prometheus
                                         text exposition (scrape target)
+  GET  /v1/capacity                  -> capacity observatory: load
+                                        forecast, sustainable throughput,
+                                        headroom, replica recommendation,
+                                        autoscaler decision history
+  POST /v1/fleet/scale               -> {"replicas": N} manual fleet
+                                        resize within the autoscaler
+                                        bounds (fleet servers only)
   POST /v1/generate {"question": .., -> {"answer": ..}
         optional: "max_new_tokens", "temperature", "top_p", "top_k",
                   "repetition_penalty", "greedy", "seed", "system_prompt",
@@ -102,6 +109,10 @@ def serve(
     engine_kind: str = "continuous",
     replicas: int = 1,
     routing: str = "prefix",
+    autoscale: str = "dry-run",
+    min_replicas: int = 1,
+    max_replicas: int = 0,
+    scale_cooldown_s: float = 30.0,
     slots: int = 8,
     kv_buf_len: int = 4096,
     kv_block_len: int = 256,
@@ -162,6 +173,10 @@ def serve(
 
     from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
     from llm_fine_tune_distributed_tpu.infer.routing import ROUTING_POLICIES
+    from llm_fine_tune_distributed_tpu.observe.capacity import (
+        Autoscaler,
+        report_from_capacity_snapshots,
+    )
     from llm_fine_tune_distributed_tpu.observe.metrics import (
         PROMETHEUS_CONTENT_TYPE,
         prometheus_exposition,
@@ -231,6 +246,30 @@ def serve(
             "--replicas N needs a continuous/paged engine (the fleet "
             "router places by queue depth and prefix residency, which the "
             "window batcher does not expose); drop --replicas or pick "
+            "--engine continuous|paged"
+        )
+    autoscale = autoscale or "dry-run"
+    if autoscale not in Autoscaler.MODES:
+        raise ValueError(
+            f"unknown --autoscale mode {autoscale!r} (expected one of "
+            f"{Autoscaler.MODES})"
+        )
+    min_replicas = max(1, int(min_replicas or 1))
+    max_replicas = max(0, int(max_replicas or 0))
+    if max_replicas and max_replicas < replicas:
+        raise ValueError(
+            "--max-replicas must be >= --replicas (the fleet starts at "
+            f"--replicas); got {max_replicas} < {replicas}"
+        )
+    if min_replicas > replicas:
+        raise ValueError(
+            "--min-replicas must be <= --replicas (the fleet starts at "
+            f"--replicas); got {min_replicas} > {replicas}"
+        )
+    if max_replicas > replicas and engine_kind == "window":
+        raise ValueError(
+            "--max-replicas (elastic fleet growth) needs a continuous/"
+            "paged engine; drop --max-replicas or pick "
             "--engine continuous|paged"
         )
     if publish_watch_dir and engine_kind == "window":
@@ -383,7 +422,7 @@ def serve(
                         max_adapters=max_adapters,
                     )
                     kw["adapter_quota"] = adapter_capacity
-                if replicas > 1:
+                if replicas > 1 or max_replicas > replicas:
                     if kw.get("flight_dir"):
                         kw["flight_dir"] = os.path.join(
                             kw["flight_dir"], f"replica{i}"
@@ -401,14 +440,38 @@ def serve(
                     generator, slots=slots, buf_len=kv_buf_len, **kw
                 )
 
-            if replicas > 1:
+            if replicas > 1 or max_replicas > replicas:
+                # a growable fleet even from --replicas 1: elastic growth
+                # needs the router/fleet shape from the start, so
+                # --max-replicas above --replicas forces it
                 cont_engine = EngineFleet(
                     [_make_replica(i) for i in range(replicas)],
                     routing=routing,
+                    replica_factory=_make_replica,
                 )
             else:
                 cont_engine = _make_replica(0)
             cont_kind = engine_kind
+    # elastic fleet control loop (observe/capacity.py): dry-run (default)
+    # records would-be decisions without acting — read GET /v1/capacity,
+    # then restart with --autoscale on once the recommendations look sane
+    autoscaler = None
+    if isinstance(cont_engine, EngineFleet):
+        autoscaler = Autoscaler(
+            cont_engine,
+            mode=autoscale,
+            min_replicas=min_replicas,
+            max_replicas=max_replicas or replicas,
+            cooldown_s=scale_cooldown_s,
+            retire_timeout_s=drain_timeout_s,
+        )
+        if autoscale != "off":
+            autoscaler.start()
+            print(
+                f"[serve] autoscaler ({autoscale}): replicas in "
+                f"[{min_replicas}, {max_replicas or replicas}], "
+                f"cooldown {scale_cooldown_s:g}s"
+            )
     # on-demand profiler capture (POST /v1/profile): one per server process
     # (jax.profiler traces are process-wide). Captures go on the engine's
     # flight-recorder timeline so they line up with crashes and restarts.
@@ -611,13 +674,18 @@ def serve(
                 if isinstance(cont_engine, EngineFleet):
                     snap = {"engine": cont_kind, **cont_engine.stats_snapshot()}
                     per = snap.pop("per_replica")
+                    # per_replica labels are STABLE ids, not positions: a
+                    # scaled fleet's ids are sparse, and a replica retired
+                    # between the snapshot and here simply drops its series
+                    by_id = dict(cont_engine.replica_items())
                     replica_series = [
                         (
                             label,
                             per[label],
-                            cont_engine.replicas[int(label)].stats.hist,
+                            by_id[int(label)].stats.hist,
                         )
                         for label in sorted(per, key=int)
+                        if int(label) in by_id
                     ]
                     hists = cont_engine.merged_histograms()
                     tenant_hists = cont_engine.merged_tenant_histograms()
@@ -705,17 +773,57 @@ def serve(
                     })
                     return
                 if isinstance(cont_engine, EngineFleet):
+                    # "fleet" carries the fleet's own lifecycle events
+                    # (scale_up / scale_down / scale_decision); per-replica
+                    # rings are keyed by stable id, not position
                     self._send(200, {
+                        "fleet": cont_engine.recorder.events()[-limit:],
                         "replicas": {
-                            str(i): rep.recorder.events()[-limit:]
-                            for i, rep in enumerate(cont_engine.replicas)
-                        }
+                            str(rid): rep.recorder.events()[-limit:]
+                            for rid, rep in cont_engine.replica_items()
+                        },
                     })
                 else:
                     self._send(
                         200,
                         {"events": cont_engine.recorder.events()[-limit:]},
                     )
+            elif path == "/v1/capacity":
+                # capacity observatory (observe/capacity.py): current and
+                # forecast load, sustainable per-replica throughput,
+                # headroom, the hysteresis-banded replica recommendation,
+                # and the autoscaler's bounded decision history
+                if cont_engine is None:
+                    self._send(404, {
+                        "error": "capacity reporting needs a continuous/"
+                        "paged engine (the window engine has no load "
+                        "forecaster)"
+                    })
+                    return
+                if isinstance(cont_engine, EngineFleet):
+                    report = cont_engine.capacity_report(
+                        horizon_s=(
+                            autoscaler.horizon_s if autoscaler else 60.0
+                        ),
+                        min_replicas=min_replicas,
+                        max_replicas=(
+                            autoscaler.max_replicas if autoscaler
+                            else replicas
+                        ),
+                    )
+                else:
+                    # single engine: same report shape, a fleet of one
+                    report = report_from_capacity_snapshots(
+                        [cont_engine.capacity_snapshot()], 1
+                    )
+                report["engine"] = cont_kind
+                report["autoscale"] = (
+                    autoscaler.mode if autoscaler else "off"
+                )
+                report["decisions"] = (
+                    autoscaler.decisions() if autoscaler else []
+                )
+                self._send(200, report)
             elif path == "/v1/lineage":
                 # train→serve lineage: which training run/step produced
                 # each resident weight generation, was its anomaly window
@@ -992,6 +1100,51 @@ def serve(
                     return
                 self._send(200, result)
                 return
+            if self.path == "/v1/fleet/scale":
+                # manual override: step the fleet to an absolute replica
+                # count (the autoscaler keeps adjusting afterwards unless
+                # started with --autoscale dry-run/off). Deliberately NOT
+                # behind the drain guard: an operator may shed replicas
+                # while in-flight work finishes.
+                if not isinstance(cont_engine, EngineFleet):
+                    self._send(404, {
+                        "error": "fleet scaling needs --replicas > 1 or "
+                                 "--max-replicas above --replicas",
+                    })
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    target = int(req["replicas"])
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {
+                        "error": "bad request: body must be a JSON object "
+                                 f"with an integer 'replicas' ({e})",
+                    })
+                    return
+                lo = min_replicas
+                hi = (
+                    autoscaler.max_replicas if autoscaler
+                    else max(replicas, max_replicas)
+                )
+                if not lo <= target <= hi:
+                    self._send(400, {
+                        "error": f"'replicas' must be within [{lo}, {hi}]"
+                                 f", got {target}",
+                    })
+                    return
+                try:
+                    while len(cont_engine.replicas) < target:
+                        cont_engine.add_replica()
+                    while len(cont_engine.replicas) > target:
+                        cont_engine.retire_replica(
+                            timeout_s=drain_timeout_s
+                        )
+                except (RuntimeError, ValueError) as e:
+                    self._send(409, {"error": str(e)})
+                    return
+                self._send(200, {"replicas": len(cont_engine.replicas)})
+                return
             if self.path != "/v1/generate":
                 self._send(404, {"error": "not found"})
                 return
@@ -1180,6 +1333,7 @@ def serve(
         control["window_engine"] = engine
         control["profiler"] = profiler_capture
         control["deploy"] = deploy_mgr
+        control["autoscaler"] = autoscaler
 
     print(f"Serving on {host}:{port}")
     try:
@@ -1188,6 +1342,8 @@ def serve(
         pass
     finally:
         httpd.server_close()
+        if autoscaler is not None:
+            autoscaler.stop()
         if deploy_mgr is not None:
             deploy_mgr.stop()
         if coordinator is not None:
@@ -1225,6 +1381,29 @@ def main(argv: Optional[list] = None) -> int:
         help="fleet placement policy (--replicas > 1): prefix = prompt-"
              "prefix cache affinity, ties least-loaded; least-loaded = "
              "smallest backlog per slot; round-robin = strict rotation",
+    )
+    parser.add_argument(
+        "--autoscale", choices=["dry-run", "on", "off"], default="dry-run",
+        help="elastic fleet control loop (observe/capacity.py): dry-run "
+             "(default) records every would-be scale decision on "
+             "GET /v1/capacity and the flight recorder WITHOUT acting; "
+             "on additionally adds/retires replicas within "
+             "--min-replicas/--max-replicas; off disables the loop",
+    )
+    parser.add_argument(
+        "--min-replicas", type=int, default=1, metavar="N",
+        help="autoscaler floor: never retire below N replicas",
+    )
+    parser.add_argument(
+        "--max-replicas", type=int, default=0, metavar="N",
+        help="autoscaler ceiling: never grow past N replicas. 0 = "
+             "--replicas (no elastic growth); a value above --replicas "
+             "builds a growable fleet even from --replicas 1",
+    )
+    parser.add_argument(
+        "--scale-cooldown-s", type=float, default=30.0,
+        help="autoscaler: seconds between APPLIED scale actions, so a "
+             "burst cannot ladder the fleet up faster than replicas warm",
     )
     parser.add_argument(
         "--slots", type=int, default=8,
@@ -1479,7 +1658,9 @@ def main(argv: Optional[list] = None) -> int:
           adapter_dir=args.adapter_dir, max_adapters=args.max_adapters,
           adapter_capacity=args.adapter_capacity,
           engine_kind=args.engine, replicas=args.replicas,
-          routing=args.routing, slots=args.slots,
+          routing=args.routing, autoscale=args.autoscale,
+          min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+          scale_cooldown_s=args.scale_cooldown_s, slots=args.slots,
           kv_buf_len=args.kv_buf_len, kv_block_len=args.kv_block_len,
           prefill_chunk=args.prefill_chunk,
           max_queue_depth=args.max_queue_depth,

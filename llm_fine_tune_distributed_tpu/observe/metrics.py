@@ -83,6 +83,11 @@ class ServingStats:
         # cancelled mid-decode by an expired client deadline (the
         # pre-prefill expiry stays in requests_shed_deadline)
         "preemptions", "requests_shed_deadline_decode",
+        # capacity observatory (observe/capacity.py): emitted tokens whose
+        # request settled successfully — the numerator of goodput_fraction.
+        # Tokens that were emitted but thrown away land in the
+        # reason-labelled waste map (``wasted_tokens_by_reason``) instead.
+        "goodput_tokens",
     )
     GAUGES = (
         "queue_depth", "live_slots", "engine_generation",
@@ -108,6 +113,21 @@ class ServingStats:
     # infer/batching.PRIORITY_TIERS (kept literal here so observe/ stays
     # import-independent of infer/).
     SHED_TIERS = ("interactive", "batch", "best_effort")
+    # reason-labelled wasted-token counters (``wasted_tokens_by_reason`` in
+    # the snapshot): decode work the device performed whose tokens never
+    # counted as goodput. Every reason is always present so the /v1/stats
+    # and /metrics schemas are identical with zero waste.
+    #   deadline  — cancelled mid-decode (or at prefill) by an expired
+    #               client deadline; the 504 carries the partial tokens
+    #   abandoned — the waiter gave up (client timeout/disconnect) after
+    #               tokens had been emitted, including preempted-then-
+    #               abandoned requests whose banked tokens died with them
+    #   failover  — tokens emitted on a replica that crashed/drained before
+    #               settle; the request re-ran elsewhere, so these are
+    #               duplicate work
+    #   shed      — tokens banked by a preempted request that was then shed
+    #               (displacement/overflow) instead of resumed
+    WASTE_REASONS = ("deadline", "abandoned", "failover", "shed")
     # the per-tenant record's exact key set (pinned by
     # tests/test_metrics_schema.py so the /v1/stats schema cannot drift)
     TENANT_KEYS = ("requests", "tokens", "queue_depth")
@@ -139,6 +159,9 @@ class ServingStats:
         # tier-labelled sheds (overflow + brownout + displacement), every
         # tier always present (schema stability with zero sheds)
         self._tier_shed: Dict[str, int] = {t: 0 for t in self.SHED_TIERS}
+        # reason-labelled wasted tokens, every reason always present
+        # (schema stability with zero waste)
+        self._waste: Dict[str, int] = {r: 0 for r in self.WASTE_REASONS}
         self.hist: Dict[str, Histogram] = {
             name: (
                 Histogram.linear(0.0, 16.0, 1.0)
@@ -201,6 +224,21 @@ class ServingStats:
             for tier, n in by_tier.items():
                 self._tier_shed[tier] = self._tier_shed.get(tier, 0) + int(n)
 
+    def waste_incr(self, reason: str, n: int) -> None:
+        """Charge ``n`` emitted-but-discarded tokens to one waste reason
+        (``WASTE_REASONS``) — the engine calls this from its single settle
+        point, so every emitted token lands in exactly one of
+        ``goodput_tokens`` or this map."""
+        with self._lock:
+            self._waste[reason] = self._waste.get(reason, 0) + n
+
+    def waste_merge(self, by_reason: Dict[str, int]) -> None:
+        """Fold another snapshot's ``wasted_tokens_by_reason`` map into
+        this one (fleet aggregation: replica waste counts sum)."""
+        with self._lock:
+            for reason, n in by_reason.items():
+                self._waste[reason] = self._waste.get(reason, 0) + int(n)
+
     def observe(self, name: str, value: float) -> None:
         """Record one histogram observation (histograms carry their own
         locks, so this does not contend with the counter lock)."""
@@ -260,6 +298,13 @@ class ServingStats:
                 tenant: dict(rec) for tenant, rec in self._tenants.items()
             }
             out["requests_shed_by_tier"] = dict(self._tier_shed)
+            out["wasted_tokens_by_reason"] = dict(self._waste)
+        wasted = sum(out["wasted_tokens_by_reason"].values())
+        emitted = out["goodput_tokens"] + wasted
+        # 1.0 at zero traffic: "no waste yet" is the healthy reading
+        out["goodput_fraction"] = (
+            out["goodput_tokens"] / emitted if emitted else 1.0
+        )
         out["uptime_s"] = now - self.started_at
         out["slots"] = self.slots
         out["slot_occupancy"] = (
@@ -415,6 +460,31 @@ def prometheus_exposition(
     lines.append(f"# TYPE {name} counter")
     for tier in sorted(by_tier):
         lines.append(f'{name}{{tier="{tier}"}} {int(by_tier[tier])}')
+    # capacity observatory: reason-labelled wasted-token counters and the
+    # fleet replica-count gauge. ``wasted_tokens_by_reason`` is a dict
+    # value (skipped by the numeric loop), emitted with a ``reason`` label
+    # — every known reason always has a sample (ServingStats seeds all
+    # reasons at 0), so the schema cannot drift with load. Gated on the
+    # key so trainer/window snapshots (no ServingStats) stay unchanged.
+    wasted = snap.get("wasted_tokens_by_reason")
+    if wasted is not None:
+        name = f"{prefix}_wasted_tokens_total"
+        lines.append(f"# TYPE {name} counter")
+        for reason in sorted(wasted):
+            lines.append(f'{name}{{reason="{reason}"}} {int(wasted[reason])}')
+            for label, rsnap, _ in replicas:
+                rw = rsnap.get("wasted_tokens_by_reason") or {}
+                if reason in rw:
+                    lines.append(
+                        f'{name}{{replica="{label}",reason="{reason}"}} '
+                        f"{int(rw[reason])}"
+                    )
+        # elastic fleet: current replica count as its own gauge (a single
+        # engine is a fleet of one); distinct from the fleet-only
+        # ``serving_replicas`` so the series exists at every scale
+        name = f"{prefix}_replica_count"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {int(snap.get('replicas', 1))}")
     # compile-ledger samples: ``compile`` is a nested dict (skipped by the
     # numeric loop), so per-program compile counts/seconds are emitted
     # explicitly with a ``program`` label. TYPE lines are UNCONDITIONAL so

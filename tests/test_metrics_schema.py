@@ -64,6 +64,10 @@ SNAPSHOT_KEYS = {
     "preemptions", "requests_shed_deadline_decode",
     # overload control: tier name -> requests shed from that tier
     "requests_shed_by_tier",
+    # capacity observatory (observe/capacity.py): tokens that reached a
+    # successful settle, the reason-keyed waste map for the rest, and the
+    # derived goodput/(goodput+waste) ratio
+    "goodput_tokens", "wasted_tokens_by_reason", "goodput_fraction",
     # gauges
     "queue_depth", "live_slots", "engine_generation", "weight_generation",
     # overload control: the brownout controller's current stage (0-3)
@@ -140,6 +144,12 @@ EXPECTED_METRICS = {
     ("serving_preemptions_total", "counter"),
     ("serving_requests_shed_deadline_decode_total", "counter"),
     ("serving_requests_shed_tier_total", "counter"),
+    # capacity observatory: goodput vs reason-labelled waste split and the
+    # replica-count gauge (1 for a single engine — a fleet of one)
+    ("serving_goodput_tokens_total", "counter"),
+    ("serving_wasted_tokens_total", "counter"),
+    ("serving_goodput_fraction", "gauge"),
+    ("serving_replica_count", "gauge"),
     # per-tenant series (tenant="name" labels; TYPE lines are emitted even
     # with zero tenants so the schema is load-independent)
     ("serving_tenant_requests_total", "counter"),
@@ -259,6 +269,13 @@ def test_metrics_exposition_well_formed():
     assert 'serving_requests_shed_tier_total{tier="batch"} 0' in text
     assert 'serving_requests_shed_tier_total{tier="best_effort"} 0' in text
     assert "serving_brownout_stage 0" in text
+    # capacity observatory: every waste reason has a sample even with zero
+    # waste, goodput reads 1.0 at zero traffic ("no waste yet" is the
+    # healthy reading), and a single engine is a fleet of one
+    for reason in ServingStats.WASTE_REASONS:
+        assert f'serving_wasted_tokens_total{{reason="{reason}"}} 0' in text
+    assert "serving_goodput_fraction 1" in text
+    assert "serving_replica_count 1" in text
 
 
 # The fleet /v1/stats contract: everything a single paged engine reports,
@@ -266,6 +283,9 @@ def test_metrics_exposition_well_formed():
 FLEET_EXTRA_KEYS = {
     "replicas", "routing", "healthy_replicas", "available_replicas",
     "per_replica",
+    # elastic fleet: replicas retired so far (their final counters live on
+    # in the aggregate via the retired accumulator)
+    "replicas_retired",
     # router counters (EngineFleet.ROUTER_COUNTERS == metrics.FLEET_COUNTERS)
     "requests_routed_prefix_affinity", "requests_routed_adapter_affinity",
     "requests_routed_least_loaded",
@@ -281,6 +301,7 @@ FLEET_EXTRA_KEYS = {
 FLEET_EXPECTED_METRICS = EXPECTED_METRICS | {
     ("serving_replica_info", "gauge"),
     ("serving_replicas", "gauge"),
+    ("serving_replicas_retired", "gauge"),
     ("serving_healthy_replicas", "gauge"),
     ("serving_available_replicas", "gauge"),
     ("serving_requests_routed_prefix_affinity_total", "counter"),

@@ -477,12 +477,19 @@ def test_fleet_sheds_best_effort_fleet_wide_when_all_browned_out():
 
 
 def test_fleet_deadline_caps_failover_budget():
-    """``deadline_ms`` bounds the WHOLE fleet attempt: the dispatch timeout
-    shrinks to the deadline, and the replica receives the remaining budget
-    (so failover hops cannot stack full timeouts past the client's SLO)."""
+    """``deadline_s`` bounds the WHOLE fleet attempt: the dispatch timeout
+    shrinks to the deadline plus a fixed grace, and the replica receives the
+    remaining budget (so failover hops cannot stack full timeouts past the
+    client's SLO). The grace keeps the fleet-side wait a hang backstop: the
+    replica's own deadline machinery must win the race at the deadline and
+    surface DeadlineExceededError, never a bare stream-starved timeout."""
+    from llm_fine_tune_distributed_tpu.infer.fleet import (
+        DEADLINE_TIMEOUT_GRACE_S,
+    )
+
     rep = _FakeReplica(0)
     fleet = EngineFleet([rep], routing="round-robin")
     fleet.submit([7], GREEDY4, priority="batch", deadline_s=5.0, timeout=600.0)
     assert rep.seen_kwargs["priority"] == "batch"
-    assert rep.seen_kwargs["timeout"] <= 5.0
+    assert 5.0 < rep.seen_kwargs["timeout"] <= 5.0 + DEADLINE_TIMEOUT_GRACE_S
     assert 0 < rep.seen_kwargs["deadline_s"] <= 5.0

@@ -8,15 +8,17 @@ from llm_fine_tune_distributed_tpu.observe.throughput import ThroughputMeter
 
 def test_steady_state_excludes_compile_pause():
     m = ThroughputMeter(2, tokens_per_sample=10)
-    time.sleep(0.3)  # "compile" before the first step lands
+    time.sleep(0.9)  # "compile" before the first step lands
     m.update(4)
     for _ in range(5):
         time.sleep(0.02)
         m.update(4)
     s = m.snapshot()
     assert "samples_per_second_per_chip_steady" in s
-    # cumulative is dragged down by the 0.3s pause; steady is not
-    assert s["samples_per_second_per_chip_steady"] > 2 * s["samples_per_second_per_chip"]
+    # cumulative is dragged down by the 0.9s pause; steady (a median of
+    # per-interval rates) is not. The margin tolerates the 0.02s sleeps
+    # stretching ~10x on a loaded single-core box.
+    assert s["samples_per_second_per_chip_steady"] > 1.5 * s["samples_per_second_per_chip"]
     assert s["samples_per_second_per_chip"] > 0
     assert s["tokens_per_second_per_chip"] > 0
 
