@@ -61,7 +61,15 @@ SERVE_FLEET_CLIENTS=8), SERVE_TENANTS=4 (multi-tenant arm tenant count; 0
 disables; SERVE_TENANT_REQS=8 requests per tenant), SERVE_COMPILES=1
 (zero-recompile assertion arm: warm the full spec+adapters+paged workload,
 mark the compile ledger warm, re-run it, exit nonzero on ANY post-warmup
-recompile), SERVE_HOTSWAP=1 (hot-swap arm: publish a perturbed checkpoint
+recompile; with >= 2 devices the arm re-runs the speculative paged
+workload on a tp=2 mesh engine and gates its ledger too),
+SERVE_SHARDED=1 (sharded arm: the same all-greedy workload on a tp=1 and
+a tp=SERVE_SHARDED_TP=4 paged engine at equal slots, served twice around
+a weight hot-swap; exits nonzero unless the sharded outputs bit-match
+tp=1 on both passes with zero drops and zero post-warmup recompiles —
+skips with a null metric below SERVE_SHARDED_TP devices, so on CPU run
+under XLA_FLAGS=--xla_force_host_platform_device_count=8),
+SERVE_HOTSWAP=1 (hot-swap arm: publish a perturbed checkpoint
 while SERVE_HOTSWAP_CLIENTS=16 clients hammer a paged engine, deploy it
 mid-run via HotSwapManager, exit nonzero on any dropped request or any
 post-warmup recompile; SERVE_HOTSWAP_REQS_PER_CLIENT=4), SERVE_OVERLOAD=1
@@ -1011,12 +1019,44 @@ def main():
         _compile_pass()  # steady state: must not compile anything new
         comp = paged_spec.stats_snapshot()["compile"]
         shutil.rmtree(adapter_root, ignore_errors=True)
-        ok = comp["recompiles_after_warmup"] == 0
+
+        # sharded pass: the SAME speculative paged workload on a tp=2 mesh
+        # engine (own Generator, own ledger). Mesh placement must reach a
+        # sharding fixed point at the first compile — a tick whose operand
+        # shardings drift re-specializes every program, which this catches.
+        sharded_recompiles = None
+        if jax.device_count() >= 2:
+            from llm_fine_tune_distributed_tpu.infer.generate import (
+                make_tp_mesh,
+            )
+
+            sh_gen = Generator(
+                params, mc, ByteChatMLTokenizer(),
+                mesh=make_tp_mesh(2, mc), compute_dtype=dtype,
+                eos_token_ids=[],
+            )
+            sh_engine = PagedContinuousBatchingEngine(
+                sh_gen, slots=4, buf_len=256, prompt_bucket=32, block_len=32,
+                prefill_chunk=64, speculative_k=spec_k,
+            )
+            for prompt, gen, seed in paged_load:
+                sh_engine.submit(prompt, gen, seed=seed, timeout=600)
+            sh_engine.mark_compile_warm()
+            for prompt, gen, seed in paged_load:
+                sh_engine.submit(prompt, gen, seed=seed, timeout=600)
+            sharded_recompiles = sh_engine.stats_snapshot()["compile"][
+                "recompiles_after_warmup"
+            ]
+
+        ok = comp["recompiles_after_warmup"] == 0 and not sharded_recompiles
         print(json.dumps({
             "metric": "serve_zero_recompile_guard",
             "value": 1 if ok else 0,
-            "unit": "1 = no post-warmup recompiles (spec+adapters+paged)",
+            "unit": "1 = no post-warmup recompiles "
+                    "(spec+adapters+paged, plus tp=2 sharded pass)",
             "recompiles_after_warmup": comp["recompiles_after_warmup"],
+            "sharded_recompiles_after_warmup": sharded_recompiles,
+            "sharded_devices": jax.device_count(),
             "compiles_total": comp["total_compiles"],
             "compile_seconds_total": comp["total_compile_s"],
             "programs": sorted(comp["programs"]),
@@ -1025,6 +1065,127 @@ def main():
         }), flush=True)
         if not ok:
             sys.exit(1)
+
+    # sharded arm: the SAME all-greedy workload on a mesh=None paged engine
+    # (tp=1) and a tp=SERVE_SHARDED_TP mesh engine at EQUAL slots, served
+    # twice with a weight hot-swap between the passes. Three gates, each a
+    # correctness statement about mesh sharding: greedy outputs bit-match
+    # tp=1 on both passes (GSPMD partitioning must be numerically inert),
+    # zero dropped requests, and zero post-warmup recompiles on the sharded
+    # engine ACROSS the swap (re-placement over the resident NamedSharding,
+    # never a fresh device_put that would change operand shardings). Skips
+    # with a null metric when the process has fewer devices than tp — force
+    # devices on CPU via XLA_FLAGS=--xla_force_host_platform_device_count=8.
+    if os.environ.get("SERVE_SHARDED", "1") == "1":
+        from llm_fine_tune_distributed_tpu.infer.generate import make_tp_mesh
+        from llm_fine_tune_distributed_tpu.infer.sampling import (
+            GenerationConfig,
+        )
+        from llm_fine_tune_distributed_tpu.utils.tree import flatten_dict
+
+        sh_tp = int(os.environ.get("SERVE_SHARDED_TP", "4"))
+        if jax.device_count() < sh_tp:
+            print(json.dumps({
+                "metric": "serve_sharded_parity_guard",
+                "value": None,
+                "unit": "1 = tp greedy parity + zero drops + zero "
+                        "recompiles across hot-swap",
+                "skipped": (
+                    f"needs {sh_tp} devices, have {jax.device_count()}"
+                ),
+            }), flush=True)
+        else:
+            sh_rng = np.random.RandomState(11)
+            sh_load = []
+            for i in range(12):
+                plen = int(sh_rng.choice([6, 20, 40]))
+                prompt = sh_rng.randint(
+                    0, min(mc.vocab_size, 256), (plen,)
+                ).tolist()
+                gen = GenerationConfig(max_new_tokens=16, do_sample=False)
+                sh_load.append((prompt, gen, i))
+
+            def _sh_serve(eng, drops):
+                out, t0 = [], time.perf_counter()
+                for prompt, gen, seed in sh_load:
+                    try:
+                        out.append(
+                            eng.submit_full(
+                                prompt, gen, seed=seed, timeout=600
+                            ).result
+                        )
+                    except Exception:
+                        out.append(None)
+                        drops.append(seed)
+                return out, time.perf_counter() - t0
+
+            def _sh_engine(mesh):
+                g = Generator(
+                    params, mc, ByteChatMLTokenizer(), mesh=mesh,
+                    compute_dtype=dtype, eos_token_ids=[],
+                )
+                return PagedContinuousBatchingEngine(
+                    g, slots=4, buf_len=256, prompt_bucket=32, block_len=32,
+                    prefill_chunk=64,
+                )
+
+            base_eng = _sh_engine(None)
+            tp_eng = _sh_engine(make_tp_mesh(sh_tp, mc))
+            sh_drops = []
+            ref1, base_dt = _sh_serve(base_eng, sh_drops)
+            got1, tp_dt = _sh_serve(tp_eng, sh_drops)
+            tp_eng.mark_compile_warm()
+            sh_recompiles0 = tp_eng.compile_ledger.recompiles_after_warmup
+
+            flat = flatten_dict(params)
+            swap_key = sorted(
+                k for k in flat if k.endswith("kernel")
+            )[0]
+            swap = {swap_key: np.asarray(flat[swap_key], np.float32) + 1e-3}
+            for eng in (base_eng, tp_eng):
+                eng.request_weight_swap(
+                    swap, fingerprint="sharded-arm", timeout=600
+                )
+            ref2, _ = _sh_serve(base_eng, sh_drops)
+            got2, _ = _sh_serve(tp_eng, sh_drops)
+            sh_recompiles = (
+                tp_eng.compile_ledger.recompiles_after_warmup
+                - sh_recompiles0
+            )
+            sh_tokens = sum(len(r) for r in got1 + got2 if r)
+            parity_pre = got1 == ref1 and None not in ref1
+            parity_post = got2 == ref2 and None not in ref2
+            ok = (
+                parity_pre and parity_post
+                and not sh_drops and sh_recompiles == 0
+            )
+            print(json.dumps({
+                "metric": "serve_sharded_parity_guard",
+                "value": 1 if ok else 0,
+                "unit": "1 = tp greedy parity + zero drops + zero "
+                        "recompiles across hot-swap",
+                "tp": sh_tp,
+                "devices": jax.device_count(),
+                "slots": 4,
+                "requests": 4 * len(sh_load),
+                "parity_pre_swap": parity_pre,
+                "parity_post_swap": parity_post,
+                "requests_dropped": len(sh_drops),
+                "recompiles_after_warmup": sh_recompiles,
+                "tokens_served_tp": sh_tokens,
+                "tokens_per_sec_tp": (
+                    round(sum(len(r) for r in got1 if r) / tp_dt, 2)
+                    if tp_dt > 0 else 0.0
+                ),
+                "tokens_per_sec_tp1": (
+                    round(sum(len(r) for r in ref1 if r) / base_dt, 2)
+                    if base_dt > 0 else 0.0
+                ),
+                "model": preset,
+                "platform": jax.devices()[0].platform,
+            }), flush=True)
+            if not ok:
+                sys.exit(1)
 
     # hot-swap arm: a perturbed checkpoint publishes while clients hammer a
     # paged engine, and HotSwapManager deploys it mid-run. The acceptance

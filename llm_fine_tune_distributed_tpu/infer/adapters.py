@@ -89,6 +89,7 @@ class AdapterRegistry:
         max_adapters: int = 8,
         rank: Optional[int] = None,
         stats=None,
+        mesh=None,
     ):
         if max_adapters < 2:
             raise ValueError(
@@ -98,6 +99,13 @@ class AdapterRegistry:
         self.adapter_dir = adapter_dir
         self.max_adapters = int(max_adapters)
         self.stats = stats
+        self.mesh = mesh
+        # sharded-engine hook: the engine points this at SlotBridge.
+        # adapter_write so every pool-slot mutation (load, eviction rewrite,
+        # startup rebuild) is announced to follower processes BEFORE the
+        # device write — all processes then run the identical .at[slot].set
+        # over their shards of the global pool leaves
+        self.on_write = None
         self._lock = threading.RLock()
         self._names: List[Optional[str]] = [None] * self.max_adapters
         self._idx: Dict[str, int] = {}
@@ -145,14 +153,16 @@ class AdapterRegistry:
                 if name in POOL_TARGET_MODULES and getattr(kernel, "ndim", 0) == 2:
                     d_in, d_out = kernel.shape
                     out = dict(node)
-                    out["lora_a_pool"] = jnp.zeros(
-                        (self.max_adapters, d_in, self.rank), jnp.float32
+                    out["lora_a_pool"] = self._alloc_pool(
+                        prefix + ("lora_a_pool",),
+                        (self.max_adapters, d_in, self.rank),
                     )
-                    out["lora_b_pool"] = jnp.zeros(
-                        (self.max_adapters, self.rank, d_out), jnp.float32
+                    out["lora_b_pool"] = self._alloc_pool(
+                        prefix + ("lora_b_pool",),
+                        (self.max_adapters, self.rank, d_out),
                     )
-                    out["lora_scale_pool"] = jnp.zeros(
-                        (self.max_adapters,), jnp.float32
+                    out["lora_scale_pool"] = self._alloc_pool(
+                        prefix + ("lora_scale_pool",), (self.max_adapters,)
                     )
                     self._sites[tuple(prefix)] = out
                     return out
@@ -160,6 +170,31 @@ class AdapterRegistry:
             return {k: walk(v, prefix + (k,)) for k, v in node.items()}
 
         return walk(base_params, ())
+
+    def _alloc_pool(self, path: tuple, shape: tuple):
+        """One zero-initialized f32 pool leaf, placed under the mesh's
+        partition rules (parallel/sharding.py carries lora_*_pool entries)
+        when the registry serves a sharded engine — so gathers from the
+        pools compose with sharded activations without resharding."""
+        if self.mesh is None:
+            return jnp.zeros(shape, jnp.float32)
+        import jax
+        from jax.sharding import NamedSharding
+
+        from llm_fine_tune_distributed_tpu.parallel.sharding import (
+            _validate_spec,
+            global_array_from_host,
+            mesh_fully_addressable,
+            param_spec,
+        )
+
+        spec = _validate_spec(
+            param_spec("/".join(path), len(shape)), shape, self.mesh
+        )
+        sharding = NamedSharding(self.mesh, spec)
+        if mesh_fully_addressable(self.mesh):
+            return jax.device_put(jnp.zeros(shape, jnp.float32), sharding)
+        return global_array_from_host(np.zeros(shape, np.float32), sharding)
 
     # ---------------------------------------------------------------- surface
 
@@ -319,7 +354,22 @@ class AdapterRegistry:
             out[pth] = (a, b)
         return out
 
-    def _write_slot(self, slot: int, padded: dict, scale: float) -> None:
+    def apply_remote_write(self, slot: int, padded: dict, scale: float) -> None:
+        """Follower half of the sharded pool-write protocol: apply a pool
+        slot write announced by process 0 over the slot bridge
+        (``infer/multihost.follow_slots``). The factors arrived via the
+        broadcast, so the write is the identical functional update every
+        other process runs — no disk or name bookkeeping follower-side."""
+        with self._lock:
+            self._write_slot(slot, padded, scale, announce=False)
+
+    def _write_slot(
+        self, slot: int, padded: dict, scale: float, announce: bool = True
+    ) -> None:
+        if announce and self.on_write is not None:
+            # broadcast first: followers must receive the factors before
+            # any process dispatches the pool update
+            self.on_write(slot, padded, scale)
         for pth, site in self._sites.items():
             a, b = padded[pth]
             site["lora_a_pool"] = site["lora_a_pool"].at[slot].set(

@@ -535,14 +535,24 @@ class HotSwapManager:
     def _capture(self, weights: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Host copies of the values currently resident at ``weights``'s
         paths (read off replica 0 — a completed rolling swap leaves every
-        replica on the same generation, so any replica would do)."""
+        replica on the same generation, so any replica would do). Leaves on
+        a process-spanning mesh are not host-readable via ``np.asarray``;
+        ``process_allgather`` assembles the full value from every process's
+        shards, so rollback buffers work under the sharded slot engines."""
         params = self.engines[0]._params
         out = {}
         for key in weights:
             node = params
             for part in key.split("/"):
                 node = node[part]
-            out[key] = np.asarray(node)
+            if not getattr(node, "is_fully_addressable", True):
+                from jax.experimental import multihost_utils
+
+                out[key] = np.asarray(
+                    multihost_utils.process_allgather(node, tiled=True)
+                )
+            else:
+                out[key] = np.asarray(node)
         return out
 
     # ------------------------------------------------------ auto-rollback

@@ -217,11 +217,25 @@ def _cow_swap_tree(params, updates):
                         f"{tuple(np.shape(arr))} — a hot-swap may change "
                         "values, never shapes"
                     )
-                new = jnp.asarray(np.asarray(arr)).astype(old.dtype)
+                host = np.asarray(arr)
                 sharding = getattr(old, "sharding", None)
-                out[head] = (
-                    jax.device_put(new, sharding) if sharding is not None else new
-                )
+                if sharding is None:
+                    out[head] = jnp.asarray(host).astype(old.dtype)
+                elif getattr(old, "is_fully_addressable", True):
+                    out[head] = jax.device_put(
+                        jnp.asarray(host).astype(old.dtype), sharding
+                    )
+                else:
+                    # resident leaf spans processes: device_put cannot target
+                    # remote devices, so re-place over the resident sharding
+                    # by contributing this process's shards of the host copy
+                    from llm_fine_tune_distributed_tpu.parallel.sharding import (
+                        global_array_from_host,
+                    )
+
+                    out[head] = global_array_from_host(
+                        host.astype(old.dtype), sharding
+                    )
             else:
                 out[head] = rec(out[head], group, prefix + (head,))
         return out
@@ -370,13 +384,25 @@ class ContinuousBatchingEngine:
         slo_ring_capacity: int = 512,
         slo_generations_kept: int = 8,
         trace_log_max_mb: float = 0.0,
+        bridge=None,
     ):
-        if getattr(generator, "_multihost", False):
+        if getattr(generator, "_multihost", False) and bridge is None:
             raise ValueError(
-                "the continuous engine is single-host only (per-step host "
-                "scheduling would need a broadcast per token); use the "
-                "window BatchingEngine behind a MultihostCoordinator"
+                "process-spanning generator without a slot bridge: the "
+                "continuous/paged engines serve a multi-host --tp mesh only "
+                "behind the sharded-engine tick protocol — pass "
+                "bridge=SlotBridge() here (the server wires this "
+                "automatically for --tp > local devices with --engine "
+                "continuous|paged; followers run "
+                "infer.multihost.follow_slots)"
             )
+        # sharded slot engines (infer/multihost.py): with a bridge attached,
+        # every host decision that leads to a device dispatch is broadcast
+        # as a fixed-shape control header first, so follower processes enter
+        # the identical fused program in the identical order. None on
+        # single-process meshes — sharded dispatch needs no coordination
+        # when one controller owns every device.
+        self._bridge = bridge
         self._generator = generator
         # multi-tenant LoRA serving (infer/adapters.py): with a registry
         # attached every jitted program runs over its POOLED params view
@@ -385,6 +411,12 @@ class ContinuousBatchingEngine:
         # tenants co-batch in the same dispatch. adapter_quota bounds each
         # tenant's concurrently-admitted requests (0 = unbounded).
         self._mt = adapters
+        if bridge is not None and adapters is not None:
+            # pool writes (loads, evictions, startup rebuilds) must land on
+            # every process's shard of the global pool leaves: the registry
+            # announces each write's host factors over the bridge before
+            # touching device state (followers apply the same write)
+            adapters.on_write = bridge.adapter_write
         self._params = (
             adapters.params
             if adapters is not None
@@ -1516,6 +1548,16 @@ class ContinuousBatchingEngine:
         gen = self._generator
         # ledger entries compiled from here on attribute to this incarnation
         self.compile_ledger.current_generation = self.supervisor.generation
+        if self._bridge is not None:
+            # followers allocate the identical sharded mirror before process 0
+            # touches any collective allocation
+            self._bridge.startup(
+                kind=0,
+                slots=self._slots,
+                buf_len=self._buf_len,
+                spec_k=self._spec_k,
+                use_draft=self._use_draft,
+            )
         self._cache, self._state = gen.init_slot_state(self._slots, self._buf_len)
         if self._mt is not None:
             # restore every resident adapter into the pooled view, so
@@ -1582,6 +1624,11 @@ class ContinuousBatchingEngine:
         assert swap is not None
         t0 = time.monotonic()
         try:
+            if self._bridge is not None:
+                # broadcast the RAW updates: requantize + copy-on-write graft
+                # are deterministic, so every process rebuilds the identical
+                # tree from the same bytes (no shared filesystem needed)
+                self._bridge.swap(swap.updates)
             updates = _requantize_updates(self._params, swap.updates)
             new_params, updated = _cow_swap_tree(self._params, updates)
             self._params = new_params
@@ -1835,6 +1882,14 @@ class ContinuousBatchingEngine:
         knobs = self._knob_arrays(req)
         import jax
 
+        mirror_draft = self._use_draft and req.gen.speculative_lookup > 0
+        if self._bridge is not None:
+            # announce before entering the collective: followers must join
+            # the same fused prefill or process 0 deadlocks inside it
+            self._bridge.prefill(
+                bucket, plen, slot, req.seed, knobs, padded,
+                draft_padded=padded if mirror_draft else None,
+            )
         with annotate("prefill"):
             self._cache, self._state, first = prefill(
                 self._params, self._cache, self._state, padded, np.int32(plen),
@@ -1847,7 +1902,7 @@ class ContinuousBatchingEngine:
             req.trace.mark("prefill", self._now)
         if self._watchdog is not None:
             self._watchdog.poke(self._decode_index)
-        if self._use_draft and req.gen.speculative_lookup > 0:
+        if mirror_draft:
             # mirror the prompt into the draft model's dense row so its
             # first drafting tick sees the same context as the target
             dprefill = gen.draft_slot_prefill(bucket)
@@ -1930,9 +1985,12 @@ class ContinuousBatchingEngine:
         t0 = time.monotonic()
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
+        live = self._live.copy()
+        if self._bridge is not None:
+            self._bridge.step(live)
         with annotate("sample"):
             self._cache, self._state, toks = step(
-                self._params, self._cache, self._state, self._live.copy()
+                self._params, self._cache, self._state, live
             )
             toks = np.asarray(toks)  # the host sync a wedged link would hang
         self._tick_done(t0)
@@ -2000,6 +2058,10 @@ class ContinuousBatchingEngine:
             if n_draft.any():
                 gen = self._generator
                 dstep = gen.draft_slot_step(self._slots, k)
+                if self._bridge is not None:
+                    # the draft model's fused step is its own collective,
+                    # dispatched before the verify step — announce separately
+                    self._bridge.draft_step(window, start)
                 with annotate("draft"):
                     self._dcache, dbuf = dstep(
                         gen.draft_params, self._dcache, self._state, window,
@@ -2026,9 +2088,14 @@ class ContinuousBatchingEngine:
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
         drafts, n_draft = self._propose_drafts()
+        live = self._live.copy()
+        if self._bridge is not None:
+            # drafts/n_draft ride the broadcast as authoritative operands:
+            # followers discard whatever their mirrored draft step produced
+            self._bridge.spec_step(live, drafts, n_draft)
         with annotate("verify"):
             self._cache, self._state, toks, n_emit = step(
-                self._params, self._cache, self._state, self._live.copy(),
+                self._params, self._cache, self._state, live,
                 drafts, n_draft,
             )
             toks = np.asarray(toks)  # the host sync a wedged link would hang
@@ -2266,6 +2333,18 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._table[:, :] = NULL_BLOCK
         self._slot_blocks = [[] for _ in range(self._slots)]
         self._slot_plen = [0] * self._slots
+        if self._bridge is not None:
+            self._bridge.startup(
+                kind=1,
+                slots=self._slots,
+                buf_len=self._buf_len,
+                spec_k=self._spec_k,
+                num_blocks=self._num_blocks,
+                block_len=self._block_len,
+                table_blocks=self._table_blocks,
+                kv_quant_int8=self._kv_quant != "none",
+                use_draft=self._use_draft,
+            )
         if self._kv_quant != "none":
             self._cache, self._state = gen.init_paged_state(
                 self._slots, self._num_blocks, self._block_len,
@@ -2535,6 +2614,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             chunk = np.asarray(
                 prompt[task.next : task.next + C], np.int32
             )[None, :]
+            if self._bridge is not None:
+                self._bridge.paged_chunk(
+                    table, chunk, task.next, req.adapter_idx
+                )
             with annotate("prefill"):
                 self._cache = ingest(
                     self._params, self._cache, table, chunk,
@@ -2562,6 +2645,20 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         padded[0, :remaining] = prompt[task.next :]
         seen_row = np.zeros((1, gen.config.vocab_size), bool)
         seen_row[0, np.asarray(prompt, np.intp)] = True
+        mirror_draft = self._use_draft and req.gen.speculative_lookup > 0
+        dpad = None
+        if mirror_draft:
+            dbucket = min(
+                -(-task.plen // self._bucket) * self._bucket, self._buf_len
+            )
+            dpad = np.zeros((1, dbucket), np.int32)
+            dpad[0, : task.plen] = prompt
+        if self._bridge is not None:
+            self._bridge.paged_final(
+                bucket, task.next, task.plen, task.slot, req.seed,
+                self._knob_arrays(req), table, padded, seen_row,
+                draft_padded=dpad,
+            )
         with annotate("prefill"):
             self._cache, self._state, first = final(
                 self._params, self._cache, self._state, table, padded,
@@ -2578,16 +2675,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             req.trace.mark("prefill", self._now)
         if self._watchdog is not None:
             self._watchdog.poke(self._decode_index)
-        if self._use_draft and req.gen.speculative_lookup > 0:
+        if mirror_draft:
             # the draft model keeps a DENSE per-slot cache even under the
             # paged target engine (it is small by construction); mirror the
             # whole prompt into its row now that the prompt is fully known
-            dbucket = min(
-                -(-task.plen // self._bucket) * self._bucket, self._buf_len
-            )
-            dpad = np.zeros((1, dbucket), np.int32)
-            dpad[0, : task.plen] = prompt
-            dprefill = gen.draft_slot_prefill(dbucket)
+            dprefill = gen.draft_slot_prefill(dpad.shape[1])
             self._dcache = dprefill(
                 gen.draft_params, self._dcache, dpad, np.int32(task.slot)
             )
@@ -2631,9 +2723,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         t0 = time.monotonic()
         self._decode_index += 1
         self.faults.maybe_fail_decode(self._decode_index)
+        live = self._live.copy()
+        if self._bridge is not None:
+            self._bridge.paged_step(live, tables)
         with annotate("sample"):
             self._cache, self._state, toks = step(
-                self._params, self._cache, self._state, self._live.copy(),
+                self._params, self._cache, self._state, live,
                 tables,
             )
             toks = np.asarray(toks)
@@ -2665,9 +2760,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.faults.maybe_fail_decode(self._decode_index)
         drafts, n_draft = self._propose_drafts()
         step = gen.spec_paged_step(self._slots, nb, self._block_len, self._spec_k)
+        live = self._live.copy()
+        if self._bridge is not None:
+            self._bridge.spec_paged_step(live, tables, drafts, n_draft)
         with annotate("verify"):
             self._cache, self._state, toks, n_emit = step(
-                self._params, self._cache, self._state, self._live.copy(),
+                self._params, self._cache, self._state, live,
                 tables, drafts, n_draft,
             )
             toks = np.asarray(toks)

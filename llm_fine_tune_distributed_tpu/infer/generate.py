@@ -65,14 +65,22 @@ def _prompt_prefill(params, prompt_ids, prompt_lens, *, mc, dtype, act, mesh,
     return first, cache, seen, valid, rng
 
 
-def make_tp_mesh(tp: int):
+def make_tp_mesh(tp: int, model_config: Optional[ModelConfig] = None):
     """Tensor-parallel inference mesh over the first ``tp`` devices of the
     GLOBAL pool (the `--tp` flag of ask_tuned_model.py / smollm3-serve).
 
     Under ``jax.distributed`` the pool spans processes, so ``tp`` may exceed
     the local device count — a llama3_70b int8 (~70 GB) becomes servable on
     a 2-host v5e-8 with ``--tp 8``. The Generator detects the
-    process-spanning mesh and switches to global-array placement/inputs."""
+    process-spanning mesh and switches to global-array placement/inputs.
+
+    With ``model_config`` the KV-head geometry is validated UP FRONT instead
+    of failing deep inside ``shard_params`` with a bare shape error: when
+    ``tp`` does not divide ``num_kv_heads`` (GQA presets with few KV heads)
+    the KV cache falls back to head REPLICATION — correct but each chip
+    holds the full cache — and a warning says so at mesh build time."""
+    import warnings
+
     import jax as _jax
 
     from llm_fine_tune_distributed_tpu.config import MeshConfig
@@ -84,6 +92,16 @@ def make_tp_mesh(tp: int):
             f"across {_jax.process_count()} process(es); start more hosts "
             "under jax.distributed (MASTER_ADDR/PORT, WORLD_SIZE/RANK)"
         )
+    if model_config is not None and tp > 1:
+        if model_config.num_kv_heads % tp != 0:
+            warnings.warn(
+                f"--tp {tp} does not divide num_kv_heads="
+                f"{model_config.num_kv_heads}: KV-cache leaves fall back to "
+                "head replication (every chip holds the full cache; weights "
+                f"still shard {tp}-way). For a sharded cache pick a tp that "
+                f"divides {model_config.num_kv_heads}.",
+                stacklevel=2,
+            )
     return make_mesh(MeshConfig(data=1, fsdp=1, tensor=tp, seq=1, expert=1, pipe=1))
 
 
@@ -588,10 +606,58 @@ class Generator:
             "adapter_idx": jnp.zeros((slots,), jnp.int32),
         }
 
+    def _place_replicated(self, tree):
+        """Mesh placement for per-slot host-visible state: every leaf lives
+        replicated on the mesh (they are small and read host-side every
+        tick). No-op without a mesh."""
+        if self.mesh is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from llm_fine_tune_distributed_tpu.parallel.sharding import place_tree
+
+        rep = NamedSharding(self.mesh, P())
+        return place_tree(tree, jax.tree.map(lambda _: rep, tree))
+
+    def _pin_kv(self, tree):
+        """Traced: constrain a cache/pool pytree to the resident KV
+        shardings (kv-head dim over ``tensor``), so every program's output
+        cache layout equals its input layout — the threaded buffers sit at a
+        sharding fixed point from the first compile, which is what makes the
+        sharded engines zero-recompile after warmup. Identity without a
+        mesh."""
+        if self.mesh is None:
+            return tree
+        from llm_fine_tune_distributed_tpu.parallel.sharding import (
+            kv_cache_shardings,
+        )
+
+        return jax.lax.with_sharding_constraint(
+            tree, kv_cache_shardings(tree, self.mesh)
+        )
+
+    def _pin_state(self, state):
+        """Traced: constrain the per-slot state dict replicated (its leaves
+        are host-read every tick). Identity without a mesh."""
+        if self.mesh is None:
+            return state
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, rep), state
+        )
+
     def init_slot_state(self, slots: int, buf_len: int):
-        """Fresh (cache, state) for a ``slots``-wide persistent decode."""
-        cache = init_cache(self.config, slots, buf_len, dtype=self.compute_dtype)
-        return cache, self._fresh_slot_state(slots)
+        """Fresh (cache, state) for a ``slots``-wide persistent decode.
+        Under a mesh both land sharded/placed (cache: kv-head dim over
+        ``tensor``; state: replicated) so the engines' first dispatch
+        already sees the steady-state layout."""
+        cache = init_cache(
+            self.config, slots, buf_len, dtype=self.compute_dtype,
+            mesh=self.mesh,
+        )
+        return cache, self._place_replicated(self._fresh_slot_state(slots))
 
     def _instrument(self, key, fn, aot: bool = True):
         """Ledger-wrap a freshly built program: ``key`` is the jit-cache
@@ -663,7 +729,7 @@ class Generator:
                 seen=seen,
                 rng=jnp.where(live[:, None], split[:, 0], state["rng"]),
             )
-            return cache, new_state, tok
+            return self._pin_kv(cache), self._pin_state(new_state), tok
 
         return step
 
@@ -721,7 +787,7 @@ class Generator:
                 do_sample=state["do_sample"].at[slot].set(knobs["do_sample"]),
                 adapter_idx=state["adapter_idx"].at[slot].set(knobs["adapter_idx"]),
             )
-            return cache, state, first[0]
+            return self._pin_kv(cache), self._pin_state(state), first[0]
 
         return prefill
 
@@ -746,9 +812,9 @@ class Generator:
         from the pool pytree, so no program variants are needed here."""
         pool = init_paged_cache(
             self.config, num_blocks, block_len, dtype=self.compute_dtype,
-            kv_quant=kv_quant,
+            kv_quant=kv_quant, mesh=self.mesh,
         )
-        return pool, self._fresh_slot_state(slots)
+        return pool, self._place_replicated(self._fresh_slot_state(slots))
 
     def paged_step(self, slots: int, nb: int, block_len: int):
         """Jitted one-token paged decode step (cached per table width)."""
@@ -822,7 +888,7 @@ class Generator:
                 seen=seen,
                 rng=jnp.where(live[:, None], split[:, 0], state["rng"]),
             )
-            return pool, new_state, tok
+            return self._pin_kv(pool), self._pin_state(new_state), tok
 
         return step
 
@@ -855,7 +921,7 @@ class Generator:
                     block_tables=table, compute_dtype=dtype, output_hidden=True,
                     activation_sharding=act, adapter_idx=adapter_idx[None],
                 )
-                return pool
+                return self._pin_kv(pool)
 
             return ingest
 
@@ -899,7 +965,7 @@ class Generator:
                 do_sample=state["do_sample"].at[slot].set(knobs["do_sample"]),
                 adapter_idx=state["adapter_idx"].at[slot].set(knobs["adapter_idx"]),
             )
-            return pool, state, first[0]
+            return self._pin_kv(pool), self._pin_state(state), first[0]
 
         return final_chunk
 
@@ -1035,7 +1101,7 @@ class Generator:
                 seen=seen,
                 rng=jnp.where(live[:, None], splits[:, 0], state["rng"]),
             )
-            return cache, new_state, toks, n_emit
+            return self._pin_kv(cache), self._pin_state(new_state), toks, n_emit
 
         return step
 
@@ -1076,7 +1142,7 @@ class Generator:
                 seen=seen,
                 rng=jnp.where(live[:, None], splits[:, 0], state["rng"]),
             )
-            return pool, new_state, toks, n_emit
+            return self._pin_kv(pool), self._pin_state(new_state), toks, n_emit
 
         return step
 
@@ -1094,7 +1160,8 @@ class Generator:
         if self._draft_config is None:
             raise ValueError("no draft model attached")
         return init_cache(
-            self._draft_config, slots, buf_len, dtype=self.compute_dtype
+            self._draft_config, slots, buf_len, dtype=self.compute_dtype,
+            mesh=self.mesh,
         )
 
     def draft_slot_prefill(self, bucket: int):
@@ -1128,7 +1195,7 @@ class Generator:
                 compute_dtype=dtype, output_hidden=True,
                 activation_sharding=act,
             )
-            return insert_cache_row(dcache, small, slot)
+            return self._pin_kv(insert_cache_row(dcache, small, slot))
 
         return prefill
 
@@ -1197,7 +1264,7 @@ class Generator:
                 dcache, dbuf, _ = jax.lax.fori_loop(
                     1, K, dstep, (dcache, dbuf, spec_seen)
                 )
-            return dcache, dbuf
+            return self._pin_kv(dcache), dbuf
 
         return draft
 

@@ -136,6 +136,432 @@ class MultihostCoordinator:
         _broadcast(stop, self._is_source)
 
 
+# --------------------------------------------------------------------------
+# Sharded slot engines: the continuous/paged tick protocol.
+#
+# The window protocol above broadcasts one (prompts, config, seed) tuple per
+# WHOLE batch. The slot engines decide per TICK — admission, drafting,
+# speculation, preemption, weight swap, adapter residency — all host-side on
+# process 0. Each decision that leads to a device dispatch serializes into a
+# fixed-shape control header (+ shape-derivable payloads) broadcast before
+# the dispatch, so every process enters the identical fused program in the
+# identical order while process 0 alone owns HTTP, batching state, and
+# settlement. Followers hold their own references to the GLOBAL sharded
+# cache/state/pool arrays and thread them through the mirrored dispatches.
+#
+# Wire format: int64 header of _SLOT_HEADER_LEN
+#   [op, a, b, c, d, e, f, g, h, i]
+# where the meaning of a..i depends on op (see each SlotBridge method).
+# Variable-size payloads (swap manifests, adapter factors) ride as a JSON
+# manifest whose byte length is in the header, followed by one raw-bytes
+# broadcast per leaf with shape/dtype taken from the manifest — the same
+# "length first, then sized buffers" trick _encode_cfg uses.
+
+_SLOT_HEADER_LEN = 10
+
+SLOT_STOP = 0
+SLOT_STARTUP = 1
+SLOT_PREFILL = 2
+SLOT_STEP = 3
+SLOT_SPEC_STEP = 4
+SLOT_PAGED_CHUNK = 5
+SLOT_PAGED_FINAL = 6
+SLOT_PAGED_STEP = 7
+SLOT_SPEC_PAGED_STEP = 8
+SLOT_SWAP = 9
+SLOT_ADAPTER = 10
+SLOT_DRAFT_STEP = 11
+
+# per-request sampling knobs pack into one fixed f64 vector (the dict
+# engine._knob_arrays builds; do_sample/adapter_idx round-trip exactly
+# through f64)
+_KNOB_FIELDS = (
+    "temperature", "top_p", "top_k", "repetition_penalty", "do_sample",
+    "adapter_idx",
+)
+_KNOB_DTYPES = {
+    "temperature": np.float32, "top_p": np.float32, "top_k": np.int32,
+    "repetition_penalty": np.float32, "do_sample": np.bool_,
+    "adapter_idx": np.int32,
+}
+
+
+def _encode_knobs(knobs: dict) -> np.ndarray:
+    return np.asarray([float(knobs[f]) for f in _KNOB_FIELDS], np.float64)
+
+
+def _decode_knobs(vec: np.ndarray) -> dict:
+    return {
+        f: _KNOB_DTYPES[f](vec[i]) for i, f in enumerate(_KNOB_FIELDS)
+    }
+
+
+def _tree_manifest(updates: dict):
+    """(manifest uint8 buffer, ordered [(path, np.ndarray)] entries) for a
+    flat {path: array} dict — the sender half of the sized-payload codec."""
+    entries = [(p, np.asarray(updates[p])) for p in sorted(updates)]
+    manifest = json.dumps(
+        [[p, list(a.shape), a.dtype.str] for p, a in entries]
+    ).encode()
+    return np.frombuffer(manifest, np.uint8).copy(), entries
+
+
+def _manifest_entries(buf: np.ndarray):
+    """Receiver half: [(path, shape tuple, dtype)] from a manifest buffer."""
+    return [
+        (p, tuple(shape), np.dtype(dt))
+        for p, shape, dt in json.loads(bytes(buf.tobytes()).decode())
+    ]
+
+
+class SlotBridge:
+    """Process-0 side of the sharded slot engines' tick protocol.
+
+    The engine calls the matching method immediately BEFORE each device
+    dispatch; the broadcast is itself a collective, so it must complete
+    before process 0 enters the fused program (otherwise followers wait on
+    a header while the coordinator waits on them inside the program).
+    Engines attach it via their ``bridge=`` kwarg; without one, a
+    process-spanning generator is rejected at engine construction."""
+
+    def __init__(self):
+        import jax
+
+        self._is_source = jax.process_index() == 0
+
+    def _header(self, op: int, *vals) -> None:
+        h = np.zeros((_SLOT_HEADER_LEN,), np.int64)
+        h[0] = op
+        for i, v in enumerate(vals):
+            h[1 + i] = int(v)
+        _broadcast(h, self._is_source)
+
+    def _send(self, arr: np.ndarray) -> None:
+        _broadcast(np.ascontiguousarray(arr), self._is_source)
+
+    def startup(
+        self, kind: int, slots: int, buf_len: int, spec_k: int,
+        num_blocks: int = 0, block_len: int = 0, table_blocks: int = 0,
+        kv_quant_int8: bool = False, use_draft: bool = False,
+    ) -> None:
+        """kind 0 = continuous (dense), 1 = paged. Announced from the
+        engines' supervised ``_startup`` — a supervisor RESTART re-announces,
+        so followers rebuild their cache/state mirrors in lockstep."""
+        self._header(
+            SLOT_STARTUP, kind, slots, buf_len, spec_k, num_blocks,
+            block_len, table_blocks, int(kv_quant_int8), int(use_draft),
+        )
+
+    def prefill(
+        self, bucket: int, plen: int, slot: int, seed: int, knobs: dict,
+        padded: np.ndarray, draft_padded=None,
+    ) -> None:
+        dbucket = 0 if draft_padded is None else draft_padded.shape[1]
+        self._header(
+            SLOT_PREFILL, bucket, plen, slot, seed,
+            0 if draft_padded is None else 1, dbucket,
+        )
+        self._send(_encode_knobs(knobs))
+        self._send(padded.astype(np.int32))
+        if draft_padded is not None:
+            self._send(draft_padded.astype(np.int32))
+
+    def step(self, live: np.ndarray) -> None:
+        self._header(SLOT_STEP)
+        self._send(live.astype(np.bool_))
+
+    def draft_step(self, window: np.ndarray, start: np.ndarray) -> None:
+        """Announced inside ``_propose_drafts`` before the draft-model
+        dispatch (its own collective program); the verify step's operands
+        follow in spec_step/spec_paged_step."""
+        self._header(SLOT_DRAFT_STEP)
+        self._send(window.astype(np.int32))
+        self._send(start.astype(np.int32))
+
+    def spec_step(
+        self, live: np.ndarray, drafts: np.ndarray, n_draft: np.ndarray
+    ) -> None:
+        self._header(SLOT_SPEC_STEP)
+        self._send(live.astype(np.bool_))
+        self._send(drafts.astype(np.int32))
+        self._send(n_draft.astype(np.int32))
+
+    def paged_chunk(
+        self, table: np.ndarray, chunk: np.ndarray, chunk_start: int,
+        adapter_idx: int,
+    ) -> None:
+        self._header(
+            SLOT_PAGED_CHUNK, chunk.shape[1], chunk_start, adapter_idx
+        )
+        self._send(table.astype(np.int32))
+        self._send(chunk.astype(np.int32))
+
+    def paged_final(
+        self, bucket: int, chunk_start: int, plen: int, slot: int, seed: int,
+        knobs: dict, table: np.ndarray, padded: np.ndarray,
+        seen_row: np.ndarray, draft_padded=None,
+    ) -> None:
+        dbucket = 0 if draft_padded is None else draft_padded.shape[1]
+        self._header(
+            SLOT_PAGED_FINAL, bucket, chunk_start, plen, slot, seed,
+            0 if draft_padded is None else 1, dbucket,
+        )
+        self._send(_encode_knobs(knobs))
+        self._send(table.astype(np.int32))
+        self._send(padded.astype(np.int32))
+        self._send(seen_row.astype(np.bool_))
+        if draft_padded is not None:
+            self._send(draft_padded.astype(np.int32))
+
+    def paged_step(self, live: np.ndarray, tables: np.ndarray) -> None:
+        self._header(SLOT_PAGED_STEP, tables.shape[1])
+        self._send(live.astype(np.bool_))
+        self._send(tables.astype(np.int32))
+
+    def spec_paged_step(
+        self, live: np.ndarray, tables: np.ndarray, drafts: np.ndarray,
+        n_draft: np.ndarray,
+    ) -> None:
+        self._header(SLOT_SPEC_PAGED_STEP, tables.shape[1])
+        self._send(live.astype(np.bool_))
+        self._send(tables.astype(np.int32))
+        self._send(drafts.astype(np.int32))
+        self._send(n_draft.astype(np.int32))
+
+    def swap(self, updates) -> None:
+        """Broadcast a hot-swap's RAW update leaves ([(path tuple, host
+        array)] — WeightSwap.updates' format); every process requantizes
+        into its resident format and re-places over the resident sharding
+        independently (engine._apply_swap / follow_slots run the identical
+        _requantize + COW-graft code)."""
+        manifest, entries = _tree_manifest(
+            {"/".join(where): arr for where, arr in updates}
+        )
+        self._header(SLOT_SWAP, len(manifest))
+        self._send(manifest)
+        for _, arr in entries:
+            self._send(arr)
+
+    def adapter_write(self, slot: int, padded: dict, scale: float) -> None:
+        """Mirror one adapter pool-slot write (load or startup rebuild):
+        ``padded`` is AdapterRegistry's {site path tuple: (A, B)} host dict.
+        Factors ride flat as '<path>/a' + '<path>/b' manifest entries; the
+        scale rides as its own f64 payload (exact)."""
+        flat = {}
+        for pth, (a, b) in padded.items():
+            flat["/".join(pth) + "/a"] = a
+            flat["/".join(pth) + "/b"] = b
+        manifest, entries = _tree_manifest(flat)
+        self._header(SLOT_ADAPTER, slot, len(manifest))
+        self._send(manifest)
+        self._send(np.asarray([scale], np.float64))
+        for _, arr in entries:
+            self._send(arr)
+
+    def stop(self) -> None:
+        self._header(SLOT_STOP)
+
+
+def _recv(shape, dtype) -> np.ndarray:
+    return _broadcast(np.zeros(shape, dtype), False)
+
+
+def follow_slots(generator, adapters=None) -> None:
+    """Follower loop for processes > 0 under a sharded SLOT engine: mirror
+    every process-0 dispatch against this process's shards of the global
+    cache/state/pool.
+
+    ``adapters``: an AdapterRegistry built with the SAME pool geometry
+    (max_adapters/rank) as process 0's — pool writes arrive over the bridge
+    (factors ride the broadcast, no shared filesystem needed), so pass
+    ``scan_disk=False`` registries on hosts without the adapter dir.
+
+    Failure policy matches ``follow``: any mirrored dispatch that fails
+    leaves process 0's next collective without a peer, so the follower
+    re-raises and dies loudly rather than wedge the fleet silently."""
+    import jax
+
+    gen = generator
+    params = adapters.params if adapters is not None else gen.params
+    mirror = {}  # engine-shape mirror state, rebuilt on every SLOT_STARTUP
+
+    def startup(h):
+        (kind, slots, buf_len, spec_k, num_blocks, block_len, table_blocks,
+         kvq, use_draft) = (int(x) for x in h[1:])
+        mirror.clear()
+        mirror.update(
+            kind=kind, slots=slots, buf_len=buf_len, spec_k=spec_k,
+            num_blocks=num_blocks, block_len=block_len,
+            table_blocks=table_blocks, use_draft=bool(use_draft),
+        )
+        if kind == 0:
+            mirror["cache"], mirror["state"] = gen.init_slot_state(
+                slots, buf_len
+            )
+        else:
+            mirror["cache"], mirror["state"] = gen.init_paged_state(
+                slots, num_blocks, block_len,
+                "int8" if kvq else "none",
+            )
+        if use_draft:
+            mirror["dcache"] = gen.init_draft_slot_cache(slots, buf_len)
+        if adapters is not None:
+            adapters.rebuild()
+
+    def recv_sized_tree(mlen):
+        entries = _manifest_entries(_recv((mlen,), np.uint8))
+        return {p: _recv(shape, dt) for p, shape, dt in entries}
+
+    while True:
+        h = _broadcast(np.zeros((_SLOT_HEADER_LEN,), np.int64), False)
+        op = int(h[0])
+        if op == SLOT_STOP:
+            return
+        try:
+            if op == SLOT_STARTUP:
+                startup(h)
+                continue
+            S = mirror["slots"]
+            buf_len = mirror["buf_len"]
+            K = mirror["spec_k"]
+            tb = mirror["table_blocks"]
+            if op == SLOT_PREFILL:
+                bucket, plen, slot, seed, draft, dbucket = (
+                    int(x) for x in h[1:7]
+                )
+                knobs = _decode_knobs(_recv((len(_KNOB_FIELDS),), np.float64))
+                padded = _recv((1, bucket), np.int32)
+                prefill = gen.slot_prefill(bucket, buf_len)
+                mirror["cache"], mirror["state"], _ = prefill(
+                    params, mirror["cache"], mirror["state"], padded,
+                    np.int32(plen), np.int32(slot), knobs,
+                    jax.random.PRNGKey(seed),
+                )
+                if draft:
+                    dpad = _recv((1, dbucket), np.int32)
+                    dprefill = gen.draft_slot_prefill(dbucket)
+                    mirror["dcache"] = dprefill(
+                        gen.draft_params, mirror["dcache"], dpad,
+                        np.int32(slot),
+                    )
+            elif op == SLOT_STEP:
+                live = _recv((S,), np.bool_)
+                step = gen.slot_step(S, buf_len)
+                mirror["cache"], mirror["state"], _ = step(
+                    params, mirror["cache"], mirror["state"], live
+                )
+            elif op == SLOT_DRAFT_STEP:
+                window = _recv((S, K + 1), np.int32)
+                start = _recv((S,), np.int32)
+                dstep = gen.draft_slot_step(S, K)
+                mirror["dcache"], _ = dstep(
+                    gen.draft_params, mirror["dcache"], mirror["state"],
+                    window, start,
+                )
+            elif op == SLOT_SPEC_STEP:
+                live = _recv((S,), np.bool_)
+                drafts = _recv((S, K), np.int32)
+                n_draft = _recv((S,), np.int32)
+                step = gen.spec_slot_step(S, buf_len, K)
+                mirror["cache"], mirror["state"], _, _ = step(
+                    params, mirror["cache"], mirror["state"], live, drafts,
+                    n_draft,
+                )
+            elif op == SLOT_PAGED_CHUNK:
+                chunk_w, chunk_start, adapter_idx = (int(x) for x in h[1:4])
+                table = _recv((1, tb), np.int32)
+                chunk = _recv((1, chunk_w), np.int32)
+                ingest = gen.paged_prefill_chunk(
+                    chunk_w, tb, mirror["block_len"]
+                )
+                mirror["cache"] = ingest(
+                    params, mirror["cache"], table, chunk,
+                    np.int32(chunk_start), np.int32(adapter_idx),
+                )
+            elif op == SLOT_PAGED_FINAL:
+                bucket, chunk_start, plen, slot, seed, draft, dbucket = (
+                    int(x) for x in h[1:8]
+                )
+                knobs = _decode_knobs(_recv((len(_KNOB_FIELDS),), np.float64))
+                table = _recv((1, tb), np.int32)
+                padded = _recv((1, bucket), np.int32)
+                seen_row = _recv((1, gen.config.vocab_size), np.bool_)
+                final = gen.paged_prefill_final(
+                    bucket, tb, mirror["block_len"]
+                )
+                mirror["cache"], mirror["state"], _ = final(
+                    params, mirror["cache"], mirror["state"], table, padded,
+                    np.int32(chunk_start), np.int32(plen), seen_row,
+                    np.int32(slot), knobs, jax.random.PRNGKey(seed),
+                )
+                if draft:
+                    dpad = _recv((1, dbucket), np.int32)
+                    dprefill = gen.draft_slot_prefill(dbucket)
+                    mirror["dcache"] = dprefill(
+                        gen.draft_params, mirror["dcache"], dpad,
+                        np.int32(slot),
+                    )
+            elif op == SLOT_PAGED_STEP:
+                nb = int(h[1])
+                live = _recv((S,), np.bool_)
+                tables = _recv((S, nb), np.int32)
+                step = gen.paged_step(S, nb, mirror["block_len"])
+                mirror["cache"], mirror["state"], _ = step(
+                    params, mirror["cache"], mirror["state"], live, tables
+                )
+            elif op == SLOT_SPEC_PAGED_STEP:
+                nb = int(h[1])
+                live = _recv((S,), np.bool_)
+                tables = _recv((S, nb), np.int32)
+                drafts = _recv((S, K), np.int32)
+                n_draft = _recv((S,), np.int32)
+                step = gen.spec_paged_step(S, nb, mirror["block_len"], K)
+                mirror["cache"], mirror["state"], _, _ = step(
+                    params, mirror["cache"], mirror["state"], live, tables,
+                    drafts, n_draft,
+                )
+            elif op == SLOT_SWAP:
+                from llm_fine_tune_distributed_tpu.infer.engine import (
+                    _cow_swap_tree,
+                    _requantize_updates,
+                )
+
+                updates = [
+                    (tuple(p.split("/")), arr)
+                    for p, arr in recv_sized_tree(int(h[1])).items()
+                ]
+                params, _ = _cow_swap_tree(
+                    params, _requantize_updates(params, updates)
+                )
+                if adapters is not None:
+                    adapters.rebind(params)
+            elif op == SLOT_ADAPTER:
+                slot, mlen = int(h[1]), int(h[2])
+                flat = recv_sized_tree(mlen)
+                scale = float(_recv((1,), np.float64)[0])
+                if adapters is None:
+                    raise ValueError(
+                        "process 0 announced an adapter pool write but this "
+                        "follower has no AdapterRegistry — start followers "
+                        "with the same --adapter-dir pool geometry"
+                    )
+                padded = {}
+                for path in flat:
+                    if path.endswith("/a"):
+                        pth = tuple(path[:-2].split("/"))
+                        padded[pth] = (flat[path], flat[path[:-2] + "/b"])
+                adapters.apply_remote_write(slot, padded, scale)
+            else:
+                raise ValueError(f"unknown slot-bridge op {op}")
+        except Exception:
+            print(
+                "[serve] slot follower dispatch failed; crashing so the "
+                "wedge is visible (restart the serving fleet)",
+                flush=True,
+            )
+            raise
+
+
 def follow(generator) -> None:
     """Follower loop for processes > 0: mirror every coordinator batch.
 

@@ -13,6 +13,12 @@ Writes routed to it (dead rows, clamped indices) land in garbage cells whose
 view positions are always masked, and reads through null entries gather
 garbage that sits above every live query position — the paged analog of the
 dense engine's "stale rows are masked" invariant.
+
+Under the sharded slot engines (infer/multihost.py's tick bridge) this
+bookkeeping lives ONLY on process 0: block ids index the pool's unsharded
+leading dim, so the block tables process 0 broadcasts each tick reference
+the same blocks on every process's shard of the global pool — allocator
+and prefix-cache state never needs mirroring.
 """
 
 from __future__ import annotations

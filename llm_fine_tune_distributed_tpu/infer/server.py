@@ -287,7 +287,7 @@ def serve(
     if tp > 1:
         from llm_fine_tune_distributed_tpu.infer.generate import make_tp_mesh
 
-        mesh = make_tp_mesh(tp)
+        mesh = make_tp_mesh(tp, model_config)
         print(f"Tensor-parallel decode over {tp} devices")
     draft_kwargs = {}
     if draft_dir:
@@ -299,40 +299,81 @@ def serve(
         print(f"Draft model for speculation: {draft_dir}")
     generator = Generator(params, model_config, tokenizer, mesh=mesh, **draft_kwargs)
     coordinator = None
+    slot_bridge = None
     engine_target = generator
     if getattr(generator, "_multihost", False):
         import jax
 
-        from llm_fine_tune_distributed_tpu.infer.multihost import (
-            MultihostCoordinator,
-            follow,
-        )
+        if engine_kind in ("continuous", "paged"):
+            # sharded slot engines over the tick protocol: process 0 owns
+            # HTTP, batching state, and settlement, and announces every
+            # device dispatch over the slot bridge; followers mirror each
+            # dispatch against their shards of the global cache/pool
+            from llm_fine_tune_distributed_tpu.infer.multihost import (
+                SlotBridge,
+                follow_slots,
+            )
 
-        if jax.process_index() != 0:
-            # follower hosts never serve HTTP: they mirror process 0's
-            # batches until the coordinator stops them
-            print(f"[serve] process {jax.process_index()}: following host 0")
-            follow(generator)
-            return
-        coordinator = MultihostCoordinator(generator)
-        engine_target = coordinator
-        print(f"[serve] coordinating {jax.process_count()} hosts")
-        if speculative_k:
-            raise ValueError(
-                "--speculative K needs a continuous/paged engine, which is "
-                "single-host only; multi-host serving falls back to the "
-                "window engine (per-request 'speculative': K on "
-                "POST /v1/generate still works there)"
+            if replicas > 1 or max_replicas > replicas:
+                raise ValueError(
+                    "--replicas/--max-replicas scale-out is per-host and "
+                    "cannot share one slot bridge; multi-host --tp serving "
+                    "runs ONE sharded engine per fleet — run one server per "
+                    "slice behind an external balancer instead"
+                )
+            if jax.process_index() != 0:
+                follower_adapters = None
+                if adapter_dir:
+                    from llm_fine_tune_distributed_tpu.infer.adapters import (
+                        AdapterRegistry,
+                    )
+
+                    follower_adapters = AdapterRegistry(
+                        generator.params, adapter_dir,
+                        max_adapters=max_adapters, mesh=mesh,
+                    )
+                print(
+                    f"[serve] process {jax.process_index()}: following "
+                    f"host 0's {engine_kind} slot engine"
+                )
+                follow_slots(generator, adapters=follower_adapters)
+                return
+            slot_bridge = SlotBridge()
+            print(
+                f"[serve] coordinating {jax.process_count()} hosts "
+                f"({engine_kind} slot engine over the tick bridge)"
             )
-        if adapter_dir:
-            raise ValueError(
-                "--adapter-dir needs a continuous/paged engine, which is "
-                "single-host only; multi-host serving falls back to the "
-                "window engine. Alternatives: serve adapters from a "
-                "single-host deployment, or merge ONE adapter into the "
-                "weights (parallel/lora.merge_lora) and serve that "
-                "checkpoint multi-host"
+        else:
+            from llm_fine_tune_distributed_tpu.infer.multihost import (
+                MultihostCoordinator,
+                follow,
             )
+
+            if jax.process_index() != 0:
+                # follower hosts never serve HTTP: they mirror process 0's
+                # batches until the coordinator stops them
+                print(f"[serve] process {jax.process_index()}: following host 0")
+                follow(generator)
+                return
+            coordinator = MultihostCoordinator(generator)
+            engine_target = coordinator
+            print(f"[serve] coordinating {jax.process_count()} hosts")
+            if speculative_k:
+                raise ValueError(
+                    "--speculative K needs a continuous/paged engine; those "
+                    "now serve multi-host meshes too — start with "
+                    "--engine continuous|paged --tp N instead of "
+                    "--engine window"
+                )
+            if adapter_dir:
+                raise ValueError(
+                    "--adapter-dir needs a continuous/paged engine; those "
+                    "now serve multi-host meshes too — start with "
+                    "--engine continuous|paged --tp N instead of "
+                    "--engine window (or merge ONE adapter into the "
+                    "weights via parallel/lora.merge_lora and serve that "
+                    "checkpoint)"
+                )
     if engine_kind not in ("continuous", "paged", "window"):
         raise ValueError(
             f"unknown engine {engine_kind!r} (expected 'continuous', 'paged' "
@@ -372,86 +413,82 @@ def serve(
         "slo_sample_interval_s": slo_sample_interval_s,
     }
     if engine_kind in ("continuous", "paged"):
-        if coordinator is not None:
-            print(f"[serve] multi-host: {engine_kind} engine unavailable, using window")
-            if replicas > 1:
-                print(
-                    "[serve] multi-host: --replicas ignored (replica "
-                    "scale-out is per-host; run one server per slice "
-                    "behind an external balancer instead)"
-                )
-        else:
-            from llm_fine_tune_distributed_tpu.infer.engine import (
-                ContinuousBatchingEngine,
-                PagedContinuousBatchingEngine,
+        from llm_fine_tune_distributed_tpu.infer.engine import (
+            ContinuousBatchingEngine,
+            PagedContinuousBatchingEngine,
+        )
+
+        if adapter_dir:
+            from llm_fine_tune_distributed_tpu.infer.adapters import (
+                AdapterRegistry,
             )
 
+        def _make_replica(i: int):
+            # every replica wraps the SAME generator — params resident
+            # once, jitted programs shared — but owns its own KV pool,
+            # supervisor, and stats. Crash artifacts get per-replica
+            # paths so two replicas' dumps cannot clobber each other.
+            kw = dict(engine_kwargs)
+            from llm_fine_tune_distributed_tpu.observe.slo import (
+                SloPolicy,
+            )
+
+            kw["slo_policy"] = SloPolicy(
+                ttft_p99_s=slo_ttft_p99_s,
+                inter_token_p99_s=slo_inter_token_p99_s,
+                error_rate=slo_error_rate,
+                availability=slo_availability,
+                fast_window_s=slo_fast_window_s,
+                slow_window_s=slo_slow_window_s,
+            )
+            if slot_bridge is not None:
+                # process-spanning mesh: every dispatch announces over
+                # the bridge before entering the collective program
+                kw["bridge"] = slot_bridge
             if adapter_dir:
-                from llm_fine_tune_distributed_tpu.infer.adapters import (
-                    AdapterRegistry,
+                # per-replica registry: pool residency is a replica-
+                # local property (the fleet routes tenants to the
+                # replica already holding their adapter), and pool
+                # leaves are value-updated in place — sharing one
+                # across replicas would let replica A's eviction yank
+                # a slot replica B is decoding with
+                kw["adapters"] = AdapterRegistry(
+                    generator.params,
+                    adapter_dir,
+                    max_adapters=max_adapters,
+                    mesh=mesh,
                 )
-
-            def _make_replica(i: int):
-                # every replica wraps the SAME generator — params resident
-                # once, jitted programs shared — but owns its own KV pool,
-                # supervisor, and stats. Crash artifacts get per-replica
-                # paths so two replicas' dumps cannot clobber each other.
-                kw = dict(engine_kwargs)
-                from llm_fine_tune_distributed_tpu.observe.slo import (
-                    SloPolicy,
-                )
-
-                kw["slo_policy"] = SloPolicy(
-                    ttft_p99_s=slo_ttft_p99_s,
-                    inter_token_p99_s=slo_inter_token_p99_s,
-                    error_rate=slo_error_rate,
-                    availability=slo_availability,
-                    fast_window_s=slo_fast_window_s,
-                    slow_window_s=slo_slow_window_s,
-                )
-                if adapter_dir:
-                    # per-replica registry: pool residency is a replica-
-                    # local property (the fleet routes tenants to the
-                    # replica already holding their adapter), and pool
-                    # leaves are value-updated in place — sharing one
-                    # across replicas would let replica A's eviction yank
-                    # a slot replica B is decoding with
-                    kw["adapters"] = AdapterRegistry(
-                        generator.params,
-                        adapter_dir,
-                        max_adapters=max_adapters,
-                    )
-                    kw["adapter_quota"] = adapter_capacity
-                if replicas > 1 or max_replicas > replicas:
-                    if kw.get("flight_dir"):
-                        kw["flight_dir"] = os.path.join(
-                            kw["flight_dir"], f"replica{i}"
-                        )
-                    if kw.get("trace_log"):
-                        kw["trace_log"] = f"{kw['trace_log']}.replica{i}"
-                if engine_kind == "paged":
-                    return PagedContinuousBatchingEngine(
-                        generator, slots=slots, buf_len=kv_buf_len,
-                        block_len=kv_block_len, prefill_chunk=prefill_chunk,
-                        kv_quant=quantize_kv,
-                        **kw,
-                    )
-                return ContinuousBatchingEngine(
-                    generator, slots=slots, buf_len=kv_buf_len, **kw
-                )
-
+                kw["adapter_quota"] = adapter_capacity
             if replicas > 1 or max_replicas > replicas:
-                # a growable fleet even from --replicas 1: elastic growth
-                # needs the router/fleet shape from the start, so
-                # --max-replicas above --replicas forces it
-                cont_engine = EngineFleet(
-                    [_make_replica(i) for i in range(replicas)],
-                    routing=routing,
-                    replica_factory=_make_replica,
+                if kw.get("flight_dir"):
+                    kw["flight_dir"] = os.path.join(
+                        kw["flight_dir"], f"replica{i}"
+                    )
+                if kw.get("trace_log"):
+                    kw["trace_log"] = f"{kw['trace_log']}.replica{i}"
+            if engine_kind == "paged":
+                return PagedContinuousBatchingEngine(
+                    generator, slots=slots, buf_len=kv_buf_len,
+                    block_len=kv_block_len, prefill_chunk=prefill_chunk,
+                    kv_quant=quantize_kv,
+                    **kw,
                 )
-            else:
-                cont_engine = _make_replica(0)
-            cont_kind = engine_kind
+            return ContinuousBatchingEngine(
+                generator, slots=slots, buf_len=kv_buf_len, **kw
+            )
+
+        if replicas > 1 or max_replicas > replicas:
+            # a growable fleet even from --replicas 1: elastic growth
+            # needs the router/fleet shape from the start, so
+            # --max-replicas above --replicas forces it
+            cont_engine = EngineFleet(
+                [_make_replica(i) for i in range(replicas)],
+                routing=routing,
+                replica_factory=_make_replica,
+            )
+        else:
+            cont_engine = _make_replica(0)
+        cont_kind = engine_kind
     # elastic fleet control loop (observe/capacity.py): dry-run (default)
     # records would-be decisions without acting — read GET /v1/capacity,
     # then restart with --autoscale on once the recommendations look sane
@@ -1222,6 +1259,22 @@ def serve(
                 },
                 {"role": "user", "content": question},
             ]
+            if (
+                slot_bridge is not None
+                and gen.speculative_lookup > 0
+                and speculative_k == 0
+            ):
+                # the window engine's solo fallback program is not part of
+                # the slot bridge's tick protocol, so followers would never
+                # mirror it (fleet deadlock)
+                self._send(400, {"error": (
+                    "'speculative' on a multi-host --tp slot engine needs "
+                    "the server started with --speculative K (the window "
+                    "fallback is single-host only); retry without "
+                    "'speculative' or restart with "
+                    "--engine continuous|paged --tp N --speculative K"
+                )})
+                return
             try:
                 # tokenize/decode on the handler thread (Generator's shared
                 # chat helpers, so CLI and server cannot diverge); only the
@@ -1348,6 +1401,8 @@ def serve(
             deploy_mgr.stop()
         if coordinator is not None:
             coordinator.stop()  # release follower hosts
+        if slot_bridge is not None:
+            slot_bridge.stop()  # release slot-engine follower hosts
         if drain_state["draining"]:
             print("[serve] drained; exiting", flush=True)
 

@@ -739,16 +739,43 @@ def unembed(params: Params, hidden, config: ModelConfig, *, compute_dtype=jnp.bf
     return logits
 
 
-def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
-    """Fixed-size KV cache buffers for autoregressive decoding."""
+def init_cache(
+    config: ModelConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16,
+    mesh=None,
+):
+    """Fixed-size KV cache buffers for autoregressive decoding.
+
+    With ``mesh`` the buffers are allocated directly under the KV partition
+    rules (``parallel/sharding.kv_cache_spec``: kv-head dim over ``tensor``)
+    — zeros compile straight into sharded device buffers, so a pool that
+    only fits *sharded* never stages unsharded on one chip."""
     d = config.resolved_head_dim
     shape = (batch_size, max_len, config.num_kv_heads, d)
-    return {
-        "layers": {
-            str(i): {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-            for i in range(config.num_layers)
+
+    def alloc():
+        return {
+            "layers": {
+                str(i): {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+                for i in range(config.num_layers)
+            }
         }
-    }
+
+    return _alloc_kv(alloc, mesh)
+
+
+def _alloc_kv(alloc, mesh):
+    """Run a zeros-allocating thunk, placing its leaves under the mesh's KV
+    shardings when a mesh is given (jit out_shardings: works identically on
+    single-process and process-spanning meshes)."""
+    if mesh is None:
+        return alloc()
+    from llm_fine_tune_distributed_tpu.parallel.sharding import (
+        kv_cache_shardings,
+    )
+
+    shapes = jax.eval_shape(alloc)
+    shardings = kv_cache_shardings(shapes, mesh)
+    return jax.jit(alloc, out_shardings=shardings)()
 
 
 def init_paged_cache(
@@ -757,6 +784,7 @@ def init_paged_cache(
     block_len: int,
     dtype=jnp.bfloat16,
     kv_quant: str = "none",
+    mesh=None,
 ):
     """Global paged KV pool for the block-paged continuous engine: per layer
     one [num_blocks, block_len, kv_heads, head_dim] buffer shared by every
@@ -772,6 +800,11 @@ def init_paged_cache(
     prefix cache (infer/paged.py) deal only in block ids and are untouched.
     Scales start at 0 ("never written"), so every block — the null block
     forever — dequantizes to exact zeros until its first real write.
+
+    ``mesh`` allocates every pool leaf — the int8 code pools AND their
+    scale siblings — directly under the KV partition rules (see
+    ``init_cache``): kv-head dim over ``tensor``, block dim replicated, so
+    one global block id still addresses the same block on every chip.
     """
     if kv_quant not in KV_QUANT_MODES:
         raise ValueError(
@@ -789,9 +822,10 @@ def init_paged_cache(
         }
     else:
         entry = lambda: {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-    return {
-        "layers": {str(i): entry() for i in range(config.num_layers)}
-    }
+    return _alloc_kv(
+        lambda: {"layers": {str(i): entry() for i in range(config.num_layers)}},
+        mesh,
+    )
 
 
 def insert_cache_row(cache, row_cache, slot):

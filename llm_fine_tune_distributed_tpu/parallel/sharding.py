@@ -52,6 +52,10 @@ _MATRIX_RULES = [
     # B [r, out] shard out-dim. Conservative: fsdp only (r is tiny).
     (re.compile(r".*/lora_a$"), ("fsdp", None)),
     (re.compile(r".*/lora_b$"), (None, "fsdp")),
+    # Stacked adapter pools (infer/adapters.py): same orientation with a
+    # leading [max_adapters] pool dim. lora_scale_pool is 1-D -> replicated.
+    (re.compile(r".*/lora_a_pool$"), (None, "fsdp", None)),
+    (re.compile(r".*/lora_b_pool$"), (None, None, "fsdp")),
     # MoE (ops/moe.py): stacked expert weights shard the expert dim over the
     # "expert" axis (expert parallelism) plus the usual fsdp/tensor dims;
     # the router gate [h, E] is tiny — fsdp on the input dim only.
@@ -137,6 +141,61 @@ def global_array_from_host(host_array: np.ndarray, sharding: NamedSharding):
     its devices own."""
     return jax.make_array_from_callback(
         host_array.shape, sharding, lambda idx: host_array[idx]
+    )
+
+
+def mesh_fully_addressable(mesh: Mesh) -> bool:
+    """True when every mesh device belongs to this process (single-controller
+    placement via ``jax.device_put`` is legal); False on a process-spanning
+    mesh, where leaves must be assembled as global arrays."""
+    pid = jax.process_index()
+    return all(d.process_index == pid for d in mesh.devices.flat)
+
+
+def place_tree(tree, shardings):
+    """Place a host-local pytree under a matching pytree of NamedShardings,
+    choosing ``device_put`` or global-array assembly per the mesh's
+    addressability (the same split ``shard_params`` makes for weights)."""
+    meshes = {sh.mesh for sh in jax.tree.leaves(shardings)}
+    if all(mesh_fully_addressable(m) for m in meshes):
+        return jax.device_put(tree, shardings)
+    return jax.tree.map(
+        lambda x, sh: global_array_from_host(np.asarray(x), sh), tree, shardings
+    )
+
+
+# KV cache / paged block pool leaves, by leaf name. Dense rows and paged
+# blocks share the layout [rows|blocks, len, num_kv_heads, head_dim]: the
+# kv-head dim shards over ``tensor`` so each chip holds the heads its
+# (column-sharded) k/v projections produce — decode attention then needs no
+# resharding between projection, cache write, and the gather/softmax.
+# int8 pools carry sibling per-block scales [blocks, num_kv_heads] that
+# shard the same head dim. _validate_spec drops the tensor axis when it
+# does not divide num_kv_heads (head replication — see make_tp_mesh).
+_KV_LEAF_DIMS = {
+    "k": (None, None, "tensor", None),
+    "v": (None, None, "tensor", None),
+    "k_scale": (None, "tensor"),
+    "v_scale": (None, "tensor"),
+}
+
+
+def kv_cache_spec(path: str, shape, mesh: Mesh) -> P:
+    name = path.rsplit("/", 1)[-1]
+    dims = _KV_LEAF_DIMS.get(name)
+    if dims is None or len(dims) != len(shape):
+        return P()
+    return _validate_spec(P(*dims), shape, mesh)
+
+
+def kv_cache_shardings(cache, mesh: Mesh):
+    """Pytree of NamedSharding for a dense KV cache or paged block pool
+    (``models/transformer.init_cache`` / ``init_paged_cache`` layout)."""
+    return map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, kv_cache_spec(path, getattr(leaf, "shape", ()), mesh)
+        ),
+        cache,
     )
 
 

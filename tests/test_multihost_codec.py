@@ -1,0 +1,131 @@
+"""Control-header codecs for multi-host serving (infer/multihost.py).
+
+The window protocol's GenerationConfig codec and the slot-engine tick
+protocol's knob/manifest codecs are pure host-side byte shuffling — this
+pins them without any mesh: ``_encode_cfg`` overflow raises cleanly (not a
+truncated broadcast), every GenerationConfig field round-trips exactly,
+the header shape is FIXED across configs (a shape that varied per config
+would desynchronize the fleet's broadcasts), and the slot bridge's knob
+vector and sized-tree manifests reconstruct their inputs bit-for-bit.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from llm_fine_tune_distributed_tpu.infer.multihost import (
+    _CFG_BUF,
+    _HEADER_LEN,
+    _KNOB_FIELDS,
+    _SLOT_HEADER_LEN,
+    _decode_cfg,
+    _decode_knobs,
+    _encode_cfg,
+    _encode_knobs,
+    _manifest_entries,
+    _tree_manifest,
+)
+from llm_fine_tune_distributed_tpu.infer.sampling import GenerationConfig
+
+# ------------------------------------------------------- window cfg codec
+
+
+def test_cfg_roundtrips_every_field():
+    """Every GenerationConfig field survives the JSON wire — including
+    non-default values for ALL fields at once, so a field added to the
+    dataclass without codec support fails here, not on a pod."""
+    fields = {f.name: f.default for f in dataclasses.fields(GenerationConfig)}
+    overrides = {}
+    for name, default in fields.items():
+        if isinstance(default, bool):
+            overrides[name] = not default
+        elif isinstance(default, int):
+            overrides[name] = default + 3
+        elif isinstance(default, float):
+            overrides[name] = default * 0.5 + 0.125
+    gen = GenerationConfig(**overrides)
+    buf, length = _encode_cfg(gen)
+    assert _decode_cfg(buf, length) == gen
+
+
+def test_cfg_default_roundtrip_and_fixed_buffer_shape():
+    g1 = GenerationConfig()
+    g2 = GenerationConfig(max_new_tokens=999, temperature=0.123, top_k=7)
+    b1, l1 = _encode_cfg(g1)
+    b2, l2 = _encode_cfg(g2)
+    # the BUFFER shape never varies with the config — only the length
+    # prefix in the header does (fixed-shape broadcasts or deadlock)
+    assert b1.shape == b2.shape == (_CFG_BUF,)
+    assert b1.dtype == b2.dtype == np.uint8
+    assert _decode_cfg(b1, l1) == g1
+    assert _decode_cfg(b2, l2) == g2
+
+
+def test_cfg_overflow_raises_cleanly():
+    # an oversized field value must fail the encode with a clear
+    # ValueError, never silently truncate the buffer (replace() performs
+    # no type checking, so this models a pathological client string)
+    huge = dataclasses.replace(GenerationConfig(), top_k="x" * (_CFG_BUF + 1))
+    with pytest.raises(ValueError, match=str(_CFG_BUF)):
+        _encode_cfg(huge)
+
+
+def test_header_lengths_are_constants():
+    # wire-format freeze: bumping either is a protocol break that needs
+    # every host on the same build — make the bump loud
+    assert _HEADER_LEN == 5
+    assert _SLOT_HEADER_LEN == 10
+
+
+# ----------------------------------------------------- slot bridge codecs
+
+
+def test_knob_vector_roundtrips_exactly():
+    knobs = {
+        "temperature": np.float32(0.7),
+        "top_p": np.float32(0.95),
+        "top_k": np.int32(40),
+        "repetition_penalty": np.float32(1.1),
+        "do_sample": np.bool_(True),
+        "adapter_idx": np.int32(3),
+    }
+    vec = _encode_knobs(knobs)
+    assert vec.shape == (len(_KNOB_FIELDS),) and vec.dtype == np.float64
+    out = _decode_knobs(vec)
+    for field in _KNOB_FIELDS:
+        assert out[field] == knobs[field]
+        assert out[field].dtype == knobs[field].dtype
+
+
+def test_knob_vector_shape_fixed_across_values():
+    a = _encode_knobs(
+        {
+            "temperature": 1.0, "top_p": 1.0, "top_k": 0,
+            "repetition_penalty": 1.0, "do_sample": False, "adapter_idx": 0,
+        }
+    )
+    b = _encode_knobs(
+        {
+            "temperature": 0.1, "top_p": 0.5, "top_k": 512,
+            "repetition_penalty": 1.3, "do_sample": True, "adapter_idx": 7,
+        }
+    )
+    assert a.shape == b.shape
+
+
+def test_tree_manifest_roundtrips_shapes_dtypes_order():
+    tree = {
+        "model/layers/0/self_attn/q_proj/kernel": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "model/embed_tokens/weight": np.ones((2, 2), np.int8),
+        "a/scalarish": np.asarray([1.5], np.float64),
+    }
+    manifest, entries = _tree_manifest(tree)
+    assert manifest.dtype == np.uint8
+    decoded = _manifest_entries(manifest)
+    # sorted path order, shape and dtype preserved
+    assert [p for p, _, _ in decoded] == sorted(tree)
+    for (path, shape, dtype), (spath, arr) in zip(decoded, entries):
+        assert path == spath
+        assert shape == arr.shape and dtype == arr.dtype
+        np.testing.assert_array_equal(arr, tree[path])

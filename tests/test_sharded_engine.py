@@ -1,0 +1,277 @@
+"""Mesh-sharded slot engines (ISSUE 17 tentpole): tensor-parallel
+continuous/paged batching under pjit.
+
+Pins the acceptance contract on the conftest-forced 8-CPU mesh: a tp=2
+engine's greedy output is bit-identical to the mesh=None engine — plain
+greedy, speculative K>0, int8 KV pools, a resident LoRA adapter, a weight
+hot-swap, and a preempt-resume — with ZERO post-warmup recompiles (mesh
+placement must reach a sharding fixed point at the first compile, or every
+tick would re-specialize). Also pins the placement itself: KV/pool leaves
+shard their kv-head dim over ``tensor``, int8 scale siblings shard the
+same head dim, sampler state stays replicated, and ``make_tp_mesh`` warns
+(instead of exploding inside ``shard_params``) when tp does not divide the
+model's kv-head count.
+"""
+
+import os
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import GenerationConfig, Generator
+from llm_fine_tune_distributed_tpu.infer.adapters import AdapterRegistry
+from llm_fine_tune_distributed_tpu.infer.engine import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
+from llm_fine_tune_distributed_tpu.infer.generate import make_tp_mesh
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.parallel.lora import (
+    load_lora_adapter,
+    merge_lora,
+)
+
+from tests.test_adapters import _make_adapter
+
+CFG = get_preset("tiny")
+GREEDY = GenerationConfig(max_new_tokens=12, do_sample=False)
+TOK = ByteChatMLTokenizer()
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 2, reason="needs the forced multi-device CPU mesh"
+)
+
+
+def _enc(text: str):
+    return TOK.encode(text)
+
+
+def _prompts():
+    return [_enc("alpha"), _enc("beta bravo"), _enc("the quick brown fox")]
+
+
+@pytest.fixture(scope="module")
+def base_params():
+    return init_params(jax.random.PRNGKey(0), CFG, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_tp_mesh(2, CFG)
+
+
+@pytest.fixture(scope="module")
+def solo_gen(base_params):
+    return Generator(
+        base_params, CFG, TOK, compute_dtype=jnp.float32, eos_token_ids=[]
+    )
+
+
+@pytest.fixture(scope="module")
+def tp_gen(base_params, mesh):
+    return Generator(
+        base_params, CFG, TOK, mesh=mesh, compute_dtype=jnp.float32,
+        eos_token_ids=[],
+    )
+
+
+def _make_engine(gen, paged, **kw):
+    if paged:
+        return PagedContinuousBatchingEngine(
+            gen, slots=4, buf_len=128, prompt_bucket=16, block_len=16,
+            prefill_chunk=32, **kw,
+        )
+    return ContinuousBatchingEngine(
+        gen, slots=4, buf_len=128, prompt_bucket=16, **kw
+    )
+
+
+def _serve_all(eng, cfg=GREEDY, **submit_kw):
+    """Three prompts served twice: the second pass exercises the paged
+    engine's prefix-HIT admission path, whose programs also need warming
+    before a recompile gate means anything."""
+    out = [
+        eng.submit_full(p, cfg, seed=0, timeout=240, **submit_kw).result
+        for p in _prompts()
+    ]
+    out += [
+        eng.submit_full(p, cfg, seed=0, timeout=240, **submit_kw).result
+        for p in _prompts()
+    ]
+    return out
+
+
+# ---------------------------------------------------------------- placement
+
+
+def test_kv_cache_leaves_shard_head_dim_state_replicated(tp_gen, mesh):
+    cache, state = tp_gen.init_slot_state(4, 128)
+    k = cache["layers"]["0"]["k"]
+    assert k.shape[2] == CFG.num_kv_heads
+    shard = k.addressable_shards[0].data
+    # kv-head dim split 2-way over tensor; every other dim intact
+    assert shard.shape[2] * 2 == k.shape[2]
+    assert shard.shape[0] == k.shape[0] and shard.shape[1] == k.shape[1]
+    # sampler state must stay replicated: every shard is the full leaf
+    for leaf in jax.tree.leaves(state):
+        assert leaf.addressable_shards[0].data.shape == leaf.shape
+
+
+def test_int8_pool_scales_shard_head_dim(tp_gen):
+    pool, _ = tp_gen.init_paged_state(4, 32, 16, "int8")
+    layer = pool["layers"]["0"]
+    ks = layer["k_scale"]
+    assert ks.addressable_shards[0].data.shape[1] * 2 == ks.shape[1]
+    kq = layer["k"]
+    assert kq.addressable_shards[0].data.shape[2] * 2 == kq.shape[2]
+
+
+def test_make_tp_mesh_warns_on_kv_head_fallback():
+    # tiny has 2 kv heads: tp=4 cannot shard them and must say so (weights
+    # still shard — head replication is a capacity statement, not an error)
+    if jax.device_count() < 4:
+        pytest.skip("needs 4 devices")
+    with pytest.warns(UserWarning, match="head replication"):
+        make_tp_mesh(4, CFG)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        make_tp_mesh(2, CFG)  # divides: silent
+
+
+# ------------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize(
+    "paged,kw",
+    [
+        (False, {}),
+        (True, {}),
+        (False, {"speculative_k": 2}),
+        (True, {"speculative_k": 2}),
+        (True, {"kv_quant": "int8"}),
+    ],
+    ids=["dense", "paged", "dense-spec", "paged-spec", "paged-int8"],
+)
+def test_tp_engine_greedy_bit_identical_zero_recompiles(
+    base_params, solo_gen, tp_gen, paged, kw
+):
+    cfg = GREEDY
+    if kw.get("speculative_k"):
+        cfg = GenerationConfig(
+            max_new_tokens=12, do_sample=False, speculative_lookup=2
+        )
+    ref_eng = _make_engine(solo_gen, paged, **kw)
+    ref = _serve_all(ref_eng, cfg)
+
+    eng = _make_engine(tp_gen, paged, **kw)
+    got = _serve_all(eng, cfg)  # warms cold AND prefix-hit paths
+    eng.mark_compile_warm()
+    # the ledger is the (module-shared) generator's: assert on the DELTA
+    recompiles0 = eng.compile_ledger.recompiles_after_warmup
+    got += _serve_all(eng, cfg)
+    assert got[:6] == ref and got[6:] == ref
+    assert eng.compile_ledger.recompiles_after_warmup == recompiles0
+
+
+def test_tp_adapter_rows_match_merged_solo(base_params, tp_gen, mesh, tmp_path):
+    """A resident LoRA adapter decoding on the tp=2 engine (pool leaves
+    placed under the mesh rules) emits the merged-weights solo tokens."""
+    _make_adapter(base_params, str(tmp_path / "t1"), seed=1, rank=4)
+    reg = AdapterRegistry(
+        tp_gen.params, str(tmp_path), max_adapters=4, mesh=mesh
+    )
+    eng = _make_engine(tp_gen, True, adapters=reg)
+    merged = Generator(
+        merge_lora(
+            load_lora_adapter(base_params, os.path.join(str(tmp_path), "t1"))
+        ),
+        CFG, TOK, compute_dtype=jnp.float32, eos_token_ids=[],
+    )
+    for p in _prompts():
+        ref = merged.generate_ids(p, GREEDY)
+        got = eng.submit_full(p, GREEDY, timeout=240, adapter="t1").result
+        assert got == ref
+    # base rows co-batch through pool slot 0 bit-identically too
+    base_ref = Generator(
+        base_params, CFG, TOK, compute_dtype=jnp.float32, eos_token_ids=[]
+    ).generate_ids(_prompts()[0], GREEDY)
+    assert eng.submit_full(_prompts()[0], GREEDY, timeout=240).result == base_ref
+
+
+def test_tp_hot_swap_bit_identical_zero_recompiles(base_params, tp_gen):
+    """A weight hot-swap on the sharded engine re-places updates over the
+    resident NamedSharding (not plain device_put to one chip) and keeps
+    the warm jit caches: post-swap greedy equals a from-scratch engine on
+    the swapped weights, with zero recompiles across the swap."""
+    eng = _make_engine(tp_gen, False)
+    _serve_all(eng)
+    eng.mark_compile_warm()
+    recompiles0 = eng.compile_ledger.recompiles_after_warmup
+    new_embed = (
+        np.asarray(base_params["model"]["embed_tokens"]["weight"]) * 1.25
+    )
+    eng.request_weight_swap(
+        {"model/embed_tokens/weight": new_embed}, fingerprint="x", timeout=240
+    )
+    got = _serve_all(eng)
+    assert eng.compile_ledger.recompiles_after_warmup == recompiles0
+    swapped = dict(base_params)
+    swapped["model"] = dict(base_params["model"])
+    swapped["model"]["embed_tokens"] = {"weight": jnp.asarray(new_embed)}
+    ref_gen = Generator(
+        swapped, CFG, TOK, compute_dtype=jnp.float32, eos_token_ids=[]
+    )
+    assert got == _serve_all(_make_engine(ref_gen, False))
+
+
+def test_tp_preempt_resume_bit_identical(tp_gen):
+    """KV-pressure preemption + resume on the sharded paged engine: the
+    preempted greedy victim's full token list equals the uninterrupted
+    solo run (banked tokens + re-prefilled suffix over sharded pools)."""
+    eng = PagedContinuousBatchingEngine(
+        tp_gen, slots=2, buf_len=256, prompt_bucket=64, block_len=16,
+        prefill_chunk=64,
+    )
+    prompt = _enc("preempt me please")
+    victim_cfg = GenerationConfig(max_new_tokens=48, do_sample=False)
+    solo = tp_gen.generate_ids(prompt, victim_cfg)
+    sampled = GenerationConfig(max_new_tokens=64, do_sample=True, temperature=1.0)
+    # warm everything the dance touches
+    eng.submit(prompt, victim_cfg, priority="best_effort", timeout=240)
+    eng.submit(_enc("interactive warm"), sampled, seed=3, timeout=240)
+
+    occupier = threading.Thread(
+        target=lambda: eng.submit(
+            _enc("long sampled occupier"), sampled, seed=9, timeout=240
+        )
+    )
+    occupier.start()
+    deadline = 240
+    import time as _t
+
+    t0 = _t.monotonic()
+    while eng.live_slots < 1 and _t.monotonic() - t0 < deadline:
+        _t.sleep(0.005)
+    stream = eng.stream(
+        prompt, victim_cfg, priority="best_effort", timeout=240
+    )
+    tokens = [next(stream), next(stream)]  # victim is decoding
+    trigger = threading.Thread(
+        target=lambda: eng.submit(
+            _enc("interactive arrival"),
+            GenerationConfig(max_new_tokens=8, do_sample=True, temperature=1.0),
+            seed=4, timeout=240,
+        )
+    )
+    trigger.start()
+    tokens.extend(stream)
+    trigger.join()
+    occupier.join()
+    assert tokens == solo
+    assert eng.stats_snapshot()["preemptions"] >= 1

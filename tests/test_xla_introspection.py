@@ -449,8 +449,10 @@ def test_profiler_capture_busy_and_autostop(tmp_path):
     second = cap.start(0.05)
     assert second != trace_dir
     # generous: under full-suite load stop_trace serializes TraceMe events
-    # from every still-ticking engine fixture and can take seconds
-    deadline = time.monotonic() + 30.0
+    # from every still-ticking engine fixture — on a starved single-core
+    # runner ONE stop_trace has been observed to take ~60s, so the budget
+    # must cover a full serialization, not just scheduler jitter
+    deadline = time.monotonic() + 120.0
     while cap.active is not None and time.monotonic() < deadline:
         time.sleep(0.01)
     assert cap.active is None  # the timer auto-stopped it
