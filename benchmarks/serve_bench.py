@@ -59,10 +59,19 @@ TTFT after recovery; SERVE_CHAOS_CLIENTS=8), SERVE_SPEC=1 (speculative arm;
 SERVE_SPEC_K=4, SERVE_SPEC_CLIENTS=16), SERVE_FLEET=1 (fleet arm;
 SERVE_FLEET_CLIENTS=8), SERVE_TENANTS=4 (multi-tenant arm tenant count; 0
 disables; SERVE_TENANT_REQS=8 requests per tenant), SERVE_COMPILES=1
-(zero-recompile assertion arm: warm the full spec+adapters+paged workload,
+(zero-recompile assertion arm: warm the full spec+adapters+paged workload
+— including a host-tier spill -> evict -> restore cycle and an
+export/adopt migration hop, so the tiered-KV paths ride the same gate —
 mark the compile ledger warm, re-run it, exit nonzero on ANY post-warmup
 recompile; with >= 2 devices the arm re-runs the speculative paged
 workload on a tp=2 mesh engine and gates its ledger too),
+SERVE_MIGRATE=1 (migration arm: retire a replica of a 2-replica fleet
+MID-TRAFFIC with live greedy streams on it, once draining — the baseline,
+retirement waits out the longest request — and once migrating through the
+shared host tier; exits nonzero unless every stream completes
+bit-identical to solo generate_ids with zero drops, nothing recompiles
+after warmup, and the migrated retirement's wall-clock stays under 25% of
+the drain-wait baseline; SERVE_MIGRATE_MAX_NEW=160),
 SERVE_SHARDED=1 (sharded arm: the same all-greedy workload on a tp=1 and
 a tp=SERVE_SHARDED_TP=4 paged engine at equal slots, served twice around
 a weight hot-swap; exits nonzero unless the sharded outputs bit-match
@@ -984,9 +993,15 @@ def main():
         registry = AdapterRegistry(
             params, adapter_root, max_adapters=len(tenant_names) + 1
         )
+        from llm_fine_tune_distributed_tpu.infer.paged import HostBlockTier
+        from llm_fine_tune_distributed_tpu.infer.sampling import (
+            GenerationConfig,
+        )
+
         paged_spec = PagedContinuousBatchingEngine(
             fresh_gen, slots=4, buf_len=256, prompt_bucket=32, block_len=32,
             prefill_chunk=64, speculative_k=spec_k,
+            host_tier=HostBlockTier(128 << 20),
         )
         dense_adapters = ContinuousBatchingEngine(
             fresh_gen, slots=4, buf_len=256, prompt_bucket=32,
@@ -1013,8 +1028,35 @@ def main():
                     prompt, gen, seed=seed, timeout=600,
                     adapter=tenant_names[j % len(tenant_names)],
                 )
+            # tiered-KV cycle: spill every cached block to the host tier,
+            # drop the HBM copies, and resubmit — admission must RESTORE
+            # (device scatter), not re-prefill; then export a mid-decode
+            # stream and adopt it back, the slot-migration hop. None of it
+            # may retrace after warmup.
+            prompt, _, seed = paged_load[0]
+            dropped = []
+            paged_spec._prefix.evict(paged_spec._num_blocks, collect=dropped)
+            paged_spec._spill_to_tier(dropped)
+            tier_cfg = GenerationConfig(max_new_tokens=48, do_sample=False)
+            paged_spec.submit(prompt, tier_cfg, seed=seed, timeout=600)
+            stream = paged_spec.stream(prompt, tier_cfg, seed=seed, timeout=600)
+            next(stream)
+            for req in paged_spec.export_requests(timeout=60):
+                paged_spec.adopt_request(req)
+            for _ in stream:
+                pass
 
         _compile_pass()  # warmup: every (program, shapes) compiles here
+        # the spill/restore block counts above depend on eviction timing, so
+        # pin EVERY gather/scatter bucket the pool can express (pow2 up to
+        # the pool size) against NULL_BLOCK rows — reading block 0 is free
+        # and writing its own zeros back preserves the null-block invariant
+        n = 1
+        while n <= paged_spec._block_bucket(paged_spec._num_blocks - 1):
+            paged_spec._scatter_blocks(
+                [0] * n, paged_spec._gather_blocks([0] * n)
+            )
+            n *= 2
         paged_spec.mark_compile_warm()  # shared ledger: one call marks both
         _compile_pass()  # steady state: must not compile anything new
         comp = paged_spec.stats_snapshot()["compile"]
@@ -1060,6 +1102,109 @@ def main():
             "compiles_total": comp["total_compiles"],
             "compile_seconds_total": comp["total_compile_s"],
             "programs": sorted(comp["programs"]),
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+        if not ok:
+            sys.exit(1)
+
+    # migration arm: retire a replica of a 2-replica fleet MID-TRAFFIC with
+    # live greedy streams on it, twice — once draining (the baseline:
+    # retirement waits out the longest request) and once migrating (export
+    # -> shared host tier -> the sibling adopts; the SAME stream iterators
+    # keep yielding). Four gates: zero drops, every stream bit-identical to
+    # solo generate_ids ACROSS the migration, zero post-warmup recompiles,
+    # and the migrated retirement's wall-clock under 25% of the drain-wait
+    # baseline — retirement must cost O(blocks moved), not O(longest
+    # request remaining).
+    if os.environ.get("SERVE_MIGRATE", "1") == "1":
+        from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
+        from llm_fine_tune_distributed_tpu.infer.paged import HostBlockTier
+        from llm_fine_tune_distributed_tpu.infer.sampling import (
+            GenerationConfig,
+        )
+
+        mig_gen = Generator(
+            params, mc, ByteChatMLTokenizer(), compute_dtype=dtype,
+            eos_token_ids=[],
+        )
+        mig_tier = HostBlockTier(256 << 20)
+        mig_new = int(os.environ.get("SERVE_MIGRATE_MAX_NEW", "160"))
+        mig_rng = np.random.RandomState(13)
+        mig_cfg = GenerationConfig(max_new_tokens=mig_new, do_sample=False)
+        mig_prompts = [
+            mig_rng.randint(0, min(mc.vocab_size, 256), (64,)).tolist()
+            for _ in range(4)
+        ]
+        mig_solo = [mig_gen.generate_ids(p, mig_cfg) for p in mig_prompts]
+
+        def _mig_fleet():
+            return EngineFleet(
+                [
+                    PagedContinuousBatchingEngine(
+                        mig_gen, slots=4, buf_len=256, prompt_bucket=32,
+                        block_len=32, prefill_chunk=64, host_tier=mig_tier,
+                    )
+                    for _ in range(2)
+                ],
+                routing="prefix",
+                migrate_on_retire=True,
+            )
+
+        def _mig_run(migrate):
+            fleet = _mig_fleet()
+            streams = [
+                fleet.stream(p, mig_cfg, timeout=600) for p in mig_prompts
+            ]
+            outs = [[next(s)] for s in streams]  # first token: all live
+            rid = max(
+                fleet.replica_items(), key=lambda kv: kv[1].live_slots
+            )[0]
+            t0 = time.monotonic()
+            fleet.retire_replica(rid=rid, timeout_s=600, migrate=migrate)
+            wall = time.monotonic() - t0
+            for out, s in zip(outs, streams):
+                out.extend(s)
+            moved = sum(
+                rep.stats_snapshot()["slots_migrated"]
+                for rep in fleet.replicas
+            )
+            return wall, outs, moved, fleet
+
+        _mig_run(True)  # warmup: compiles the whole path, migration included
+        warm_eng = _mig_fleet().replicas[0]
+        n = 1
+        while n <= warm_eng._block_bucket(warm_eng._num_blocks - 1):
+            # pin every spill/restore bucket regardless of how many blocks
+            # a given export happens to move (NULL rows: free + harmless)
+            warm_eng._scatter_blocks([0] * n, warm_eng._gather_blocks([0] * n))
+            n *= 2
+        warm_eng.mark_compile_warm()  # ledger is per-Generator: marks all
+
+        drain_wall, drain_outs, _, _ = _mig_run(False)
+        mig_wall, mig_outs, mig_moved, mig_fleet = _mig_run(True)
+        comp = mig_fleet.replicas[0].stats_snapshot()["compile"]
+        exact = sum(o == s for o, s in zip(mig_outs, mig_solo))
+        ok = (
+            exact == len(mig_prompts)
+            and all(o == s for o, s in zip(drain_outs, mig_solo))
+            and mig_moved >= 1
+            and comp["recompiles_after_warmup"] == 0
+            and mig_wall < 0.25 * drain_wall
+        )
+        print(json.dumps({
+            "metric": "serve_migrate_retirement_guard",
+            "value": 1 if ok else 0,
+            "unit": "1 = zero drops + greedy parity across migration + "
+                    "zero recompiles + retirement < 25% of drain-wait",
+            "drain_wall_s": round(drain_wall, 3),
+            "migrate_wall_s": round(mig_wall, 3),
+            "retirement_speedup": round(drain_wall / max(mig_wall, 1e-9), 1),
+            "slots_migrated": mig_moved,
+            "streams_bit_identical": exact,
+            "streams": len(mig_prompts),
+            "recompiles_after_warmup": comp["recompiles_after_warmup"],
+            "host_tier_bytes": mig_tier.bytes_used,
             "model": preset,
             "platform": jax.devices()[0].platform,
         }), flush=True)

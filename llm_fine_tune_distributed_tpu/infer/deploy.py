@@ -359,6 +359,17 @@ class HotSwapManager:
         canary_verdict: Optional[Dict[str, Any]] = None
         try:
             for i, eng in enumerate(self.engines):
+                # with fleet live migration enabled, empty this replica's
+                # slots onto siblings first: the swap's drained-tick
+                # boundary then arrives in O(blocks shipped) instead of
+                # stalling behind its longest stream. Best-effort — any
+                # failure just means the swap drains the old way.
+                evacuate = getattr(self._target, "evacuate_replica", None)
+                if evacuate is not None:
+                    try:
+                        evacuate(eng)
+                    except Exception:  # noqa: BLE001 — drain-wait fallback
+                        pass
                 results.append(
                     eng.request_weight_swap(
                         weights, fingerprint=fingerprint, step=step,

@@ -76,7 +76,7 @@ import queue
 import threading
 import time
 from collections import deque
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -99,6 +99,7 @@ from llm_fine_tune_distributed_tpu.infer.errors import (
 from llm_fine_tune_distributed_tpu.infer.paged import (
     NULL_BLOCK,
     BlockAllocator,
+    HostBlockTier,
     PrefixCache,
 )
 from llm_fine_tune_distributed_tpu.infer.sampling import (
@@ -175,6 +176,24 @@ class _PendingSwap:
         self.step = step
         self.done = threading.Event()
         self.result: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+
+
+class _PendingExport:
+    """One staged request evacuation (live slot migration, infer/fleet.py):
+    a completion latch the fleet blocks on while the engine worker detaches
+    every in-flight and queued request at its next tick boundary. Unlike a
+    ``_PendingSwap`` it does NOT wait for live slots to drain — emptying
+    them without waiting is the point. ``result`` (the detached Request
+    list) or ``error`` is set before ``done``; on error every
+    already-detached request has been re-adopted locally, so the caller can
+    always fall back to plain drain-wait."""
+
+    __slots__ = ("done", "result", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result: Optional[List["Request"]] = None
         self.error: Optional[BaseException] = None
 
 
@@ -492,6 +511,11 @@ class ContinuousBatchingEngine:
         self._swap_pending: Optional[_PendingSwap] = None
         self._weight_generation = 0
         self._weight_fingerprint: Optional[str] = None
+        # live slot migration (infer/fleet.py): at most one staged request
+        # evacuation, applied by the worker at its next tick boundary —
+        # unlike a hot-swap it does NOT wait for live slots to drain
+        self._export_lock = threading.Lock()
+        self._export_pending: Optional[_PendingExport] = None
         # observability: bounded event ring the supervisor dumps on
         # crash/circuit-open, optional JSONL export of settled request
         # traces, and a monotonically increasing request id. The tick
@@ -814,6 +838,61 @@ class ContinuousBatchingEngine:
                 f"{type(swap.error).__name__}: {swap.error}"
             ) from swap.error
         return dict(swap.result)
+
+    def export_requests(self, timeout: Optional[float] = None) -> List[Request]:
+        """Evacuate EVERY in-flight and queued request off this engine and
+        return them, still unresolved, for a sibling replica to adopt
+        (fleet live slot migration, infer/fleet.py). Blocks until the
+        worker applies the export at its next tick boundary — unlike a
+        hot-swap nothing waits for live slots to drain; that is the whole
+        point (retirement in O(blocks), not O(longest request)).
+
+        Each returned request has its generated-so-far tokens banked in
+        ``preempted_tokens`` (its KV blocks spilled to the shared host
+        tier when one is configured), its engine-side bookkeeping undone,
+        and its waiter/stream still attached — whoever adopts it settles
+        it exactly once. On any mid-export failure the already-detached
+        requests are re-adopted locally and the caller sees a RuntimeError:
+        the engine falls back to plain drain-wait, never a dropped request.
+        """
+        if self._terminal is not None:
+            raise self._terminal
+        exp = _PendingExport()
+        with self._export_lock:
+            if self._export_pending is not None:
+                raise RuntimeError("an export is already staged on this engine")
+            self._export_pending = exp
+        self.recorder.record(
+            "export_begin",
+            live=int(self._live.sum()),
+            queued=self._queue_len(),
+        )
+        self._q.put(_SWAP_POKE)  # wake an idle worker parked on the queue
+        if not exp.done.wait(timeout):
+            raise TimeoutError(f"request export not applied within {timeout}s")
+        if exp.error is not None:
+            raise RuntimeError(
+                "request export failed; the engine re-adopted its requests: "
+                f"{type(exp.error).__name__}: {exp.error}"
+            ) from exp.error
+        return list(exp.result or [])
+
+    def adopt_request(self, req: Request) -> None:
+        """Accept a request exported from a sibling replica (or re-adopt a
+        locally exported one after a failed migration). Deliberately
+        bypasses the admission gates (draining/brownout/overflow/deadline):
+        the request was already admitted once — this is a continuation, not
+        a new arrival — and refusing it here would strand its waiter. The
+        resume path re-prefills whatever the host tier cannot restore, so
+        greedy output stays bit-identical to an uninterrupted run."""
+        if self._terminal is not None:
+            raise self._terminal
+        self._attach_request(req)
+        req.trace.mark("migrated")
+        self.recorder.record(
+            "adopt", request=req.id, tokens_banked=len(req.preempted_tokens)
+        )
+        self._q.put(req)
 
     def predicted_drain_s(self) -> float:
         """Public Retry-After estimate: seconds until this replica's current
@@ -1535,6 +1614,7 @@ class ContinuousBatchingEngine:
         # _terminal was set and enqueued afterwards — resolve those too
         while True:
             self._resolve_swap_terminal()
+            self._resolve_export_terminal()
             self._fail_queued(self._terminal)
             req = self._q.get()
             if req is _SWAP_POKE:
@@ -1586,6 +1666,11 @@ class ContinuousBatchingEngine:
             step = self._generator.slot_step(self._slots, self._buf_len)
             decode = lambda: self._decode_once(step)  # noqa: E731
         while True:
+            if self._export_pending is not None:
+                # migration export applies IMMEDIATELY — evacuating live
+                # slots is the point (and it unblocks any staged swap by
+                # emptying the slots it was waiting on)
+                self._apply_export()
             if self._swap_pending is not None:
                 # hot-swap staged: admission pauses (queued requests start on
                 # the NEW generation), live slots finish on the old one, and
@@ -1696,6 +1781,136 @@ class ContinuousBatchingEngine:
             swap.error = self._terminal
             swap.done.set()
 
+    def _resolve_export_terminal(self) -> None:
+        """Fail a staged export with the terminal error so the migrating
+        fleet call never hangs (it falls back to drain-wait, which the
+        terminal engine resolves by failing everything fast)."""
+        with self._export_lock:
+            exp, self._export_pending = self._export_pending, None
+        if exp is not None:
+            exp.error = self._terminal
+            exp.done.set()
+
+    def _apply_export(self) -> None:
+        """Evacuate every in-flight and queued request (worker thread only).
+
+        Per slot: bank the generated-so-far tokens preempt-style (the paged
+        engine also spills the slot's ingested KV blocks to the host tier),
+        free the slot, undo the request's engine-side bookkeeping
+        (``_detach_request``), and hand it to the exporter. Queued waiters
+        just detach. The migrate fault point fires BEFORE each request is
+        touched, so any injected (or real) mid-export failure leaves every
+        request either fully exported or fully resident — the except arm
+        re-adopts the exported ones locally and the caller falls back to
+        drain-wait. Either way each request still has exactly one pending
+        settle ahead of it, on exactly one engine."""
+        exp = self._export_pending
+        assert exp is not None
+        exported: List[Request] = []
+        try:
+            self._drain_queue()
+            for slot in range(self._slots):
+                req = self._slot_req[slot]
+                if req is None:
+                    continue
+                if req.abandoned:
+                    self._forget_prefill(slot)
+                    self._settle_abandoned(req)
+                    self._release(slot)
+                    continue
+                self.faults.maybe_fail_migrate()
+                self._bank_and_spill(slot, req)
+                self._release(slot)
+                self._detach_request(req)
+                exported.append(req)
+            while self._waiting:
+                req = self._waiting.popleft()
+                if req.done.is_set():
+                    continue
+                if self._pre_admit_resolve(req):
+                    continue
+                self.faults.maybe_fail_migrate()
+                self._detach_request(req)
+                exported.append(req)
+            exp.result = exported
+            self.recorder.record("export", requests=len(exported))
+        except BaseException as e:  # noqa: BLE001 — reported to the caller
+            for req in exported:
+                try:
+                    self._attach_request(req)
+                    self._waiting.append(req)
+                except BaseException as attach_err:  # noqa: BLE001
+                    # re-adopt failed (e.g. adapter pool now full): the pin
+                    # was already released, so balance the ledger by hand
+                    # and fail the waiter rather than hang it
+                    req.adapter = None
+                    with self._plock:
+                        self._pending += 1
+                    self._resolve_error(req, attach_err)
+            exp.error = e
+            self.recorder.record(
+                "export_failed",
+                error=f"{type(e).__name__}: {e}",
+                readopted=len(exported),
+            )
+        finally:
+            with self._export_lock:
+                self._export_pending = None
+            exp.done.set()
+
+    def _attach_request(self, req: Request) -> None:
+        """Take over an exported request: re-acquire its adapter pin and
+        re-enter it into this engine's pending/tenant ledgers. The inverse
+        of ``_detach_request``; tenant ``requests`` is NOT re-counted — the
+        request was counted once at its original admission."""
+        if req.adapter is not None:
+            if self._mt is None:
+                raise UnknownAdapterError(
+                    f"adapter {req.adapter!r} not available on the adopting "
+                    "engine (no adapter registry)"
+                )
+            req.adapter_idx = int(self._mt.acquire(req.adapter))
+            with self._plock:
+                self._tenant_inflight[req.adapter] = (
+                    self._tenant_inflight.get(req.adapter, 0) + 1
+                )
+            self.stats.tenant_incr(req.adapter, "queue_depth")
+        else:
+            req.adapter_idx = 0
+        with self._plock:
+            self._pending += 1
+
+    def _detach_request(self, req: Request) -> None:
+        """Remove an exported request from this engine's ledgers WITHOUT
+        settling it — its waiter stays attached and unresolved, and the
+        adopting engine's ``_attach_request`` re-enters it there."""
+        with self._plock:
+            self._pending -= 1
+            if req.adapter is not None:
+                n = self._tenant_inflight.get(req.adapter, 1) - 1
+                if n <= 0:
+                    self._tenant_inflight.pop(req.adapter, None)
+                else:
+                    self._tenant_inflight[req.adapter] = n
+        if req.adapter is not None:
+            self.stats.tenant_incr(req.adapter, "queue_depth", -1)
+            if self._mt is not None:
+                self._mt.release(req.adapter)
+
+    def _bank_and_spill(self, slot: int, req: Request) -> None:
+        """Bank a migrating slot's generated-so-far tokens on the request
+        (preempt-style, but NOT counted as a preemption — nothing was
+        displaced). The paged engine overrides to also spill the slot's
+        ingested blocks to the host tier so the adopting replica restores
+        instead of re-prefilling."""
+        req.preempted_tokens.extend(self._slot_tokens[slot])
+
+    def _forget_prefill(self, slot: int):
+        """Drop (and return) the pending prefill task occupying ``slot``,
+        if any — the dense engine prefills synchronously and has none; the
+        paged engine overrides."""
+        return None
+
     def _recover(self, cause: BaseException) -> bool:
         """Classify a worker failure; True = state rebuilt, serve again."""
         if self._watchdog is not None:
@@ -1758,6 +1973,7 @@ class ContinuousBatchingEngine:
         err.__cause__ = cause
         self._terminal = err  # set BEFORE resolving, so waiters see it
         self._resolve_swap_terminal()  # a staged swap must not hang its waiter
+        self._resolve_export_terminal()  # nor a staged export its fleet caller
         reason = "circuit_open" if sup.circuit_open else "fatal"
         self.recorder.record(reason, error=str(err))
         dump = sup.dump_flight(
@@ -2296,6 +2512,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # slot's LAST real block (models/transformer.py), corrupting live KV.
         spec_k = max(0, int(kwargs.get("speculative_k", 0) or 0))
         self._kv_quant = str(kwargs.pop("kv_quant", "none"))
+        # host-RAM tier behind the HBM pool (paged.HostBlockTier), SHARED
+        # across fleet replicas — that sharing is the migration transport.
+        # None disables spill/restore (eviction degrades to plain discard).
+        self._host_tier = kwargs.pop("host_tier", None)
         slack = max(bucket, spec_k + 1) if spec_k else bucket
         self._table_blocks = -(-(int(buf_len) + slack) // self._block_len)
         self._prefill_chunk = max(1, int(prefill_chunk))
@@ -2361,6 +2581,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _serve_loop(self) -> None:
         while True:
+            if self._export_pending is not None:
+                # migration export applies IMMEDIATELY (evacuating live and
+                # prefilling slots is the point), and by emptying the slots
+                # it lets any staged swap land on the very next check
+                self._apply_export()
             if self._swap_pending is not None:
                 # hot-swap staged: no new admissions; in-progress prefills
                 # and live slots finish on the old generation, then the swap
@@ -2398,6 +2623,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         again as post-swap traffic rebuilds them against the new weights)."""
         dropped = len(self._prefix)
         self._prefix.evict(self._num_blocks)
+        if dropped:
+            # NOT spilled: the whole point is that this KV is stale. The
+            # host tier's fingerprint stamps make its old entries unmatched
+            # after the swap anyway; these just count as discards.
+            self.stats.incr("prefix_blocks_discarded", dropped)
         self.recorder.record("prefix_cache_invalidated", entries=dropped)
 
     def _admit(self) -> None:
@@ -2482,6 +2712,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # cap: >= 1 suffix token must prefill (the first sampled token needs
         # the last prompt token's logits)
         shared = self._prefix.match(keys, (plen - 1) // L)
+        shared = self._restore_shared(req, keys, shared, (plen - 1) // L)
         shared_len = len(shared) * L
         _, _, _, write_end = self._chunk_plan(plen, shared_len)
         # speculation headroom: a verify tick at the last in-budget position
@@ -2503,7 +2734,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         nprivate = total - len(shared)
         private = self._allocator.alloc(nprivate)
         if private is None:
-            self._prefix.evict(nprivate)
+            dropped: List[Tuple[bytes, int]] = []
+            self._prefix.evict(nprivate, collect=dropped)
+            self._spill_to_tier(dropped)
             self.recorder.record(
                 "prefix_evict", request=req.id, blocks_needed=nprivate
             )
@@ -2796,7 +3029,218 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         if full > 0:
             keys = self._prefix.block_keys(ctx)
             self._prefix.insert(keys[:full], self._slot_blocks[slot][:full])
+            # also spill the banked blocks to the host tier NOW: under the
+            # very pressure that caused this preemption, LRU will likely
+            # reclaim them from HBM before the resume — the host copy turns
+            # that resume back into restore-then-decode
+            self._spill_to_tier(
+                list(zip(keys[:full], self._slot_blocks[slot][:full]))
+            )
         super()._preempt_slot(slot)
+
+    def _forget_prefill(self, slot: int):
+        for i, task in enumerate(self._prefills):
+            if task.slot == slot:
+                return self._prefills.pop(i)
+        return None
+
+    def _bank_and_spill(self, slot: int, req: Request) -> None:
+        """Migration export: bank tokens, then spill every INGESTED full
+        block to the shared host tier so the adopting replica restores
+        instead of re-prefilling. A still-prefilling slot has ingested
+        exactly ``task.next`` positions (everything past that is unwritten
+        garbage — spilling it would corrupt the restore); a live slot has
+        everything but the last emitted token's KV."""
+        task = self._forget_prefill(slot)
+        if task is not None:
+            ingested = task.next
+        else:
+            ingested = (
+                self._slot_plen[slot] + len(self._slot_tokens[slot]) - 1
+            )
+        super()._bank_and_spill(slot, req)
+        ctx = list(req.prompt) + list(req.preempted_tokens)
+        full = ingested // self._block_len
+        if full > 0:
+            keys = self._prefix.block_keys(ctx)[:full]
+            blocks = self._slot_blocks[slot][:full]
+            # local second chance too: a failed migration readopts here and
+            # the resume re-matches these from HBM without touching the tier
+            self._prefix.insert(keys, blocks)
+            self._spill_to_tier(list(zip(keys, blocks)))
+
+    # ------------------------------------------------------- host tier
+    # (docs/architecture.md "Tiered KV and live slot migration")
+
+    @staticmethod
+    def _block_bucket(n: int) -> int:
+        """Power-of-two bucket over a transfer's block count, so the
+        gather/scatter programs compile once per bucket (SERVE_COMPILES
+        guards the spill/restore paths like any other hot path)."""
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _tier_ready(self) -> bool:
+        """Spill/restore preconditions: a tier is configured, the generator
+        exposes the block gather/scatter programs (stub generators in unit
+        tests may not), and this engine is not a multihost tick bridge
+        leader (block contents live sharded across processes there — a
+        host round-trip through process 0 would be wrong)."""
+        return (
+            self._host_tier is not None
+            and self._bridge is None
+            and hasattr(self._generator, "paged_block_gather")
+            and hasattr(self._generator, "paged_block_scatter")
+        )
+
+    def _gather_blocks(self, bids: List[int]) -> List[List[np.ndarray]]:
+        """Copy ``bids``'s pool rows to host: one list of per-leaf arrays
+        per block, in ``jax.tree_util`` flatten order (int8 code + scale
+        siblings travel together by construction)."""
+        import jax
+
+        n = len(bids)
+        bucket = self._block_bucket(n)
+        ids = np.full((bucket,), NULL_BLOCK, np.int32)
+        ids[:n] = bids
+        out = jax.device_get(
+            self._generator.paged_block_gather(bucket)(self._cache, ids)
+        )
+        leaves = jax.tree_util.tree_leaves(out)
+        return [[np.asarray(leaf[i]) for leaf in leaves] for i in range(n)]
+
+    def _scatter_blocks(
+        self, bids: List[int], entries: List[List[np.ndarray]]
+    ) -> None:
+        """Write host-tier ``entries`` into pool rows ``bids``. Pad rows
+        (bucket slack) scatter ZEROS into the NULL block — for int8 pools
+        the null block's zero codes AND zero scales are an invariant the
+        attention masks rely on, so the padding must preserve it."""
+        import jax
+
+        n = len(bids)
+        bucket = self._block_bucket(n)
+        ids = np.full((bucket,), NULL_BLOCK, np.int32)
+        ids[:n] = bids
+        leaves, treedef = jax.tree_util.tree_flatten(self._cache)
+        if any(len(e) != len(leaves) for e in entries):
+            raise RuntimeError(
+                "host-tier entry layout does not match this pool "
+                "(leaf count mismatch)"
+            )
+        updates = []
+        for j, leaf in enumerate(leaves):
+            rows = np.zeros(
+                (bucket,) + tuple(leaf.shape[1:]), dtype=entries[0][j].dtype
+            )
+            for i in range(n):
+                rows[i] = entries[i][j]
+            updates.append(rows)
+        self._cache = self._generator.paged_block_scatter(bucket)(
+            self._cache, ids, jax.tree_util.tree_unflatten(treedef, updates)
+        )
+
+    def _spill_to_tier(self, pairs: List[Tuple[bytes, int]]) -> None:
+        """Copy the named blocks' DEVICE contents into the host tier before
+        their ids can be reallocated (the caller guarantees the single
+        worker thread dispatches no overwriting write first). Every block
+        that does not land in the tier counts as a discard — a failed or
+        refused spill degrades to today's plain eviction, never an error."""
+        if not pairs:
+            return
+        if not self._tier_ready():
+            self.stats.incr("prefix_blocks_discarded", len(pairs))
+            return
+        try:
+            self.faults.maybe_fail_spill()
+            arrays = self._gather_blocks([bid for _, bid in pairs])
+            spilled = 0
+            for (key, _), rows in zip(pairs, arrays):
+                if self._host_tier.put(
+                    key, rows, fingerprint=self._weight_fingerprint
+                ):
+                    spilled += 1
+            if spilled:
+                self.stats.incr("prefix_blocks_spilled", spilled)
+            if spilled < len(pairs):
+                self.stats.incr("prefix_blocks_discarded", len(pairs) - spilled)
+            self.recorder.record("spill", blocks=spilled)
+        except Exception as e:  # noqa: BLE001 — spill is best-effort
+            self.stats.incr("prefix_blocks_discarded", len(pairs))
+            self.recorder.record(
+                "spill_failed",
+                blocks=len(pairs),
+                error=f"{type(e).__name__}: {e}",
+            )
+
+    def _restore_shared(
+        self, req: Request, keys: List[bytes], shared: List[int], cap: int
+    ) -> List[int]:
+        """Extend an admission's prefix-cache ``match`` run with blocks
+        restored from the host tier (device scatter back into freshly
+        allocated pool rows). Any failure — tier miss, stale fingerprint,
+        no free blocks, injected or real scatter fault — returns what HBM
+        already had and the plan re-prefills the rest: slower, never
+        wrong, greedy bit-identical either way."""
+        if not self._tier_ready():
+            return shared
+        have = len(shared)
+        want = keys[have:cap]
+        if not want:
+            return shared
+        run = self._host_tier.resident_run(
+            want, fingerprint=self._weight_fingerprint
+        )
+        if run == 0:
+            if req.preempted_tokens:
+                # a resume EXPECTED its banked blocks; their absence is the
+                # restore-miss the fallback re-prefill path covers
+                self.stats.incr("host_tier_restore_misses")
+            return shared
+        entries: List[List[np.ndarray]] = []
+        for key in want[:run]:
+            got = self._host_tier.get(
+                key, fingerprint=self._weight_fingerprint
+            )
+            if got is None:
+                break  # concurrently evicted; restore what we still can
+            entries.append(got)
+        if not entries:
+            self.stats.incr("host_tier_restore_misses")
+            return shared
+        blocks = self._allocator.alloc(len(entries))
+        if blocks is None:
+            dropped: List[Tuple[bytes, int]] = []
+            self._prefix.evict(len(entries), collect=dropped)
+            self._spill_to_tier(dropped)
+            blocks = self._allocator.alloc(len(entries))
+        if blocks is None:
+            self.stats.incr("host_tier_restore_misses", len(entries))
+            return shared
+        try:
+            self.faults.maybe_fail_restore()
+            self._scatter_blocks(blocks, entries)
+        except Exception as e:  # noqa: BLE001 — fall back to re-prefill
+            for bid in blocks:
+                self._allocator.free(bid)
+            self.stats.incr("host_tier_restore_misses", len(entries))
+            self.recorder.record(
+                "restore_failed",
+                request=req.id,
+                blocks=len(entries),
+                error=f"{type(e).__name__}: {e}",
+            )
+            return shared
+        # register restored blocks exactly like freshly prefilled ones: the
+        # cache takes its own reference, the plan keeps the alloc reference
+        self._prefix.insert(want[: len(entries)], blocks)
+        self.stats.incr("host_tier_restore_hits", len(entries))
+        self.recorder.record(
+            "restore", request=req.id, blocks=len(entries)
+        )
+        return shared + blocks
 
     def _occupancy(self) -> float:
         return self._allocator.used_count / max(1, self._num_blocks - 1)
@@ -2825,4 +3269,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def stats_snapshot(self) -> dict:
         self.stats.gauge("blocks_in_use", self._allocator.used_count)
         self.stats.gauge("prefix_cache_blocks", len(self._prefix))
+        self.stats.gauge(
+            "host_tier_bytes",
+            self._host_tier.bytes_used if self._host_tier is not None else 0,
+        )
         return super().stats_snapshot()

@@ -136,7 +136,14 @@ class EngineFleet:
         routing: str = "prefix",
         prefix_home_capacity: int = 8192,
         replica_factory=None,
+        migrate_on_retire: bool = False,
     ):
+        # --migrate-on-retire: retire_replica (and the autoscaler's
+        # scale-down / HotSwapManager's per-replica drain) empties a replica
+        # by live-migrating its in-flight requests to siblings through the
+        # shared host tier instead of waiting for them to finish —
+        # retirement in O(blocks), not O(longest request)
+        self.migrate_on_retire = bool(migrate_on_retire)
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         if routing not in ROUTING_POLICIES:
@@ -229,7 +236,12 @@ class EngineFleet:
         self.recorder.record("scale_up", replica=rid, replicas=n)
         return rid, rep
 
-    def retire_replica(self, rid: Optional[int] = None, timeout_s: float = 60.0):
+    def retire_replica(
+        self,
+        rid: Optional[int] = None,
+        timeout_s: float = 60.0,
+        migrate: Optional[bool] = None,
+    ):
         """Shrink the fleet by one replica, gracefully: close the
         replica's admission (the router stops choosing it the moment
         ``draining`` flips), let in-flight work finish via the drain
@@ -237,6 +249,13 @@ class EngineFleet:
         (fleet totals never go backwards), THEN drop it from the map and
         purge its intent-map entries. Defaults to the newest replica.
         Returns the retired id. Refuses to retire the last replica.
+
+        ``migrate`` (None = the fleet's ``migrate_on_retire`` default):
+        before waiting, live-migrate the replica's in-flight and queued
+        requests to siblings through the shared host tier — the drain then
+        completes in O(blocks shipped), not O(longest request), with every
+        stream finishing mid-flight on its new replica. Any migration
+        failure falls back to the plain drain-wait below, never a drop.
 
         On drain timeout the replica is torn down anyway — its waiters
         still hold a reference and settle normally, but tokens they emit
@@ -254,6 +273,16 @@ class EngineFleet:
         # settling its queue) while it drains; _route already excludes
         # draining replicas at decision time
         rep.begin_drain()
+        migrate = self.migrate_on_retire if migrate is None else bool(migrate)
+        migrated = 0
+        if migrate and hasattr(rep, "export_requests"):
+            try:
+                migrated = self._evacuate(rid, rep, timeout_s)
+            except Exception:
+                # Export failure re-adopts every request on the source, so
+                # the plain drain-wait below still settles them all: slower,
+                # never a drop.
+                migrated = 0
         drained = rep.wait_drained(timeout_s)
         self._fold_retired(rep)
         with self._lock:
@@ -267,9 +296,135 @@ class EngineFleet:
                 del self._prefix_home[key]
             n = len(self._by_id)
         self.recorder.record(
-            "scale_down", replica=rid, replicas=n, drained=bool(drained)
+            "scale_down", replica=rid, replicas=n, drained=bool(drained),
+            migrated=migrated,
         )
         return rid
+
+    # ------------------------------------------------------ live migration
+    # (docs/architecture.md "Tiered KV and live slot migration")
+
+    def migrate_slot(
+        self,
+        source_rid: int,
+        target_rid: Optional[int] = None,
+        timeout_s: float = 30.0,
+    ) -> int:
+        """Live-migrate every in-flight and queued request off replica
+        ``source_rid`` onto ``target_rid`` (None = least-loaded sibling per
+        request): the source banks each request's generated-so-far tokens
+        and spills its ingested KV blocks to the shared host tier, the
+        target adopts the request (restore-then-decode — greedy output is
+        bit-identical to the uninterrupted run), and the router re-pins the
+        prefix intent so follow-on same-session traffic lands on the
+        target. Waiters and SSE streams ride along untouched: the Request
+        object (its done event and token queue) is what migrates.
+
+        Returns the number of requests migrated. Raises KeyError on an
+        unknown replica id; an export failure raises RuntimeError after
+        the source has re-adopted its requests (drain-wait semantics)."""
+        with self._lock:
+            if source_rid not in self._by_id:
+                raise KeyError(f"no replica with id {source_rid}")
+            if target_rid is not None and target_rid not in self._by_id:
+                raise KeyError(f"no replica with id {target_rid}")
+            if target_rid == source_rid:
+                raise ValueError("cannot migrate a replica onto itself")
+            source = self._by_id[source_rid]
+        return self._evacuate(
+            source_rid, source, timeout_s, target_rid=target_rid
+        )
+
+    def evacuate_replica(self, engine) -> int:
+        """Best-effort evacuation hook for the rolling hot-swap
+        (infer/deploy.HotSwapManager calls it per replica before staging
+        that replica's swap): with ``migrate_on_retire`` enabled, the
+        replica's live requests migrate to siblings so the swap's
+        drained-tick boundary arrives in O(blocks) instead of stalling
+        behind the longest stream. No-op (returns 0) when migration is
+        disabled, the engine is not one of ours, it has no export support,
+        or there is no sibling to absorb the work."""
+        if not self.migrate_on_retire:
+            return 0
+        for rid, rep in self.replica_items():
+            if rep is engine:
+                if len(self._by_id) <= 1:
+                    return 0
+                if not hasattr(rep, "export_requests"):
+                    return 0
+                try:
+                    return self._evacuate(rid, rep, timeout_s=30.0)
+                except Exception:  # noqa: BLE001 — swap falls back to drain
+                    return 0
+        return 0
+
+    def _evacuate(
+        self,
+        rid: int,
+        source,
+        timeout_s: float,
+        target_rid: Optional[int] = None,
+    ) -> int:
+        """Export the source's requests and adopt each onto a sibling.
+
+        Failure ladder (never a dropped request): an export failure means
+        the source re-adopted everything — re-raise and let the caller
+        drain-wait; a per-request adoption failure tries the next sibling;
+        when every sibling refuses, the SOURCE re-adopts that request and
+        finishes it locally (plain drain). Each request lands on exactly
+        one engine either way, so its single pending settle survives."""
+        exported = source.export_requests(timeout=timeout_s)
+        moved = 0
+        for req in exported:
+            placed = False
+            candidates = []
+            for tid, rep in self.replica_items():
+                if tid == rid or rep is source:
+                    continue
+                if target_rid is not None and tid != target_rid:
+                    continue
+                if not rep.healthy or rep.draining or rep.recovering:
+                    continue
+                if not hasattr(rep, "adopt_request"):
+                    continue
+                candidates.append((rep.queue_depth + rep.live_slots, tid, rep))
+            for _, tid, rep in sorted(candidates, key=lambda c: (c[0], c[1])):
+                try:
+                    rep.adopt_request(req)
+                except Exception:  # noqa: BLE001 — try the next sibling
+                    continue
+                stats = getattr(rep, "stats", None)
+                if stats is not None:
+                    stats.incr("slots_migrated")
+                self._repin_prefix(req, tid)
+                self.recorder.record(
+                    "migrate", request=req.id, source=rid, target=tid
+                )
+                moved += 1
+                placed = True
+                break
+            if not placed:
+                # no sibling could take it: the source finishes it locally
+                # (adopt_request bypasses the draining gate by design)
+                source.adopt_request(req)
+                self.recorder.record(
+                    "migrate_fallback", request=req.id, source=rid
+                )
+        return moved
+
+    def _repin_prefix(self, req, target_rid: int) -> None:
+        """Point the router's prefix intent map at the adopting replica:
+        the migrated session's follow-on requests (same system prompt /
+        conversation) should land where its blocks now live."""
+        keys = self._keys(list(req.prompt) + list(req.preempted_tokens))
+        if not keys:
+            return
+        with self._lock:
+            for key in keys:
+                self._prefix_home[key] = target_rid
+                self._prefix_home.move_to_end(key)
+            while len(self._prefix_home) > self._prefix_cap:
+                self._prefix_home.popitem(last=False)
 
     def _fold_retired(self, rep) -> None:
         """Merge a retiring replica's final stats into the persistent
@@ -848,6 +1003,9 @@ class EngineFleet:
                     # replicas share one resident weight tree — summing
                     # would count the same HBM once per replica
                     "weight_bytes",
+                    # ...and one shared host tier: every replica reports the
+                    # same pool's bytes, so the fleet takes the max, not N×
+                    "host_tier_bytes",
                 )
                 else sum(vals)
             )

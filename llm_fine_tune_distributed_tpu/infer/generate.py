@@ -844,6 +844,64 @@ class Generator:
             )
         return self._jit_cache[key]
 
+    def paged_block_gather(self, n: int):
+        """Jitted gather of ``n`` pool blocks (host-tier spill). Cached per
+        power-of-two block-count bucket ``n`` — the engine pads its id list
+        with NULL_BLOCK rows it slices off host-side, so any spill size
+        reuses a handful of compiled programs (zero post-warmup recompiles,
+        the SERVE_COMPILES contract)."""
+        key = ("paged_block_gather", n)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._instrument(
+                key, self._build_paged_block_gather()
+            )
+        return self._jit_cache[key]
+
+    def paged_block_scatter(self, n: int):
+        """Jitted scatter of ``n`` host blocks back into the pool (host-tier
+        restore). Same bucketing contract as ``paged_block_gather``; the
+        engine pads with NULL_BLOCK ids and ALL-ZERO rows, so pad writes
+        land in block 0 as zeros — which for the int8 pool layout preserves
+        the null block's zero-codes/zero-scales invariant, and for bf16 only
+        rewrites garbage that is always masked."""
+        key = ("paged_block_scatter", n)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._instrument(
+                key, self._build_paged_block_scatter()
+            )
+        return self._jit_cache[key]
+
+    def _build_paged_block_gather(self):
+        """Tree-mapped row gather over the pool pytree: every pool leaf is
+        block-major (``[num_blocks, ...]`` — int8 code pools and their scale
+        siblings alike), so one ``leaf[ids]`` per leaf lifts a whole block
+        (codes + scales as a unit) into ``n`` leading rows ready for one
+        host transfer."""
+
+        @jax.jit
+        def gather(pool, ids):
+            return jax.tree.map(lambda leaf: leaf[ids], pool)
+
+        return gather
+
+    def _build_paged_block_scatter(self):
+        """Inverse of the gather: writes ``updates`` (one leading row per
+        block id, same treedef as the pool) into the pool rows ``ids``.
+        Functional like every other pool program — the engine re-points its
+        pool reference at the result."""
+
+        @jax.jit
+        def scatter(pool, ids, updates):
+            return self._pin_kv(
+                jax.tree.map(
+                    lambda leaf, upd: leaf.at[ids].set(upd.astype(leaf.dtype)),
+                    pool,
+                    updates,
+                )
+            )
+
+        return scatter
+
     def _build_paged_step(self, slots: int, nb: int, block_len: int):
         """One decode step over the slot array against the block pool. Same
         sampling semantics as ``_build_slot_step`` bit for bit — only the KV

@@ -23,8 +23,9 @@ and prefix-cache state never needs mirroring.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from llm_fine_tune_distributed_tpu.infer.routing import prefix_block_keys
 
@@ -177,14 +178,124 @@ class PrefixCache:
             self._alloc.ref(bid)
             self._entries[key] = bid
 
-    def evict(self, want_free: int) -> int:
+    def evict(
+        self,
+        want_free: int,
+        collect: Optional[List[Tuple[bytes, int]]] = None,
+    ) -> int:
         """Drop LRU entries until the allocator has ``want_free`` free blocks
         or the cache is empty; returns entries dropped. Dropping an entry
         whose block is still mapped in a slot table releases only the cache's
-        reference (lost reuse, never lost data)."""
+        reference (lost reuse, never lost data).
+
+        ``collect`` (optional) receives the dropped ``(key, block_id)`` pairs
+        in eviction order, so the engine can spill their DEVICE contents to
+        the host tier before anything reallocates and overwrites them — the
+        block's bytes stay valid until a later alloc + write, and the
+        engine's single scheduler thread orders the spill gather before any
+        such write."""
         dropped = 0
         while self._entries and self._alloc.free_count < want_free:
-            _, bid = self._entries.popitem(last=False)
+            key, bid = self._entries.popitem(last=False)
+            if collect is not None:
+                collect.append((key, bid))
             self._alloc.free(bid)
             dropped += 1
         return dropped
+
+
+class HostBlockTier:
+    """Byte-bounded host-RAM tier behind the HBM block pool.
+
+    One entry per prefix-cache key (the SAME cumulative-token keys
+    ``PrefixCache`` indexes by): the host copies of ONE block's pool leaves
+    in ``jax.tree_util`` flatten order — for int8 pools that means the code
+    blocks AND their scale siblings travel as a unit, so a restored block is
+    bit-identical to the spilled one including its quantization history.
+
+    LRU over total bytes (``capacity_bytes``; 0 disables the tier — every
+    ``put`` is refused and eviction degrades to today's discard). Entries
+    are stamped with the spiller's weight fingerprint: a restore under a
+    different resident fingerprint MUST miss (the KV was computed by other
+    weights), which is exactly what happens mid rolling hot-swap — the
+    consumer re-prefills instead (slower, never wrong).
+
+    Thread-safe (one lock): the tier is SHARED by every fleet replica —
+    that sharing is the transport live slot migration rides (spill on the
+    source, restore on the target, both against the same process-local
+    pool of pinned numpy arrays). Host entries survive an engine worker
+    restart (the device pool dies, host RAM does not), so a post-recovery
+    resume can restore instead of re-prefilling as long as the weights are
+    unchanged.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = max(0, int(capacity_bytes))
+        self._lock = threading.Lock()
+        # key -> (arrays, fingerprint, nbytes); insertion order = LRU order
+        self._entries: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def put(self, key: bytes, arrays: List, fingerprint=None) -> bool:
+        """Insert one block's host arrays under ``key``, evicting LRU
+        entries until it fits. False when the tier is disabled or the entry
+        alone exceeds capacity (caller counts a discard). Re-putting a
+        resident key refreshes its content and LRU position — the spilled
+        bytes may legitimately differ when the same prefix was recomputed
+        under new weights."""
+        nbytes = int(sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays))
+        with self._lock:
+            if self.capacity_bytes <= 0 or nbytes > self.capacity_bytes:
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[2]
+            while self._entries and self._bytes + nbytes > self.capacity_bytes:
+                _, (_, _, old_nb) = self._entries.popitem(last=False)
+                self._bytes -= old_nb
+            self._entries[key] = (list(arrays), fingerprint, nbytes)
+            self._bytes += nbytes
+        return True
+
+    def get(self, key: bytes, fingerprint=None) -> Optional[List]:
+        """The block's host arrays (LRU-touched), or None when absent or
+        spilled under a DIFFERENT weight fingerprint — stale KV must read
+        as a miss, never as data."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry[1] != fingerprint:
+                return None
+            self._entries.move_to_end(key)
+            return entry[0]
+
+    def resident_run(self, keys: Sequence[bytes], fingerprint=None) -> int:
+        """How many LEADING keys are restorable under ``fingerprint`` — the
+        engine's pre-allocation probe (no LRU touch, no data copied)."""
+        with self._lock:
+            n = 0
+            for key in keys:
+                entry = self._entries.get(key)
+                if entry is None or entry[1] != fingerprint:
+                    break
+                n += 1
+            return n
+
+    def discard(self, key: bytes) -> None:
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry[2]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0

@@ -117,6 +117,8 @@ def serve(
     kv_buf_len: int = 4096,
     kv_block_len: int = 256,
     prefill_chunk: int = 512,
+    host_tier_mb: int = 0,
+    migrate_on_retire: bool = False,
     max_queue_depth: int = 256,
     queue_deadline_s: Optional[float] = None,
     priority_default: str = "interactive",
@@ -272,6 +274,20 @@ def serve(
             "paged engine; drop --max-replicas or pick "
             "--engine continuous|paged"
         )
+    host_tier_mb = max(0, int(host_tier_mb or 0))
+    if host_tier_mb and engine_kind != "paged":
+        raise ValueError(
+            "--host-tier-mb spills paged KV BLOCKS to host RAM on eviction/"
+            "preemption; the dense/window caches have no blocks to spill — "
+            "pick --engine paged or drop --host-tier-mb"
+        )
+    if migrate_on_retire and not (replicas > 1 or max_replicas > replicas):
+        raise ValueError(
+            "--migrate-on-retire live-migrates a retiring replica's "
+            "requests to SIBLING replicas; it needs a fleet — set "
+            "--replicas > 1 (or --max-replicas above --replicas) or drop "
+            "--migrate-on-retire"
+        )
     if publish_watch_dir and engine_kind == "window":
         raise ValueError(
             "--publish-watch-dir (checkpoint hot-swap) needs a continuous/"
@@ -412,6 +428,14 @@ def serve(
         "trace_log_max_mb": trace_log_max_mb,
         "slo_sample_interval_s": slo_sample_interval_s,
     }
+    # ONE host tier shared by every paged replica (infer/paged.HostBlockTier):
+    # the sharing is what live slot migration ships blocks through
+    host_tier = None
+    if host_tier_mb and engine_kind == "paged" and slot_bridge is None:
+        from llm_fine_tune_distributed_tpu.infer.paged import HostBlockTier
+
+        host_tier = HostBlockTier(host_tier_mb * 1024 * 1024)
+        print(f"[serve] host KV tier: {host_tier_mb} MiB")
     if engine_kind in ("continuous", "paged"):
         from llm_fine_tune_distributed_tpu.infer.engine import (
             ContinuousBatchingEngine,
@@ -470,7 +494,7 @@ def serve(
                 return PagedContinuousBatchingEngine(
                     generator, slots=slots, buf_len=kv_buf_len,
                     block_len=kv_block_len, prefill_chunk=prefill_chunk,
-                    kv_quant=quantize_kv,
+                    kv_quant=quantize_kv, host_tier=host_tier,
                     **kw,
                 )
             return ContinuousBatchingEngine(
@@ -485,6 +509,7 @@ def serve(
                 [_make_replica(i) for i in range(replicas)],
                 routing=routing,
                 replica_factory=_make_replica,
+                migrate_on_retire=migrate_on_retire,
             )
         else:
             cont_engine = _make_replica(0)
@@ -1479,6 +1504,22 @@ def main(argv: Optional[list] = None) -> int:
              "(longer prompts interleave with decode)",
     )
     parser.add_argument(
+        "--host-tier-mb", type=int, default=0, metavar="MB",
+        help="paged engine: host-RAM KV tier budget in MiB (LRU over "
+             "bytes). Evicted prefix-cache blocks and preempted requests' "
+             "banked blocks spill here instead of vanishing, and resume/"
+             "reuse restores them to the device instead of re-prefilling "
+             "(int8 code+scale blocks round-trip as a unit). 0 = off",
+    )
+    parser.add_argument(
+        "--migrate-on-retire", action="store_true",
+        help="fleet (--replicas > 1): retire_replica, autoscaler scale-"
+             "down, and rolling hot-swaps empty a replica by live-"
+             "migrating its in-flight requests to siblings through the "
+             "host tier (O(blocks), greedy bit-identical) instead of "
+             "waiting for the longest stream to finish",
+    )
+    parser.add_argument(
         "--speculative", type=int, default=0, metavar="K",
         help="continuous/paged engines: draft up to K tokens per slot per "
              "tick (prompt-lookup by default) and verify them in ONE fused "
@@ -1718,6 +1759,8 @@ def main(argv: Optional[list] = None) -> int:
           scale_cooldown_s=args.scale_cooldown_s, slots=args.slots,
           kv_buf_len=args.kv_buf_len, kv_block_len=args.kv_block_len,
           prefill_chunk=args.prefill_chunk,
+          host_tier_mb=args.host_tier_mb,
+          migrate_on_retire=args.migrate_on_retire,
           max_queue_depth=args.max_queue_depth,
           queue_deadline_s=args.queue_deadline_s or None,
           priority_default=args.priority_default,

@@ -157,6 +157,14 @@ class FaultInjector:
         self._decode_at: set = set()  # absolute decode step indices
         self._decode_next = 0
         self._prefill_next = 0
+        # tiered-KV / migration fault points (infer/engine.py): spill =
+        # the host-tier gather on eviction/preemption/export, restore = the
+        # device scatter at admission, migrate = per-request inside an
+        # export (so "crash mid-migration" lands between two requests, the
+        # worst spot for double-settle bugs)
+        self._spill_next = 0
+        self._restore_next = 0
+        self._migrate_next = 0
         # latency (not failure) injection: (remaining ticks, seconds each)
         self._decode_delay = (0, 0.0)
 
@@ -175,6 +183,29 @@ class FaultInjector:
         """Fail the next ``k`` prefill operations, then heal."""
         with self._lock:
             self._prefill_next += int(k)
+
+    def fail_spill_next(self, k: int = 1) -> None:
+        """Fail the next ``k`` host-tier spills (the block gather on
+        eviction/preemption/export), then heal. The engine degrades each
+        failed spill to today's plain discard — lost reuse, never lost
+        data — and counts ``prefix_blocks_discarded``."""
+        with self._lock:
+            self._spill_next += int(k)
+
+    def fail_restore_next(self, k: int = 1) -> None:
+        """Fail the next ``k`` host-tier restores (the device scatter at
+        admission), then heal. The engine falls back to the full re-prefill
+        path — greedy output stays bit-identical either way."""
+        with self._lock:
+            self._restore_next += int(k)
+
+    def fail_migrate_next(self, k: int = 1) -> None:
+        """Fail the next ``k`` per-request migration export steps, then
+        heal — a crash MID export, after some requests already left. The
+        engine re-adopts every already-detached request and the fleet falls
+        back to drain-wait; the request completes on exactly one replica."""
+        with self._lock:
+            self._migrate_next += int(k)
 
     def delay_decode_next(self, k: int = 1, seconds: float = 0.05) -> None:
         """Slow (don't fail) the next ``k`` decode ticks by ``seconds``
@@ -215,3 +246,24 @@ class FaultInjector:
                 return
             self._prefill_next -= 1
         raise InjectedFault("injected prefill failure")
+
+    def maybe_fail_spill(self) -> None:
+        with self._lock:
+            if self._spill_next <= 0:
+                return
+            self._spill_next -= 1
+        raise InjectedFault("injected host-tier spill failure")
+
+    def maybe_fail_restore(self) -> None:
+        with self._lock:
+            if self._restore_next <= 0:
+                return
+            self._restore_next -= 1
+        raise InjectedFault("injected host-tier restore failure")
+
+    def maybe_fail_migrate(self) -> None:
+        with self._lock:
+            if self._migrate_next <= 0:
+                return
+            self._migrate_next -= 1
+        raise InjectedFault("injected migration failure")

@@ -406,6 +406,7 @@ class Autoscaler:
         horizon_s: float = 60.0,
         history: int = 64,
         retire_timeout_s: float = 60.0,
+        migrate_on_retire: Optional[bool] = None,
     ):
         if mode not in self.MODES:
             raise ValueError(
@@ -419,6 +420,9 @@ class Autoscaler:
         self.interval_s = max(0.05, float(interval_s))
         self.horizon_s = float(horizon_s)
         self.retire_timeout_s = float(retire_timeout_s)
+        # None defers to the fleet's own migrate_on_retire default; a bool
+        # forces scale-down retirement to (not) live-migrate its streams
+        self.migrate_on_retire = migrate_on_retire
         self._last_action_t: Optional[float] = None
         self._decisions: "deque[Dict[str, Any]]" = deque(maxlen=int(history))
         self._lock = threading.Lock()
@@ -461,9 +465,17 @@ class Autoscaler:
             try:
                 if recommended > current:
                     self.fleet.add_replica()
-                else:
+                elif self.migrate_on_retire is None:
+                    # no override: the fleet's migrate_on_retire default
+                    # applies (kwarg omitted so scripted stub fleets with
+                    # the old retire signature keep working)
                     self.fleet.retire_replica(
                         timeout_s=self.retire_timeout_s
+                    )
+                else:
+                    self.fleet.retire_replica(
+                        timeout_s=self.retire_timeout_s,
+                        migrate=self.migrate_on_retire,
                     )
                 decision["applied"] = True
                 self._last_action_t = now

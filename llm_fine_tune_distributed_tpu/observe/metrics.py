@@ -88,6 +88,13 @@ class ServingStats:
         # Tokens that were emitted but thrown away land in the
         # reason-labelled waste map (``wasted_tokens_by_reason``) instead.
         "goodput_tokens",
+        # tiered KV (infer/paged.HostBlockTier): prefix/banked blocks that
+        # made it into the host tier on eviction vs. vanished the old way;
+        # host->device restores that extended an admission's shared run vs.
+        # fell back to re-prefill; requests live-migrated onto this engine
+        "prefix_blocks_spilled", "prefix_blocks_discarded",
+        "host_tier_restore_hits", "host_tier_restore_misses",
+        "slots_migrated",
     )
     GAUGES = (
         "queue_depth", "live_slots", "engine_generation",
@@ -106,6 +113,9 @@ class ServingStats:
         # the k/v pools only — the per-block int8 scales ride in the
         # /v1/stats breakdown, not here
         "weight_bytes", "kv_pool_bytes",
+        # bytes resident in the shared host-RAM block tier (one pool per
+        # process: fleet aggregation takes the max, not the sum)
+        "host_tier_bytes",
     )
     # tier-labelled shed counters (``requests_shed_by_tier`` in the
     # snapshot): every priority tier is always present so the /v1/stats and
@@ -485,6 +495,26 @@ def prometheus_exposition(
         name = f"{prefix}_replica_count"
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {int(snap.get('replicas', 1))}")
+    # tiered KV: the hit/miss restore counters also roll up into one
+    # result-labelled series (the dashboard's restore-hit-rate query works
+    # off a single metric name). Gated on the snapshot key so window/
+    # trainer snapshots stay unchanged; both labels always present.
+    if "host_tier_restore_hits" in snap:
+        name = f"{prefix}_host_tier_restores_total"
+        lines.append(f"# TYPE {name} counter")
+        for result, key in (
+            ("hit", "host_tier_restore_hits"),
+            ("miss", "host_tier_restore_misses"),
+        ):
+            lines.append(
+                f'{name}{{result="{result}"}} {int(snap.get(key, 0))}'
+            )
+            for label, rsnap, _ in replicas:
+                if key in rsnap:
+                    lines.append(
+                        f'{name}{{replica="{label}",result="{result}"}} '
+                        f"{int(rsnap[key])}"
+                    )
     # compile-ledger samples: ``compile`` is a nested dict (skipped by the
     # numeric loop), so per-program compile counts/seconds are emitted
     # explicitly with a ``program`` label. TYPE lines are UNCONDITIONAL so

@@ -68,6 +68,11 @@ SNAPSHOT_KEYS = {
     # successful settle, the reason-keyed waste map for the rest, and the
     # derived goodput/(goodput+waste) ratio
     "goodput_tokens", "wasted_tokens_by_reason", "goodput_fraction",
+    # tiered KV (infer/paged.HostBlockTier): spill/discard split on
+    # eviction, restore hit/miss split at admission, adopted migrations
+    "prefix_blocks_spilled", "prefix_blocks_discarded",
+    "host_tier_restore_hits", "host_tier_restore_misses",
+    "slots_migrated",
     # gauges
     "queue_depth", "live_slots", "engine_generation", "weight_generation",
     # overload control: the brownout controller's current stage (0-3)
@@ -77,6 +82,8 @@ SNAPSHOT_KEYS = {
     # quantized serving: resident weight bytes and KV-pool bytes (the full
     # breakdown with scale overhead rides /v1/stats device_memory_report)
     "weight_bytes", "kv_pool_bytes",
+    # tiered KV: bytes resident in the (process-shared) host block tier
+    "host_tier_bytes",
     # multi-tenant LoRA: tenant -> {requests, tokens, queue_depth}
     "per_tenant",
     # derived
@@ -150,6 +157,15 @@ EXPECTED_METRICS = {
     ("serving_wasted_tokens_total", "counter"),
     ("serving_goodput_fraction", "gauge"),
     ("serving_replica_count", "gauge"),
+    # tiered KV: spill/discard counters, the raw hit/miss counters plus
+    # their result="hit|miss" rollup, migration adoptions, resident bytes
+    ("serving_prefix_blocks_spilled_total", "counter"),
+    ("serving_prefix_blocks_discarded_total", "counter"),
+    ("serving_host_tier_restore_hits_total", "counter"),
+    ("serving_host_tier_restore_misses_total", "counter"),
+    ("serving_host_tier_restores_total", "counter"),
+    ("serving_slots_migrated_total", "counter"),
+    ("serving_host_tier_bytes", "gauge"),
     # per-tenant series (tenant="name" labels; TYPE lines are emitted even
     # with zero tenants so the schema is load-independent)
     ("serving_tenant_requests_total", "counter"),

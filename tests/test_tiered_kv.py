@@ -1,0 +1,418 @@
+"""Tiered KV resilience (infer/paged.py HostBlockTier + infer/engine.py
+spill/restore + infer/fleet.py live slot migration).
+
+What this file pins, layer by layer:
+
+- ``HostBlockTier`` is a byte-bounded LRU: the bound holds across every
+  put, a get refreshes recency, an oversized or disabled put is refused
+  (never raises), and entries spilled under a different weight
+  fingerprint read as misses — stale KV is never data;
+- a spill -> host -> restore round trip is BIT-exact for both fp and
+  int8 pools (codes and their scale siblings travel as one entry);
+- prefix-cache eviction spills through the product path and a later
+  admission restores from the tier instead of re-prefilling, greedy
+  bit-identical to solo ``generate_ids``;
+- every restore failure — injected fault, cleared tier — degrades to
+  re-prefill with IDENTICAL greedy output (slower, never wrong), and an
+  injected spill fault degrades to a counted discard;
+- ``export_requests`` banks a live request preempt-style and
+  ``adopt_request`` resumes it, end-to-end tokens bit-identical;
+- fleet ``migrate_slot`` moves a mid-flight stream to a sibling replica
+  (the waiter never reconnects), settled on EXACTLY one replica;
+- an injected migrate fault re-adopts on the source (no drop, no double
+  settle, no hung waiter) and ``retire_replica`` falls back to
+  drain-wait instead of raising;
+- ``retire_replica`` with migration empties a replica without waiting
+  for its longest request.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import (
+    EngineFleet,
+    GenerationConfig,
+    Generator,
+)
+from llm_fine_tune_distributed_tpu.infer.engine import PagedContinuousBatchingEngine
+from llm_fine_tune_distributed_tpu.infer.paged import HostBlockTier
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+
+GREEDY = GenerationConfig(max_new_tokens=6, do_sample=False)
+GREEDY48 = GenerationConfig(max_new_tokens=48, do_sample=False)
+
+
+@pytest.fixture(scope="module")
+def generator():
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    return Generator(
+        params, mc, ByteChatMLTokenizer(), compute_dtype=jnp.float32, eos_token_ids=[]
+    )
+
+
+def _enc(text):
+    return ByteChatMLTokenizer().encode(text)
+
+
+def _wait(cond, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise TimeoutError("condition not reached")
+        time.sleep(0.005)
+
+
+def _tiered(generator, tier=None, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("buf_len", 256)
+    kw.setdefault("prompt_bucket", 64)
+    kw.setdefault("block_len", 16)
+    kw.setdefault("prefill_chunk", 256)
+    return PagedContinuousBatchingEngine(
+        generator, host_tier=tier if tier is not None else HostBlockTier(64 << 20),
+        **kw,
+    )
+
+
+def _tiered_fleet(generator, n=2, **fleet_kw):
+    """Fleet of paged replicas sharing ONE HostBlockTier — the sharing IS
+    the migration transport (server.py wires it the same way)."""
+    tier = HostBlockTier(64 << 20)
+    return EngineFleet(
+        [
+            _tiered(
+                generator, tier=tier, slots=4,
+                restart_backoff_s=0.01, restart_backoff_max_s=0.02,
+            )
+            for _ in range(n)
+        ],
+        routing="prefix",
+        **fleet_kw,
+    ), tier
+
+
+# a prompt spanning >= 2 full 16-token blocks, so spills have blocks to move
+VICTIM_TEXT = "a forty-ish token victim prompt for host tier spills"
+
+
+# ------------------------------------------------------------- tier unit
+
+
+def test_host_tier_lru_byte_bound_and_refresh():
+    row = lambda fill: [np.full(128, fill, np.uint8), np.full(128, fill, np.uint8)]
+    tier = HostBlockTier(1024)  # exactly 4 entries of 256 bytes
+    keys = [bytes([i]) for i in range(5)]
+    for i, k in enumerate(keys[:4]):
+        assert tier.put(k, row(i))
+    assert len(tier) == 4 and tier.bytes_used == 1024
+    tier.get(keys[0])  # refresh: k0 is now most-recent
+    assert tier.put(keys[4], row(4))  # evicts LRU = k1, NOT k0
+    assert tier.bytes_used <= 1024
+    assert tier.get(keys[1]) is None
+    assert tier.get(keys[0]) is not None and tier.get(keys[4]) is not None
+    # re-put refreshes in place (no double-count of bytes)
+    assert tier.put(keys[0], row(9))
+    assert tier.bytes_used <= 1024
+    assert int(tier.get(keys[0])[0][0]) == 9
+    # an entry that alone exceeds capacity is refused, pool untouched
+    before = tier.bytes_used
+    assert not tier.put(b"huge", [np.zeros(4096, np.uint8)])
+    assert tier.bytes_used == before
+    # disabled tier refuses everything
+    assert not HostBlockTier(0).put(b"k", row(0))
+    tier.discard(keys[0])
+    assert tier.get(keys[0]) is None
+    tier.clear()
+    assert len(tier) == 0 and tier.bytes_used == 0
+
+
+def test_host_tier_fingerprint_stale_reads_as_miss():
+    tier = HostBlockTier(1 << 20)
+    rows = [np.arange(8, dtype=np.float32)]
+    assert tier.put(b"k1", rows, fingerprint=b"gen1")
+    assert tier.put(b"k2", rows, fingerprint=b"gen1")
+    # the right fingerprint restores; any other — including None — misses
+    assert tier.get(b"k1", fingerprint=b"gen1") is not None
+    assert tier.get(b"k1", fingerprint=b"gen2") is None
+    assert tier.get(b"k1") is None
+    assert tier.resident_run([b"k1", b"k2"], fingerprint=b"gen1") == 2
+    assert tier.resident_run([b"k1", b"k2"], fingerprint=b"gen2") == 0
+    # resident_run counts the LEADING restorable run only
+    assert tier.put(b"k3", rows, fingerprint=b"gen2")
+    assert tier.resident_run([b"k1", b"k3"], fingerprint=b"gen1") == 1
+
+
+# ---------------------------------------------------- device round trip
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_spill_restore_round_trip_bit_exact(generator, kv_quant):
+    """gather -> host tier -> scatter into FRESH pool rows -> gather again
+    reproduces every pool leaf bit-for-bit. For int8 the entry carries the
+    code blocks AND their scale siblings as a unit, so a restored block is
+    identical including its quantization history."""
+    eng = _tiered(generator, kv_quant=kv_quant)
+    prompt = _enc(VICTIM_TEXT)
+    assert len(prompt) >= 32
+    eng.submit(prompt, GREEDY, timeout=240)  # fills pool + prefix cache
+    keys = eng._prefix.block_keys(prompt)
+    bids = eng._prefix.match(keys, limit=len(keys))
+    assert len(bids) == len(keys) >= 2
+    orig = eng._gather_blocks(bids)
+    if kv_quant == "int8":
+        # code + scale siblings really are distinct leaves of one entry
+        dtypes = {r.dtype for r in orig[0]}
+        assert np.dtype(np.int8) in dtypes and len(dtypes) >= 2
+    eng._spill_to_tier(list(zip(keys, bids)))
+    snap = eng.stats_snapshot()
+    assert snap["prefix_blocks_spilled"] == len(keys)
+    assert snap["prefix_blocks_discarded"] == 0
+    entries = [
+        eng._host_tier.get(k, fingerprint=eng._weight_fingerprint) for k in keys
+    ]
+    assert all(e is not None for e in entries)
+    fresh = eng._allocator.alloc(len(keys))
+    eng._scatter_blocks(fresh, entries)
+    for back_rows, orig_rows in zip(eng._gather_blocks(fresh), orig):
+        assert len(back_rows) == len(orig_rows)
+        for b, o in zip(back_rows, orig_rows):
+            assert b.dtype == o.dtype
+            np.testing.assert_array_equal(b, o)
+    for bid in list(fresh) + list(bids):
+        eng._allocator.free(bid)
+
+
+def test_spill_fault_degrades_to_counted_discard(generator):
+    eng = _tiered(generator)
+    prompt = _enc(VICTIM_TEXT)
+    eng.submit(prompt, GREEDY, timeout=240)
+    keys = eng._prefix.block_keys(prompt)
+    bids = eng._prefix.match(keys, limit=len(keys))
+    eng.faults.fail_spill_next(1)
+    eng._spill_to_tier(list(zip(keys, bids)))  # must NOT raise
+    snap = eng.stats_snapshot()
+    assert snap["prefix_blocks_spilled"] == 0
+    assert snap["prefix_blocks_discarded"] == len(keys)
+    assert len(eng._host_tier) == 0
+    # fault self-disarms: the next spill lands
+    eng._spill_to_tier(list(zip(keys, bids)))
+    assert eng.stats_snapshot()["prefix_blocks_spilled"] == len(keys)
+    for bid in bids:
+        eng._allocator.free(bid)
+
+
+# --------------------------------------------- evict -> restore -> decode
+
+
+def _evict_and_spill(eng):
+    """The exact product sequence from ``_plan`` under block pressure:
+    evict the HBM prefix cache collecting the dropped (key, block) pairs,
+    then spill them to the host tier before any reallocation."""
+    dropped = []
+    eng._prefix.evict(eng._num_blocks, collect=dropped)
+    eng._spill_to_tier(dropped)
+    return len(dropped)
+
+
+def test_evicted_prefix_restores_from_tier_bit_identical(generator):
+    eng = _tiered(generator)
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY)
+    assert eng.submit(prompt, GREEDY, timeout=240) == solo
+    assert _evict_and_spill(eng) >= 2
+    assert len(eng._prefix) == 0 and len(eng._host_tier) >= 2
+    # re-admission restores from the tier instead of re-prefilling, and
+    # decodes over the restored KV to the identical greedy tokens
+    assert eng.submit(prompt, GREEDY, timeout=240) == solo
+    snap = eng.stats_snapshot()
+    assert snap["host_tier_restore_hits"] >= 2
+    assert snap["host_tier_restore_misses"] == 0
+    assert snap["host_tier_bytes"] > 0
+
+
+def test_restore_fault_and_tier_miss_fall_back_to_reprefill(generator):
+    eng = _tiered(generator)
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY)
+    assert eng.submit(prompt, GREEDY, timeout=240) == solo
+    # injected scatter fault: restore aborts, blocks are returned to the
+    # pool, the plan re-prefills — identical output, misses counted
+    _evict_and_spill(eng)
+    eng.faults.fail_restore_next(1)
+    assert eng.submit(prompt, GREEDY, timeout=240) == solo
+    snap = eng.stats_snapshot()
+    assert snap["host_tier_restore_misses"] >= 2
+    assert snap["host_tier_restore_hits"] == 0
+    assert any(ev["kind"] == "restore_failed" for ev in eng.recorder.events())
+    # total miss (tier emptied out from under the cache): plain re-prefill
+    _evict_and_spill(eng)
+    eng._host_tier.clear()
+    assert eng.submit(prompt, GREEDY, timeout=240) == solo
+
+
+# ------------------------------------------------------- export / adopt
+
+
+def test_export_banks_and_adopt_resumes_bit_identical(generator):
+    """export_requests on a mid-decode stream banks preempt-style (tokens
+    + spilled context blocks) without settling; adopt_request resumes it
+    on the SAME engine and the ORIGINAL stream iterator runs to the solo
+    greedy tokens — the waiter never reconnects."""
+    eng = _tiered(generator)
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    stream = eng.stream(prompt, GREEDY48, timeout=240)
+    tokens = [next(stream), next(stream)]
+    exported = eng.export_requests(timeout=30)
+    assert len(exported) == 1
+    assert eng.live_slots == 0 and eng.queue_depth == 0
+    assert len(exported[0].preempted_tokens) >= 2
+    # the banked context spilled: full ingested blocks are in the tier
+    assert len(eng._host_tier) >= 2
+    assert eng.stats_snapshot()["prefix_blocks_spilled"] >= 2
+    assert any(ev["kind"] == "export" for ev in eng.recorder.events())
+    eng.adopt_request(exported[0])
+    tokens.extend(stream)
+    assert tokens == solo
+    snap = eng.stats_snapshot()
+    assert snap["requests_completed"] == 1
+    assert snap["requests_failed"] == 0
+
+
+def test_export_with_nothing_live_returns_empty(generator):
+    eng = _tiered(generator)
+    assert eng.export_requests(timeout=30) == []
+
+
+# --------------------------------------------------------- live migration
+
+
+def test_migrate_slot_moves_stream_settles_on_exactly_one_replica(generator):
+    fleet, _tier = _tiered_fleet(generator, migrate_on_retire=True)
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    stream = fleet.stream(prompt, GREEDY48, timeout=240)
+    tokens = [next(stream), next(stream)]
+    src = next(rid for rid, rep in fleet.replica_items() if rep.live_slots > 0)
+    assert fleet.migrate_slot(src) == 1
+    tokens.extend(stream)  # the SAME iterator finishes on the sibling
+    assert tokens == solo
+    src_rep = dict(fleet.replica_items())[src]
+    tgt_rid, tgt_rep = next(
+        (rid, rep) for rid, rep in fleet.replica_items() if rid != src
+    )
+    # settled on exactly one replica: the target completed it, the source
+    # kept nothing in flight and counted the adoption nowhere
+    assert tgt_rep.stats_snapshot()["slots_migrated"] == 1
+    assert tgt_rep.stats_snapshot()["requests_completed"] == 1
+    assert src_rep.stats_snapshot()["requests_completed"] == 0
+    assert src_rep.live_slots == 0 and src_rep.queue_depth == 0
+    # migration re-pins the prompt's prefix affinity onto the target
+    assert tgt_rid in set(fleet._prefix_home.values())
+    with pytest.raises(ValueError):
+        fleet.migrate_slot(src, target_rid=src)
+    with pytest.raises(KeyError):
+        fleet.migrate_slot(9999)
+
+
+def test_migrate_fault_readopts_no_drop_no_double_settle(generator):
+    """Injected crash mid-migration: the source re-adopts the request,
+    the stream completes bit-identical, and EXACTLY one replica settles
+    it — no drop, no double count, no hung waiter."""
+    fleet, _tier = _tiered_fleet(generator, migrate_on_retire=True)
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    stream = fleet.stream(prompt, GREEDY48, timeout=240)
+    tokens = [next(stream), next(stream)]
+    src = next(rid for rid, rep in fleet.replica_items() if rep.live_slots > 0)
+    reps = dict(fleet.replica_items())
+    reps[src].faults.fail_migrate_next(1)
+    with pytest.raises(RuntimeError):
+        fleet.migrate_slot(src)
+    tokens.extend(stream)  # completes locally after the re-adopt
+    assert tokens == solo
+    completed = [
+        rep.stats_snapshot()["requests_completed"] for rep in reps.values()
+    ]
+    assert sorted(completed) == [0, 1]
+    assert all(rep.stats_snapshot()["requests_failed"] == 0 for rep in reps.values())
+    assert all(rep.stats_snapshot()["slots_migrated"] == 0 for rep in reps.values())
+    # the fault self-disarmed and nothing is stuck: fresh traffic decodes
+    assert fleet.submit(_enc("after the storm"), GREEDY, timeout=240)
+
+
+def test_retire_with_migrate_fault_falls_back_to_drain_wait(generator):
+    """retire_replica never propagates a migration failure: the export
+    fault re-adopts on the source and retirement degrades to the plain
+    drain-wait — slower, never a drop."""
+    fleet, _tier = _tiered_fleet(generator, migrate_on_retire=True)
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    stream = fleet.stream(prompt, GREEDY48, timeout=240)
+    tokens = [next(stream), next(stream)]
+    src = next(rid for rid, rep in fleet.replica_items() if rep.live_slots > 0)
+    dict(fleet.replica_items())[src].faults.fail_migrate_next(1)
+    fleet.retire_replica(rid=src, timeout_s=120)  # must NOT raise
+    assert len(fleet.replicas) == 1
+    tokens.extend(stream)
+    assert tokens == solo
+
+
+def test_retire_replica_migrates_active_stream_off(generator):
+    """Retirement with migration does not wait for the live request: the
+    stream moves to the survivor (slots_migrated proves the path taken —
+    a drain-wait would leave it at 0) and completes bit-identical."""
+    fleet, _tier = _tiered_fleet(generator, migrate_on_retire=True)
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    stream = fleet.stream(prompt, GREEDY48, timeout=240)
+    tokens = [next(stream), next(stream)]
+    src = next(rid for rid, rep in fleet.replica_items() if rep.live_slots > 0)
+    survivor = next(rep for rid, rep in fleet.replica_items() if rid != src)
+    fleet.retire_replica(rid=src, timeout_s=120)
+    assert len(fleet.replicas) == 1
+    tokens.extend(stream)
+    assert tokens == solo
+    snap = survivor.stats_snapshot()
+    assert snap["slots_migrated"] == 1
+    assert snap["requests_completed"] == 1
+    # fleet rollup carries the migration and the shared tier's bytes
+    fsnap = fleet.stats_snapshot()
+    assert fsnap["slots_migrated"] == 1
+    assert "host_tier_bytes" in fsnap
+
+
+def test_migration_with_concurrent_neighbors_all_complete(generator):
+    """Evacuating a replica carrying SEVERAL live requests places every
+    one of them; all streams finish with their solo greedy tokens."""
+    fleet, _tier = _tiered_fleet(generator, migrate_on_retire=True)
+    prompts = [
+        _enc(VICTIM_TEXT),
+        _enc("a second long-context request riding the same replica here"),
+    ]
+    cfg = GenerationConfig(max_new_tokens=24, do_sample=False)
+    solos = [generator.generate_ids(p, cfg) for p in prompts]
+    results = [None] * len(prompts)
+
+    def ask(i):
+        results[i] = fleet.submit(prompts[i], cfg, timeout=240)
+
+    threads = [threading.Thread(target=ask, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    _wait(lambda: sum(rep.live_slots for rep in fleet.replicas) >= 1)
+    # routing may have split them; evacuate whichever replica is busiest
+    src = max(fleet.replica_items(), key=lambda kv: kv[1].live_slots)[0]
+    moved = fleet.migrate_slot(src)
+    assert moved >= 0  # every export either placed or re-adopted
+    for t in threads:
+        t.join(timeout=240)
+    assert results == solos
