@@ -110,7 +110,17 @@ the fixed-max baseline — small absolute floor,
 SERVE_ELASTIC_TTFT_FLOOR_S=1.0 — while its mean replica count stays at
 or below 60% of max, every request ends terminally across scale-ups and
 drain-retires, and nothing recompiles after warmup; per-phase goodput
-fractions ride along in the JSON line). Every engine-backed JSON line
+fractions ride along in the JSON line), SERVE_DISAGG=1 (disaggregation
+arm: resident short greedy decode streams while long prompts —
+SERVE_DISAGG_LONG_PROMPT tokens, 32k on accelerators — prefill
+concurrently, once on a 2-replica mixed fleet and once on a
+1-prefill+1-decode fleet at equal total slots; exits nonzero unless the
+disaggregated run's p99 inter-token gap stays within 1.25x the
+no-long-prompt baseline — small absolute floor,
+SERVE_DISAGG_GAP_FLOOR_S=0.25 — with every stream bit-identical to solo
+decode across the prefill->decode handoff and zero post-warmup
+recompiles; the mixed fleet's contended p99 rides along as the
+counterfactual). Every engine-backed JSON line
 also carries the XLA introspection gauges: mfu, hbm_bw_util,
 compiles_total, compile_seconds_total.
 """
@@ -1007,6 +1017,23 @@ def main():
             fresh_gen, slots=4, buf_len=256, prompt_bucket=32,
             adapters=registry,
         )
+        # disaggregated pair on the same ledger: a prefill-role replica
+        # that hands every request off to its decode sibling through the
+        # shared host tier — the hop (spill, adopt, restore, decode-side
+        # ticks) joins the zero-recompile guard below
+        from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
+        handoff_tier = HostBlockTier(128 << 20)  # the handoff transport
+        disagg_fleet = EngineFleet(
+            [
+                PagedContinuousBatchingEngine(
+                    fresh_gen, slots=4, buf_len=256, prompt_bucket=32,
+                    block_len=32, prefill_chunk=64,
+                    host_tier=handoff_tier, role=role,
+                )
+                for role in ("prefill", "decode")
+            ],
+            routing="prefix",
+        )
         # prefix pool repeats one system prefix (hits after first touch) and
         # the repetitive pool drives the fused draft/verify step; sequential
         # submits so both passes see identical shapes in identical order
@@ -1045,6 +1072,10 @@ def main():
                 paged_spec.adopt_request(req)
             for _ in stream:
                 pass
+            # disaggregation hop: the same prompt lands on the prefill
+            # replica, hands off through the host tier after its first
+            # token, and finishes as plain decode on the sibling
+            disagg_fleet.submit(prompt, tier_cfg, seed=seed, timeout=600)
 
         _compile_pass()  # warmup: every (program, shapes) compiles here
         # the spill/restore block counts above depend on eviction timing, so
@@ -1090,12 +1121,20 @@ def main():
                 "recompiles_after_warmup"
             ]
 
-        ok = comp["recompiles_after_warmup"] == 0 and not sharded_recompiles
+        handoff_hops = disagg_fleet.replicas[0].stats_snapshot()[
+            "requests_handed_off"
+        ]
+        ok = (
+            comp["recompiles_after_warmup"] == 0
+            and not sharded_recompiles
+            and handoff_hops >= 2  # both passes actually took the hop
+        )
         print(json.dumps({
             "metric": "serve_zero_recompile_guard",
             "value": 1 if ok else 0,
-            "unit": "1 = no post-warmup recompiles "
-                    "(spec+adapters+paged, plus tp=2 sharded pass)",
+            "unit": "1 = no post-warmup recompiles (spec+adapters+paged+"
+                    "prefill->decode handoff, plus tp=2 sharded pass)",
+            "handoff_hops": handoff_hops,
             "recompiles_after_warmup": comp["recompiles_after_warmup"],
             "sharded_recompiles_after_warmup": sharded_recompiles,
             "sharded_devices": jax.device_count(),
@@ -2004,6 +2043,195 @@ def main():
             "unexpected_errors": base_errs + el_errs,
             "baseline_phases": base_records,
             "elastic_phases": el_records,
+            "model": preset,
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+        if not ok:
+            sys.exit(1)
+
+    # disaggregation arm: resident short greedy decode streams while long
+    # prompts prefill concurrently, once on a 2-replica MIXED fleet (every
+    # replica interleaves chunked prefill with decode — the long prompt
+    # steals decode ticks from its neighbours) and once on a
+    # 1-prefill+1-decode fleet at EQUAL total slots (the long prompt owns
+    # the prefill replica; the resident streams decode undisturbed after
+    # their handoff). Gates: the disaggregated run's p99 inter-token gap
+    # stays within 1.25x the no-long-prompt baseline (small absolute
+    # floor for starved runners), every stream and every long request is
+    # bit-identical to solo generate_ids (zero drops, handoff included),
+    # and zero post-warmup recompiles. The mixed fleet's contended p99
+    # rides along as the counterfactual the split is buying back.
+    if os.environ.get("SERVE_DISAGG", "1") == "1":
+        from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
+        from llm_fine_tune_distributed_tpu.infer.paged import HostBlockTier
+        from llm_fine_tune_distributed_tpu.infer.sampling import (
+            GenerationConfig,
+        )
+
+        dg_long = int(os.environ.get(
+            "SERVE_DISAGG_LONG_PROMPT", "32768" if on_accelerator else "640"
+        ))
+        dg_longs = int(os.environ.get("SERVE_DISAGG_LONG_COUNT", "2"))
+        dg_streams = int(os.environ.get("SERVE_DISAGG_STREAMS", "6"))
+        dg_slots = int(os.environ.get("SERVE_DISAGG_SLOTS", "8"))
+        dg_max_new = int(os.environ.get("SERVE_DISAGG_MAX_NEW", "96"))
+        dg_floor = float(os.environ.get("SERVE_DISAGG_GAP_FLOOR_S", "0.25"))
+        dg_tier_mb = int(os.environ.get(
+            "SERVE_DISAGG_TIER_MB", "1024" if on_accelerator else "256"
+        ))
+        dg_chunk = 1024 if on_accelerator else 64
+        dg_buf = dg_long + 128
+        dg_gen = Generator(  # fresh generator: isolated compile ledger
+            params, mc, ByteChatMLTokenizer(), compute_dtype=dtype,
+            eos_token_ids=[],
+        )
+        dg_rng = np.random.RandomState(17)
+        short_cfg = GenerationConfig(max_new_tokens=dg_max_new, do_sample=False)
+        long_cfg = GenerationConfig(max_new_tokens=8, do_sample=False)
+        short_prompts = [
+            dg_rng.randint(0, min(mc.vocab_size, 256), (48,)).tolist()
+            for _ in range(dg_streams)
+        ]
+        long_prompts = [
+            dg_rng.randint(0, min(mc.vocab_size, 256), (dg_long,)).tolist()
+            for _ in range(dg_longs)
+        ]
+        short_solo = [dg_gen.generate_ids(p, short_cfg) for p in short_prompts]
+        long_solo = [dg_gen.generate_ids(p, long_cfg) for p in long_prompts]
+
+        def _dg_fleet(roles):
+            tier = HostBlockTier(dg_tier_mb << 20)
+            return EngineFleet(
+                [
+                    PagedContinuousBatchingEngine(
+                        dg_gen, slots=dg_slots, buf_len=dg_buf,
+                        prompt_bucket=64, block_len=32,
+                        prefill_chunk=dg_chunk, host_tier=tier, role=r,
+                    )
+                    for r in roles
+                ],
+                routing="least-loaded",
+            )
+
+        def _dg_run(fleet, n_long):
+            """Resident streams first (past prefill AND handoff), then the
+            long prompts land mid-decode; inter-token gaps cover exactly
+            the contention window."""
+            streams = [
+                fleet.stream(p, short_cfg, timeout=600)
+                for p in short_prompts
+            ]
+            outs = [[next(s), next(s)] for s in streams]
+            gaps = [[] for _ in streams]
+            long_outs = {}
+            errs = []
+
+            def _drain(i):
+                try:
+                    last = time.monotonic()
+                    for tok in streams[i]:
+                        now = time.monotonic()
+                        gaps[i].append(now - last)
+                        last = now
+                        outs[i].append(tok)
+                except Exception as e:  # noqa: BLE001 — gate on it below
+                    errs.append(f"stream {i}: {type(e).__name__}: {e}")
+
+            def _long(j):
+                try:
+                    long_outs[j] = fleet.submit(
+                        long_prompts[j], long_cfg, timeout=600
+                    )
+                except Exception as e:  # noqa: BLE001
+                    errs.append(f"long {j}: {type(e).__name__}: {e}")
+
+            threads = [
+                threading.Thread(target=_drain, args=(i,))
+                for i in range(len(streams))
+            ] + [
+                threading.Thread(target=_long, args=(j,))
+                for j in range(n_long)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            bad = sum(o != s for o, s in zip(outs, short_solo)) + sum(
+                long_outs.get(j) != long_solo[j] for j in range(n_long)
+            )
+            all_gaps = sorted(g for per in gaps for g in per)
+            for rep in fleet.replicas:  # park the fleet
+                rep.begin_drain()
+            return all_gaps, bad, errs, fleet
+
+        # warmup: the full contended workload on BOTH shapes compiles
+        # every program — prompt buckets, decode block buckets, the
+        # handoff's spill/restore, the adopted slots' decode widths
+        _dg_run(_dg_fleet(("mixed", "mixed")), dg_longs)
+        _, _, _, warm_fleet = _dg_run(_dg_fleet(("prefill", "decode")), dg_longs)
+        warm_eng = warm_fleet.replicas[0]
+        n = 1
+        while n <= warm_eng._block_bucket(warm_eng._num_blocks - 1):
+            # pin every spill/restore bucket regardless of how many blocks
+            # a given handoff happens to move (NULL rows: free + harmless)
+            warm_eng._scatter_blocks([0] * n, warm_eng._gather_blocks([0] * n))
+            n *= 2
+        warm_eng.mark_compile_warm()  # ledger is per-Generator: marks all
+
+        # measured runs on FRESH fleets: cold prefix caches, so the long
+        # prompts actually prefill instead of hitting warmup's cache
+        base_gaps, base_bad, base_errs, _ = _dg_run(
+            _dg_fleet(("mixed", "mixed")), 0
+        )
+        mixed_gaps, mixed_bad, mixed_errs, _ = _dg_run(
+            _dg_fleet(("mixed", "mixed")), dg_longs
+        )
+        dis_gaps, dis_bad, dis_errs, dis_fleet = _dg_run(
+            _dg_fleet(("prefill", "decode")), dg_longs
+        )
+        handed_off = sum(
+            rep.stats_snapshot()["requests_handed_off"]
+            for rep in dis_fleet.replicas
+        )
+        comp = dis_fleet.replicas[0].stats_snapshot()["compile"]
+        base_p99 = _pctl(base_gaps, 0.99)
+        mixed_p99 = _pctl(mixed_gaps, 0.99)
+        dis_p99 = _pctl(dis_gaps, 0.99)
+        gap_limit = max(1.25 * base_p99, dg_floor)
+        ok = (
+            not (base_errs or mixed_errs or dis_errs)
+            and base_bad == 0 and mixed_bad == 0 and dis_bad == 0
+            and handed_off >= dg_streams  # every resident stream hopped
+            and bool(dis_gaps)
+            and dis_p99 <= gap_limit
+            and comp["recompiles_after_warmup"] == 0
+        )
+        print(json.dumps({
+            "metric": "serve_disagg_guard",
+            "value": 1 if ok else 0,
+            "unit": "1 = disaggregated p99 inter-token gap <= max(1.25x "
+                    "no-long-prompt baseline, floor) under concurrent "
+                    "long-prompt prefill, zero drops, zero post-warmup "
+                    "recompiles",
+            "long_prompt_tokens": dg_long,
+            "long_prompts": dg_longs,
+            "resident_streams": dg_streams,
+            "slots_per_replica": dg_slots,
+            "baseline_p99_gap_s": round(base_p99, 4),
+            "mixed_contended_p99_gap_s": round(mixed_p99, 4),
+            "disagg_contended_p99_gap_s": round(dis_p99, 4),
+            "gap_limit_s": round(gap_limit, 4),
+            "mixed_over_baseline": round(
+                mixed_p99 / max(base_p99, 1e-9), 2
+            ),
+            "disagg_over_baseline": round(
+                dis_p99 / max(base_p99, 1e-9), 2
+            ),
+            "requests_handed_off": handed_off,
+            "streams_bit_identical": 3 * dg_streams + 2 * dg_longs
+            - (base_bad + mixed_bad + dis_bad),
+            "unexpected_errors": base_errs + mixed_errs + dis_errs,
+            "recompiles_after_warmup": comp["recompiles_after_warmup"],
             "model": preset,
             "platform": jax.devices()[0].platform,
         }), flush=True)

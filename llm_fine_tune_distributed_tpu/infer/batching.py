@@ -118,6 +118,10 @@ class Request:
     # displaced this queued lower-priority request to make room; the
     # scheduler resolves it with a tier-labelled 429 at its next admit pass
     shed_by_pressure: bool = False
+    # disaggregated serving: a prefill-role replica whose handoff failed
+    # sets this so the request decodes in place — re-admission would
+    # otherwise re-run the handoff guard and loop spill/fail forever
+    handoff_failed: bool = False
 
 
 # historical name, kept for callers/tests that referenced the private type

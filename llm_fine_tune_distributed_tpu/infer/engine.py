@@ -110,6 +110,7 @@ from llm_fine_tune_distributed_tpu.infer.supervisor import (
     EngineSupervisor,
     FaultInjector,
 )
+from llm_fine_tune_distributed_tpu.infer.routing import REPLICA_ROLES
 from llm_fine_tune_distributed_tpu.observe.capacity import LoadForecaster
 from llm_fine_tune_distributed_tpu.observe.metrics import ServingStats
 from llm_fine_tune_distributed_tpu.observe.slo import (
@@ -404,7 +405,13 @@ class ContinuousBatchingEngine:
         slo_generations_kept: int = 8,
         trace_log_max_mb: float = 0.0,
         bridge=None,
+        role: str = "mixed",
     ):
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"unknown replica role {role!r} "
+                f"(expected one of {REPLICA_ROLES})"
+            )
         if getattr(generator, "_multihost", False) and bridge is None:
             raise ValueError(
                 "process-spanning generator without a slot bridge: the "
@@ -592,6 +599,15 @@ class ContinuousBatchingEngine:
             getattr(generator, "has_draft", False)
         )
         self._dcache = None  # draft model's per-slot cache (worker-only)
+        # disaggregated prefill/decode (infer/fleet.py): a prefill-role
+        # replica finishes each prompt's chunked prefill, emits the first
+        # token, then hands the live request to a decode-capable replica
+        # through the ``handoff`` hook (installed by the fleet after
+        # construction — None means decode in place, i.e. mixed behavior).
+        # The hook runs ON the worker thread and returns True only once
+        # another replica has adopted the request.
+        self.role = role
+        self.handoff = None
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -994,6 +1010,7 @@ class ContinuousBatchingEngine:
         self.stats.gauge("brownout_stage", self._brownout_stage)
         snap = self.stats.snapshot()
         snap["circuit_state"] = self.circuit_state
+        snap["role"] = self.role
         snap["draining"] = self._draining
         snap["compile"] = self.compile_ledger.snapshot()
         mfu, bw = self._utilization()
@@ -1045,6 +1062,7 @@ class ContinuousBatchingEngine:
         mfu, bw = self._utilization()
         return {
             "slots": int(self._slots),
+            "role": self.role,
             "decode_ticks": ticks,
             "mean_decode_tick_s": mean_tick_s,
             "mean_tokens_per_step": (
@@ -1053,7 +1071,12 @@ class ContinuousBatchingEngine:
             "live_slots_mean": self.load_forecaster.live_slots_mean,
             "model_flops_utilization": mfu,
             "hbm_bandwidth_utilization": bw,
-            "forecaster": self.load_forecaster.snapshot(),
+            # snapshot at the READER's clock: the forecaster only samples
+            # while the engine ticks, so an idle replica's rates must decay
+            # here or a quiet fleet inherits its last busy phase's demand
+            # forever (the SERVE_ELASTIC down-scale failure on starved
+            # runners)
+            "forecaster": self.load_forecaster.snapshot(now=time.monotonic()),
         }
 
     def mark_compile_warm(self) -> None:
@@ -1911,6 +1934,80 @@ class ContinuousBatchingEngine:
         paged engine overrides."""
         return None
 
+    def _handoff_slot(self, slot: int, req: Request) -> None:
+        """Hand a freshly prefilled request to a decode-capable replica
+        (worker thread only; prefill-role replicas with a fleet-installed
+        ``handoff`` hook).
+
+        Uses the migration machinery one slot at a time: bank the first
+        token preempt-style (the paged engine also spills the ingested
+        blocks to the shared host tier under their prefix keys), free the
+        slot, detach the request, and ask the hook to place it on a
+        decode replica — the adopter restores the blocks through
+        ``_restore_shared`` and enters plain decode, the waiter and any
+        token stream ride the ``Request`` object unbroken. EVERY failure
+        degrades to decode-on-this-replica: a fault before the spill
+        leaves the slot live and decoding; a hook failure after the spill
+        re-attaches the request to the local queue, where re-admission
+        resumes from the locally cached blocks. Greedy output is
+        bit-identical on every path (the preemption/migration invariant:
+        the banked tokens' KV is re-derived, never trusted)."""
+        try:
+            self.faults.maybe_fail_handoff()
+            self._bank_and_spill(slot, req)
+            self._release(slot)
+            self._detach_request(req)
+        except BaseException as e:  # noqa: BLE001 — degrade, never drop
+            # nothing left this engine: the slot is still mapped and live
+            req.handoff_failed = True
+            self.stats.incr("requests_handoff_failed")
+            self.recorder.record(
+                "handoff_failed",
+                request=req.id,
+                where="spill",
+                error=f"{type(e).__name__}: {e}",
+            )
+            return
+        adopted = False
+        err: Optional[str] = None
+        try:
+            adopted = bool(self.handoff(req))
+        except BaseException as e:  # noqa: BLE001 — degrade, never drop
+            err = f"{type(e).__name__}: {e}"
+        if adopted:
+            self.stats.incr("requests_handed_off")
+            if req.trace is not None:
+                req.trace.mark("handoff")
+            self.recorder.record(
+                "handoff",
+                request=req.id,
+                tokens_banked=len(req.preempted_tokens),
+            )
+            return
+        # no decode replica took it: decode in place. The blocks are still
+        # resident in the local prefix cache, so re-admission restores the
+        # slot without re-running the long prefill — and the flag keeps
+        # the re-admitted request from re-entering the handoff guard.
+        req.handoff_failed = True
+        try:
+            self._attach_request(req)
+            self._waiting.append(req)
+        except BaseException as attach_err:  # noqa: BLE001
+            # re-adopt failed (e.g. adapter pool now full): the pin was
+            # already released, so balance the ledger by hand and fail the
+            # waiter rather than hang it (mirrors _apply_export)
+            req.adapter = None
+            with self._plock:
+                self._pending += 1
+            self._resolve_error(req, attach_err)
+        self.stats.incr("requests_handoff_failed")
+        self.recorder.record(
+            "handoff_failed",
+            request=req.id,
+            where="adopt",
+            error=err or "no decode-capable replica accepted",
+        )
+
     def _recover(self, cause: BaseException) -> bool:
         """Classify a worker failure; True = state rebuilt, serve again."""
         if self._watchdog is not None:
@@ -2133,7 +2230,15 @@ class ContinuousBatchingEngine:
         self._slot_budget[slot] = min(self._budget_cap(req), self._buf_len - plen)
         self._live[slot] = True
         self.stats.incr("requests_admitted")
-        self._emit_token(slot, req, first)
+        self.stats.incr("prefill_tokens", plen)
+        self._emit_token(slot, req, first, from_decode=False)
+        if (
+            self.role == "prefill"
+            and self.handoff is not None
+            and not req.handoff_failed
+            and self._slot_req[slot] is req
+        ):
+            self._handoff_slot(slot, req)
 
     def _tick_done(self, t0: float) -> None:
         """Per-tick epilogue shared by all four decode variants: stamp the
@@ -2179,7 +2284,7 @@ class ContinuousBatchingEngine:
         vals = self.stats.values((
             "requests_admitted", "requests_shed_overflow",
             "requests_shed_deadline", "requests_shed_tenant_quota",
-            "tokens_served",
+            "tokens_served", "prefill_tokens", "decode_tokens",
         ))
         self.load_forecaster.update(
             now,
@@ -2194,6 +2299,8 @@ class ContinuousBatchingEngine:
             queue_depth=self._queue_len(),
             queue_wait_s=self._queue_wait_ewma,
             live_slots=int(self._live.sum()),
+            prefill_tokens=vals["prefill_tokens"],
+            decode_tokens=vals["decode_tokens"],
         )
 
     def _decode_once(self, step) -> None:
@@ -2362,13 +2469,20 @@ class ContinuousBatchingEngine:
                 accepted=tick_accepted,
             )
 
-    def _emit_token(self, slot: int, req: Request, tok: int) -> None:
+    def _emit_token(
+        self, slot: int, req: Request, tok: int, from_decode: bool = True
+    ) -> None:
         if tok in self._eos:
             self._finish(slot, req)
             return
         self._slot_tokens[slot].append(tok)
         req.tokens_emitted += 1
         self.stats.incr("tokens_served")
+        if from_decode:
+            # stage-split attribution: decode-tick emissions only — the
+            # first token rides the prefill forward and its demand is
+            # already counted in prefill_tokens (prompt positions ingested)
+            self.stats.incr("decode_tokens")
         if req.adapter is not None:
             self.stats.tenant_incr(req.adapter, "tokens")
         # latency accounting against the tick clock stamped in _tick_done /
@@ -2864,6 +2978,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 jax.block_until_ready(self._cache)
             task.next += C
             self.stats.incr("prefill_chunks")
+            self.stats.incr("prefill_tokens", C)
             self.stats.observe("prefill_chunk_s", time.monotonic() - t0)
             if req.trace is not None:
                 req.trace.mark("prefill_chunk")
@@ -2903,6 +3018,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._now = time.monotonic()
         self._prefills.pop(0)
         self.stats.incr("prefill_chunks")
+        self.stats.incr("prefill_tokens", remaining)
         self.stats.observe("prefill_chunk_s", self._now - t0)
         if req.trace is not None:
             req.trace.mark("prefill", self._now)
@@ -2921,7 +3037,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         full = task.plen // self._block_len
         self._prefix.insert(task.keys[:full], self._slot_blocks[task.slot][:full])
         self._live[task.slot] = True
-        self._emit_token(task.slot, req, first)
+        self._emit_token(task.slot, req, first, from_decode=False)
+        # disaggregation: a prefill-role replica's work ends at the first
+        # token — hand the live request (and its ingested blocks, via the
+        # host tier) to a decode-capable replica. Failure decodes in place.
+        if (
+            self.role == "prefill"
+            and self.handoff is not None
+            and not req.handoff_failed
+            and self._slot_req[task.slot] is req
+        ):
+            self._handoff_slot(task.slot, req)
 
     def _decode_bucket(self, lookahead: int) -> int:
         """Power-of-two block-count bucket covering every live slot's

@@ -198,6 +198,41 @@ class EngineFleet:
         # scale_decision events (GET /v1/flight merges it with replicas')
         self.recorder = FlightRecorder(1024)
         self._saturation = SaturationModel()
+        # disaggregated prefill/decode: install the handoff hook on every
+        # prefill-role replica and sanity-check the role mix. A fleet with
+        # roles but no prefill-capable (or no decode-capable) replica is
+        # not dead — routing and handoff both degrade to mixed behavior —
+        # but it is almost certainly a misconfiguration, so say so once.
+        for rid, rep in self._by_id.items():
+            self._wire_roles(rid, rep)
+        roles = [getattr(r, "role", "mixed") for r in self._by_id.values()]
+        if any(r != "mixed" for r in roles):
+            missing = [
+                stage for stage in ("prefill", "decode")
+                if not any(r in (stage, "mixed") for r in roles)
+            ]
+            for stage in missing:
+                import warnings
+
+                warnings.warn(
+                    f"fleet has no {stage}-capable replica (roles: {roles}); "
+                    "degrading to mixed placement — every replica will both "
+                    "prefill and decode",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                self.recorder.record(
+                    "role_degraded", missing=stage, roles=list(roles)
+                )
+
+    def _wire_roles(self, rid: int, rep) -> None:
+        """Attach the prefill→decode handoff hook to a prefill-role
+        replica (engines expose ``role``/``handoff``; scripted stubs
+        don't and are left alone)."""
+        if getattr(rep, "role", "mixed") == "prefill" and hasattr(
+            rep, "handoff"
+        ):
+            rep.handoff = lambda req, _rid=rid: self._handoff(_rid, req)
 
     # --------------------------------------------------------- replica set
 
@@ -214,12 +249,17 @@ class EngineFleet:
         shift when the fleet scales, ids never do."""
         return list(self._by_id.items())
 
-    def add_replica(self):
+    def add_replica(self, role: Optional[str] = None):
         """Grow the fleet by one replica (cheap: replicas share the one
         resident Generator, so a new replica is a supervisor + KV/block
         pool + stats — no weight load, no recompile). Returns
         ``(new_id, replica)``. Raises RuntimeError when the fleet was
-        built without a ``replica_factory``."""
+        built without a ``replica_factory``.
+
+        ``role`` asks the factory for a ``prefill``/``decode``/``mixed``
+        replica (the ratio autoscaler's dimension); factories that take
+        only the replica id (the pre-role signature) get it omitted and
+        build their default."""
         if self._replica_factory is None:
             raise RuntimeError(
                 "fleet has no replica_factory; add_replica is disabled"
@@ -229,11 +269,24 @@ class EngineFleet:
             self._next_id += 1
         # build OUTSIDE the lock: pool allocation may take a while and the
         # router must keep placing on the existing replicas meanwhile
-        rep = self._replica_factory(rid)
+        if role is None:
+            rep = self._replica_factory(rid)
+        else:
+            try:
+                rep = self._replica_factory(rid, role=role)
+            except TypeError:
+                # pre-role factory signature: build the default flavor
+                rep = self._replica_factory(rid)
+        self._wire_roles(rid, rep)
         with self._lock:
             self._by_id[rid] = rep
             n = len(self._by_id)
-        self.recorder.record("scale_up", replica=rid, replicas=n)
+        self.recorder.record(
+            "scale_up",
+            replica=rid,
+            replicas=n,
+            role=getattr(rep, "role", "mixed"),
+        )
         return rid, rep
 
     def retire_replica(
@@ -241,6 +294,7 @@ class EngineFleet:
         rid: Optional[int] = None,
         timeout_s: float = 60.0,
         migrate: Optional[bool] = None,
+        role: Optional[str] = None,
     ):
         """Shrink the fleet by one replica, gracefully: close the
         replica's admission (the router stops choosing it the moment
@@ -260,10 +314,21 @@ class EngineFleet:
         On drain timeout the replica is torn down anyway — its waiters
         still hold a reference and settle normally, but tokens they emit
         after the fold are not added to fleet totals (undercount, never
-        a decrease)."""
+        a decrease).
+
+        ``role`` (the ratio autoscaler's scale-down dimension) retires the
+        NEWEST replica of that role instead of the newest overall; raises
+        KeyError when no replica has it."""
         with self._lock:
             if len(self._by_id) <= 1:
                 raise ValueError("cannot retire the last replica")
+            if rid is None and role is not None:
+                for cand in reversed(self._by_id):
+                    if getattr(self._by_id[cand], "role", "mixed") == role:
+                        rid = cand
+                        break
+                if rid is None:
+                    raise KeyError(f"no replica with role {role!r}")
             if rid is None:
                 rid = next(reversed(self._by_id))
             if rid not in self._by_id:
@@ -297,7 +362,7 @@ class EngineFleet:
             n = len(self._by_id)
         self.recorder.record(
             "scale_down", replica=rid, replicas=n, drained=bool(drained),
-            migrated=migrated,
+            migrated=migrated, role=getattr(rep, "role", "mixed"),
         )
         return rid
 
@@ -411,6 +476,57 @@ class EngineFleet:
                     "migrate_fallback", request=req.id, source=rid
                 )
         return moved
+
+    def _handoff(self, source_rid: int, req) -> bool:
+        """Place one freshly prefilled request on a decode-capable replica
+        (the prefill replica's ``handoff`` hook; runs ON its worker
+        thread, so it must never block on another replica's worker).
+
+        Candidates are decode-capable (role ``decode`` or ``mixed``),
+        available, and adoption-capable siblings; replicas sharing the
+        source's host block tier sort first (the spilled blocks are
+        ALREADY resident in their restore path — any other tier means a
+        full re-prefill on the adopter), then least busy. Returns True
+        once a sibling adopts; False tells the engine to decode in place.
+        """
+        source = self._by_id.get(source_rid)
+        source_tier = getattr(source, "_host_tier", None)
+        candidates = []
+        for tid, rep in self.replica_items():
+            if tid == source_rid or rep is source:
+                continue
+            if getattr(rep, "role", "mixed") == "prefill":
+                continue
+            if not rep.healthy or rep.draining or rep.recovering:
+                continue
+            if not hasattr(rep, "adopt_request"):
+                continue
+            shares_tier = (
+                source_tier is not None
+                and getattr(rep, "_host_tier", None) is source_tier
+            )
+            candidates.append(
+                (
+                    0 if shares_tier else 1,
+                    rep.queue_depth + rep.live_slots,
+                    tid,
+                    rep,
+                )
+            )
+        for _, _, tid, rep in sorted(candidates, key=lambda c: c[:3]):
+            try:
+                rep.adopt_request(req)
+            except Exception:  # noqa: BLE001 — try the next sibling
+                continue
+            stats = getattr(rep, "stats", None)
+            if stats is not None:
+                stats.incr("slots_migrated")
+            self._repin_prefix(req, tid)
+            self.recorder.record(
+                "handoff", request=req.id, source=source_rid, target=tid
+            )
+            return True
+        return False
 
     def _repin_prefix(self, req, target_rid: int) -> None:
         """Point the router's prefix intent map at the adopting replica:
@@ -544,11 +660,16 @@ class EngineFleet:
                     brownout_stage=int(
                         getattr(rep, "brownout_stage", 0) or 0
                     ),
+                    # disaggregation: decode-only replicas leave the
+                    # candidate set for NEW requests (they only adopt
+                    # post-prefill handoffs); stubs read as mixed
+                    role=str(getattr(rep, "role", "mixed") or "mixed"),
                 )
             )
         with self._lock:
             placement = choose_replica(
-                self.routing, views, self._rr_seq, best_effort=best_effort
+                self.routing, views, self._rr_seq, best_effort=best_effort,
+                stage="prefill",
             )
             if placement is None:
                 return None
@@ -1128,6 +1249,26 @@ class EngineFleet:
             1
             for rep in self.replicas
             if rep.healthy and not rep.draining and not rep.recovering
+        )
+        # disaggregation: stage-split token totals grouped by replica role
+        # (live replicas only — the exposition renders these as the
+        # role-labelled serving_role_* series). A homogeneous fleet reads
+        # as one "mixed" bucket.
+        by_role: Dict[str, Dict[str, int]] = {}
+        for s in snaps:
+            rec = by_role.setdefault(
+                str(s.get("role", "mixed")),
+                {"replicas": 0, "prefill_tokens": 0, "decode_tokens": 0},
+            )
+            rec["replicas"] += 1
+            rec["prefill_tokens"] += int(s.get("prefill_tokens", 0))
+            rec["decode_tokens"] += int(s.get("decode_tokens", 0))
+        agg["tokens_by_role"] = by_role
+        # fleet-level role label: uniform fleets report the shared role,
+        # any prefill/decode split reports "disaggregated"
+        roles = set(by_role)
+        agg["role"] = roles.pop() if len(roles) == 1 else (
+            "disaggregated" if roles else "mixed"
         )
         with self._lock:
             agg.update(self._counters)

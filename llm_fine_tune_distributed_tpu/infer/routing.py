@@ -42,6 +42,15 @@ import numpy as np
 
 ROUTING_POLICIES = ("prefix", "least-loaded", "round-robin")
 
+# Replica roles for disaggregated prefill/decode serving. A ``mixed``
+# replica interleaves chunked prefill with decode ticks (the historical
+# behavior); a ``prefill`` replica runs prompts to first-token and hands
+# the live request off to a decode-capable replica; a ``decode`` replica
+# only adopts handed-off requests and runs plain decode. New requests
+# route to prefill-capable replicas (prefill or mixed); handoffs land on
+# decode-capable ones (decode or mixed).
+REPLICA_ROLES = ("mixed", "prefill", "decode")
+
 
 def prefix_block_keys(prompt: Sequence[int], block_len: int) -> List[bytes]:
     """One key per FULL leading prompt block: the raw bytes of the prompt's
@@ -77,6 +86,11 @@ class ReplicaView:
     # best_effort requests — the router sheds that tier fleet-wide before
     # each engine's own admission gate has to
     brownout_stage: int = 0
+    # disaggregation: one of REPLICA_ROLES. Decode-only replicas leave the
+    # candidate set for new requests (stage="prefill"); if that empties
+    # the set the filter is dropped and the fleet degrades to mixed
+    # placement rather than going dead.
+    role: str = "mixed"
 
     @property
     def available(self) -> bool:
@@ -112,23 +126,35 @@ def choose_replica(
     views: Sequence[ReplicaView],
     rr_seq: int = 0,
     best_effort: bool = False,
+    stage: str = "prefill",
 ) -> Optional[Placement]:
     """Deterministic placement over the available views; None if none are.
 
     ``rr_seq`` is the router's monotonically increasing placement counter;
     it drives the round-robin rotation AND breaks exact load ties under
     the other policies, so the decision is a pure function of
-    (policy, views, rr_seq, best_effort). ``best_effort`` requests also
-    exclude stage-3 brownout replicas (fleet-wide tier shedding); higher
-    tiers route through brownout normally.
+    (policy, views, rr_seq, best_effort, stage). ``best_effort`` requests
+    also exclude stage-3 brownout replicas (fleet-wide tier shedding);
+    higher tiers route through brownout normally. ``stage`` is which phase
+    the placed work enters: ``"prefill"`` (a new request — decode-only
+    replicas are excluded) or ``"decode"`` (a post-prefill handoff —
+    prefill-only replicas are excluded). The role filter is best-effort:
+    if it would empty the candidate set (e.g. an all-decode fleet), it is
+    dropped and placement degrades to mixed behavior instead of None.
     """
     if policy not in ROUTING_POLICIES:
         raise ValueError(
             f"unknown routing policy {policy!r}; choose from {ROUTING_POLICIES}"
         )
+    if stage not in ("prefill", "decode"):
+        raise ValueError(f"unknown stage {stage!r}; choose 'prefill' or 'decode'")
     cands = [v for v in views if v.available]
     if best_effort:
         cands = [v for v in cands if v.brownout_stage < 3]
+    excluded_role = "decode" if stage == "prefill" else "prefill"
+    staged = [v for v in cands if v.role != excluded_role]
+    if staged:
+        cands = staged
     if not cands:
         return None
     if policy == "round-robin":

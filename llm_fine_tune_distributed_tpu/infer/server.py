@@ -71,7 +71,13 @@ replicas behind the in-process fleet router (infer/fleet.py): params are
 shared read-only, placement follows ``--routing`` (prefix-cache affinity
 by default), replica failures fail over to siblings, and ``/v1/stats`` +
 ``/metrics`` report fleet aggregates plus per-replica series labelled
-``replica="i"``.
+``replica="i"``. ``--replica-roles prefill,decode,...`` disaggregates the
+fleet into prefill/decode pools: new requests land on prefill-capable
+replicas, finished prompts hand their KV blocks to a decode replica
+through the shared ``--host-tier-mb`` tier (greedy bit-identical; any
+handoff failure decodes in place), and ``--autoscale-ratio`` lets the
+autoscaler move the pool ratio toward the observed prefill/decode
+token-demand split.
 
 Run: ``python -m llm_fine_tune_distributed_tpu.infer.server --model-dir ...``
 or ``ask_tuned_model.py --serve``.
@@ -109,10 +115,12 @@ def serve(
     engine_kind: str = "continuous",
     replicas: int = 1,
     routing: str = "prefix",
+    replica_roles: Optional[str] = None,
     autoscale: str = "dry-run",
     min_replicas: int = 1,
     max_replicas: int = 0,
     scale_cooldown_s: float = 30.0,
+    autoscale_ratio: bool = False,
     slots: int = 8,
     kv_buf_len: int = 4096,
     kv_block_len: int = 256,
@@ -174,7 +182,10 @@ def serve(
     )
 
     from llm_fine_tune_distributed_tpu.infer.fleet import EngineFleet
-    from llm_fine_tune_distributed_tpu.infer.routing import ROUTING_POLICIES
+    from llm_fine_tune_distributed_tpu.infer.routing import (
+        REPLICA_ROLES,
+        ROUTING_POLICIES,
+    )
     from llm_fine_tune_distributed_tpu.observe.capacity import (
         Autoscaler,
         report_from_capacity_snapshots,
@@ -287,6 +298,45 @@ def serve(
             "requests to SIBLING replicas; it needs a fleet — set "
             "--replicas > 1 (or --max-replicas above --replicas) or drop "
             "--migrate-on-retire"
+        )
+    # disaggregated prefill/decode pools: per-replica roles, parsed here so
+    # a bad role string fails before the model load
+    role_list: list = []
+    if replica_roles:
+        role_list = [
+            r.strip() for r in str(replica_roles).split(",") if r.strip()
+        ]
+        bad = [r for r in role_list if r not in REPLICA_ROLES]
+        if bad:
+            raise ValueError(
+                f"unknown role(s) {bad} in --replica-roles (expected a "
+                f"comma list over {REPLICA_ROLES})"
+            )
+        if len(role_list) != replicas:
+            raise ValueError(
+                "--replica-roles must name one role per starting replica; "
+                f"got {len(role_list)} roles for --replicas {replicas}"
+            )
+        if not (replicas > 1 or max_replicas > replicas):
+            raise ValueError(
+                "--replica-roles splits a FLEET into prefill/decode pools; "
+                "set --replicas > 1 (or --max-replicas above --replicas) "
+                "or drop --replica-roles"
+            )
+        if any(r != "mixed" for r in role_list) and (
+            engine_kind != "paged" or not host_tier_mb
+        ):
+            raise ValueError(
+                "prefill/decode roles hand a request over by shipping its "
+                "KV blocks through the shared host tier — they need "
+                "--engine paged AND --host-tier-mb > 0; drop "
+                "--replica-roles or add both"
+            )
+    if autoscale_ratio and not any(r != "mixed" for r in role_list):
+        raise ValueError(
+            "--autoscale-ratio treats the prefill/decode pool ratio as a "
+            "scaling dimension; it needs --replica-roles with at least one "
+            "prefill or decode replica"
         )
     if publish_watch_dir and engine_kind == "window":
         raise ValueError(
@@ -447,12 +497,18 @@ def serve(
                 AdapterRegistry,
             )
 
-        def _make_replica(i: int):
+        def _make_replica(i: int, role: Optional[str] = None):
             # every replica wraps the SAME generator — params resident
             # once, jitted programs shared — but owns its own KV pool,
             # supervisor, and stats. Crash artifacts get per-replica
             # paths so two replicas' dumps cannot clobber each other.
+            # ``role`` comes from the autoscaler growing a specific pool;
+            # otherwise the --replica-roles list assigns by index and
+            # replicas grown past the list default to mixed.
             kw = dict(engine_kwargs)
+            kw["role"] = role or (
+                role_list[i] if i < len(role_list) else "mixed"
+            )
             from llm_fine_tune_distributed_tpu.observe.slo import (
                 SloPolicy,
             )
@@ -526,6 +582,7 @@ def serve(
             max_replicas=max_replicas or replicas,
             cooldown_s=scale_cooldown_s,
             retire_timeout_s=drain_timeout_s,
+            ratio=autoscale_ratio,
         )
         if autoscale != "off":
             autoscaler.start()
@@ -533,7 +590,11 @@ def serve(
                 f"[serve] autoscaler ({autoscale}): replicas in "
                 f"[{min_replicas}, {max_replicas or replicas}], "
                 f"cooldown {scale_cooldown_s:g}s"
+                + (", prefill/decode ratio dimension on"
+                   if autoscale_ratio else "")
             )
+    if role_list:
+        print(f"[serve] replica roles: {','.join(role_list)}")
     # on-demand profiler capture (POST /v1/profile): one per server process
     # (jax.profiler traces are process-wide). Captures go on the engine's
     # flight-recorder timeline so they line up with crashes and restarts.
@@ -1463,6 +1524,17 @@ def main(argv: Optional[list] = None) -> int:
              "smallest backlog per slot; round-robin = strict rotation",
     )
     parser.add_argument(
+        "--replica-roles", default=None, metavar="R1,R2,...",
+        help="disaggregated serving: comma list assigning each starting "
+             "replica a pool role (mixed|prefill|decode), e.g. "
+             "'prefill,decode'. New requests route to prefill-capable "
+             "replicas; after the prompt is ingested the request hands "
+             "over to a decode replica through the shared host KV tier "
+             "(greedy output bit-identical; any handoff failure degrades "
+             "to decoding in place). Needs --engine paged, "
+             "--host-tier-mb > 0, and a fleet",
+    )
+    parser.add_argument(
         "--autoscale", choices=["dry-run", "on", "off"], default="dry-run",
         help="elastic fleet control loop (observe/capacity.py): dry-run "
              "(default) records every would-be scale decision on "
@@ -1484,6 +1556,14 @@ def main(argv: Optional[list] = None) -> int:
         "--scale-cooldown-s", type=float, default=30.0,
         help="autoscaler: seconds between APPLIED scale actions, so a "
              "burst cannot ladder the fleet up faster than replicas warm",
+    )
+    parser.add_argument(
+        "--autoscale-ratio", action="store_true",
+        help="autoscaler (--replica-roles): treat the prefill/decode pool "
+             "ratio as a scaling dimension — count changes grow/retire the "
+             "most/least saturated role, and a starved role inside the "
+             "count band grows (or trades a surplus dedicated replica) "
+             "toward the demand split",
     )
     parser.add_argument(
         "--slots", type=int, default=8,
@@ -1754,9 +1834,11 @@ def main(argv: Optional[list] = None) -> int:
           adapter_dir=args.adapter_dir, max_adapters=args.max_adapters,
           adapter_capacity=args.adapter_capacity,
           engine_kind=args.engine, replicas=args.replicas,
-          routing=args.routing, autoscale=args.autoscale,
+          routing=args.routing, replica_roles=args.replica_roles,
+          autoscale=args.autoscale,
           min_replicas=args.min_replicas, max_replicas=args.max_replicas,
-          scale_cooldown_s=args.scale_cooldown_s, slots=args.slots,
+          scale_cooldown_s=args.scale_cooldown_s,
+          autoscale_ratio=args.autoscale_ratio, slots=args.slots,
           kv_buf_len=args.kv_buf_len, kv_block_len=args.kv_block_len,
           prefill_chunk=args.prefill_chunk,
           host_tier_mb=args.host_tier_mb,

@@ -165,6 +165,9 @@ class FaultInjector:
         self._spill_next = 0
         self._restore_next = 0
         self._migrate_next = 0
+        # disaggregation: handoff = the prefill→decode hand-over of one
+        # finished-prefill request; failure degrades to decode-in-place
+        self._handoff_next = 0
         # latency (not failure) injection: (remaining ticks, seconds each)
         self._decode_delay = (0, 0.0)
 
@@ -206,6 +209,14 @@ class FaultInjector:
         back to drain-wait; the request completes on exactly one replica."""
         with self._lock:
             self._migrate_next += int(k)
+
+    def fail_handoff_next(self, k: int = 1) -> None:
+        """Fail the next ``k`` prefill→decode handoffs, then heal. The
+        prefill replica keeps the request and decodes it in place —
+        greedy output stays bit-identical, only the disaggregation win is
+        lost for that request."""
+        with self._lock:
+            self._handoff_next += int(k)
 
     def delay_decode_next(self, k: int = 1, seconds: float = 0.05) -> None:
         """Slow (don't fail) the next ``k`` decode ticks by ``seconds``
@@ -267,3 +278,10 @@ class FaultInjector:
                 return
             self._migrate_next -= 1
         raise InjectedFault("injected migration failure")
+
+    def maybe_fail_handoff(self) -> None:
+        with self._lock:
+            if self._handoff_next <= 0:
+                return
+            self._handoff_next -= 1
+        raise InjectedFault("injected prefill->decode handoff failure")

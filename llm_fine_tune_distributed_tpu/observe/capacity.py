@@ -55,7 +55,13 @@ class LoadForecaster:
     a fake timeline).
     """
 
-    RATES = ("arrival_rate", "admit_rate", "token_rate")
+    RATES = (
+        "arrival_rate", "admit_rate", "token_rate",
+        # disaggregation split of token_rate: prompt positions ingested by
+        # prefill forwards vs tokens emitted by decode ticks — the two
+        # demand axes the prefill:decode ratio autoscaler balances
+        "prefill_token_rate", "decode_token_rate",
+    )
 
     def __init__(self, short_tau_s: float = 30.0, long_tau_s: float = 300.0):
         self.short_tau_s = float(short_tau_s)
@@ -81,16 +87,22 @@ class LoadForecaster:
         queue_depth: int = 0,
         queue_wait_s: float = 0.0,
         live_slots: int = 0,
+        prefill_tokens: int = 0,
+        decode_tokens: int = 0,
     ) -> None:
         """One sample: cumulative ``arrivals``/``admitted``/``tokens``
         totals plus instantaneous gauges, stamped ``now`` (the caller's
-        tick clock)."""
+        tick clock). ``prefill_tokens``/``decode_tokens`` are the
+        cumulative stage-split counters; callers that don't track the
+        split may omit them (the split rates then read 0)."""
         if self._t is None:
             self._t = now
             self._counters = {
                 "arrival_rate": int(arrivals),
                 "admit_rate": int(admitted),
                 "token_rate": int(tokens),
+                "prefill_token_rate": int(prefill_tokens),
+                "decode_token_rate": int(decode_tokens),
             }
             return
         dt = now - self._t
@@ -103,6 +115,8 @@ class LoadForecaster:
             "arrival_rate": int(arrivals),
             "admit_rate": int(admitted),
             "token_rate": int(tokens),
+            "prefill_token_rate": int(prefill_tokens),
+            "decode_token_rate": int(decode_tokens),
         }
         prev_token_short = self._short.get("token_rate")
         for name, total in totals.items():
@@ -131,28 +145,58 @@ class LoadForecaster:
     def trend_tokens_per_s2(self) -> float:
         return self._trend or 0.0
 
-    def rate(self, name: str, horizon: str = "short") -> float:
-        table = self._short if horizon == "short" else self._long
-        return table.get(name, 0.0)
+    def _staleness(self, now: Optional[float], tau: float) -> float:
+        """Read-side decay factor for a stale forecaster. ``update`` only
+        runs when the engine ticks, so an idle replica's EWMAs freeze at
+        whatever rate the last busy tick measured — on a starved runner
+        that frozen peak kept the fleet's demand estimate high through a
+        quiet phase and the scale-DOWN band never fired (the PR 17
+        SERVE_ELASTIC failure). Decaying by ``exp(-(now - last)/tau)`` at
+        read is exactly the continuous limit of feeding zero-rate samples
+        over the gap, so a silent forecaster reads the same as one that
+        kept sampling an idle engine."""
+        if now is None or self._t is None:
+            return 1.0
+        gap = now - self._t
+        if gap <= 0.0:
+            return 1.0
+        return math.exp(-gap / tau)
 
-    def forecast(self, horizon_s: float) -> float:
+    def rate(
+        self, name: str, horizon: str = "short", now: Optional[float] = None
+    ) -> float:
+        table = self._short if horizon == "short" else self._long
+        tau = self.short_tau_s if horizon == "short" else self.long_tau_s
+        return table.get(name, 0.0) * self._staleness(now, tau)
+
+    def forecast(self, horizon_s: float, now: Optional[float] = None) -> float:
         """Projected token demand ``horizon_s`` ahead: the short-horizon
         rate extrapolated along the smoothed trend, floored at the long-
         horizon baseline's decay toward zero (never negative)."""
-        base = self.rate("token_rate", "short")
-        return max(0.0, base + self.trend_tokens_per_s2 * float(horizon_s))
+        base = self.rate("token_rate", "short", now=now)
+        trend = self.trend_tokens_per_s2 * self._staleness(now, self.long_tau_s)
+        return max(0.0, base + trend * float(horizon_s))
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Decision-ready view. Pass ``now`` (the reader's clock) to apply
+        staleness decay; omit it for the raw last-sample EWMAs."""
+        decay_s = self._staleness(now, self.short_tau_s)
         return {
             "samples": self.samples,
             "short_tau_s": self.short_tau_s,
             "long_tau_s": self.long_tau_s,
-            "rates_short": {n: self.rate(n, "short") for n in self.RATES},
-            "rates_long": {n: self.rate(n, "long") for n in self.RATES},
-            "trend_tokens_per_s2": self.trend_tokens_per_s2,
-            "queue_depth": self.queue_depth,
-            "queue_wait_s": self.queue_wait_s,
-            "live_slots_mean": self.live_slots_mean,
+            "rates_short": {
+                n: self.rate(n, "short", now=now) for n in self.RATES
+            },
+            "rates_long": {
+                n: self.rate(n, "long", now=now) for n in self.RATES
+            },
+            "trend_tokens_per_s2":
+                self.trend_tokens_per_s2
+                * self._staleness(now, self.long_tau_s),
+            "queue_depth": self.queue_depth * decay_s,
+            "queue_wait_s": self.queue_wait_s * decay_s,
+            "live_slots_mean": self.live_slots_mean * decay_s,
         }
 
 
@@ -207,8 +251,15 @@ def recommend_replicas(
     up: float = 0.85,
     down: float = 0.45,
     target: float = 0.65,
+    role: Optional[str] = None,
 ) -> int:
     """Hysteresis-banded replica recommendation (pure).
+
+    ``role`` scopes the recommendation to one stage of a disaggregated
+    fleet: the demand/capacity arguments are then that role's share (the
+    prefill-tokens/s or decode-tokens/s axis and the role-capable replica
+    count) rather than fleet totals. The band math is identical either
+    way — the label exists so per-role calls read as what they are.
 
     Utilization ``demand / (current x per_replica)`` inside ``[down, up]``
     holds the current count. Above ``up`` the recommendation jumps to
@@ -338,6 +389,61 @@ def capacity_report(
     }
 
 
+def role_sections(
+    roles: Sequence[str],
+    forecasts: Sequence[Dict[str, Any]],
+    replica_capacities: Sequence[float],
+    *,
+    growth: float = 1.0,
+    up: float = 0.85,
+    down: float = 0.45,
+    target: float = 0.65,
+) -> Dict[str, Dict[str, Any]]:
+    """Per-role demand/capacity/headroom view of a disaggregated fleet
+    (pure). ``roles``/``forecasts``/``replica_capacities`` are parallel
+    per-replica sequences; ``growth`` is the fleet forecast-to-now demand
+    ratio, applied to each role's measured demand so the role forecasts
+    sum to the fleet forecast.
+
+    Demand per stage is summed over EVERY replica (a mixed replica
+    contributes to both axes — its prefill tokens are prefill demand no
+    matter who served them). Capacity per stage counts the replicas
+    CAPABLE of that stage (dedicated + mixed) times the fleet's mean
+    per-replica throughput, and the recommendation applies the same
+    hysteresis bands as the fleet-level one to the role-scoped numbers.
+    """
+    known = [c for c in replica_capacities if c > 0.0]
+    per_replica = sum(known) / len(known) if known else 0.0
+    rate_key = {"prefill": "prefill_token_rate", "decode": "decode_token_rate"}
+    out: Dict[str, Dict[str, Any]] = {}
+    for stage in ("prefill", "decode"):
+        capable = [
+            i for i, r in enumerate(roles)
+            if r == stage or r == "mixed"
+        ]
+        dedicated = sum(1 for r in roles if r == stage)
+        demand_now = sum(
+            f.get("rates_short", {}).get(rate_key[stage], 0.0)
+            for f in forecasts
+        )
+        demand_fc = max(0.0, demand_now * growth)
+        capacity = per_replica * len(capable)
+        out[stage] = {
+            "replicas": len(capable),
+            "dedicated_replicas": dedicated,
+            "demand_tokens_per_s": demand_now,
+            "forecast_demand_tokens_per_s": demand_fc,
+            "capacity_tokens_per_s": capacity,
+            "headroom_tokens_per_s": capacity - demand_fc,
+            "utilization": demand_fc / capacity if capacity else 0.0,
+            "recommended_replicas": recommend_replicas(
+                demand_fc, per_replica, len(capable),
+                up=up, down=down, target=target, role=stage,
+            ),
+        }
+    return out
+
+
 def report_from_capacity_snapshots(
     snapshots: Sequence[Dict[str, Any]],
     current_replicas: int,
@@ -350,7 +456,10 @@ def report_from_capacity_snapshots(
     """``capacity_report`` straight from engine ``capacity_snapshot()``
     dicts: maps each snapshot through the saturation model and hands the
     forecaster views over. Shared by the fleet (N snapshots) and the
-    single-engine ``/v1/capacity`` path (one snapshot, a fleet of one)."""
+    single-engine ``/v1/capacity`` path (one snapshot, a fleet of one).
+    Snapshots carrying a ``role`` add a per-role ``roles`` section —
+    prefill vs decode demand, capacity, headroom, and a role-scoped
+    recommendation — the ratio signal the role-aware Autoscaler acts on."""
     model = model or SaturationModel()
     forecasts = [s.get("forecaster") or {} for s in snapshots]
     capacities = [
@@ -364,7 +473,7 @@ def report_from_capacity_snapshots(
         )
         for s in snapshots
     ]
-    return capacity_report(
+    report = capacity_report(
         forecasts,
         capacities,
         current_replicas,
@@ -372,6 +481,20 @@ def report_from_capacity_snapshots(
         min_replicas=min_replicas,
         max_replicas=max_replicas,
     )
+    roles = [str(s.get("role", "mixed")) for s in snapshots]
+    demand_now = report["current_load"]["demand_tokens_per_s"]
+    growth = (
+        report["forecast"]["demand_tokens_per_s"] / demand_now
+        if demand_now > 0.0 else 1.0
+    )
+    report["roles"] = role_sections(
+        roles, forecasts, capacities,
+        growth=growth,
+        up=report["bands"]["up"],
+        down=report["bands"]["down"],
+        target=report["bands"]["target"],
+    )
+    return report
 
 
 class Autoscaler:
@@ -407,6 +530,7 @@ class Autoscaler:
         history: int = 64,
         retire_timeout_s: float = 60.0,
         migrate_on_retire: Optional[bool] = None,
+        ratio: bool = False,
     ):
         if mode not in self.MODES:
             raise ValueError(
@@ -414,6 +538,12 @@ class Autoscaler:
             )
         self.fleet = fleet
         self.mode = mode
+        # ratio mode (--autoscale-ratio): the prefill:decode ratio becomes
+        # a scaling dimension. Scale-ups grow the most-pressured role,
+        # scale-downs retire from the least-pressured one, and a role
+        # imbalance with totals in-band still moves (grow the starved
+        # role, or trade a surplus replica away when already at max).
+        self.ratio = bool(ratio)
         self.min_replicas = max(1, int(min_replicas))
         self.max_replicas = max(self.min_replicas, int(max_replicas))
         self.cooldown_s = max(0.0, float(cooldown_s))
@@ -442,8 +572,15 @@ class Autoscaler:
         )
         current = int(report["replicas"])
         recommended = int(report["recommended_replicas"])
-        if recommended == current:
-            return None
+        role: Optional[str] = None
+        if recommended != current:
+            direction = "up" if recommended > current else "down"
+            role = self._pick_role(report, direction)
+        else:
+            ratio_move = self._ratio_move(report, current)
+            if ratio_move is None:
+                return None
+            direction, role, recommended = ratio_move
         in_cooldown = (
             self._last_action_t is not None
             and (now - self._last_action_t) < self.cooldown_s
@@ -453,7 +590,7 @@ class Autoscaler:
             "mode": self.mode,
             "replicas": current,
             "recommended_replicas": recommended,
-            "direction": "up" if recommended > current else "down",
+            "direction": direction,
             "demand_tokens_per_s":
                 report["forecast"]["demand_tokens_per_s"],
             "per_replica_tokens_per_s":
@@ -461,22 +598,28 @@ class Autoscaler:
             "cooldown": bool(in_cooldown),
             "applied": False,
         }
+        if self.ratio:
+            decision["role"] = role
+            roles = report.get("roles") or {}
+            if roles:
+                decision["role_demand_tokens_per_s"] = {
+                    r: s["demand_tokens_per_s"] for r, s in roles.items()
+                }
         if not in_cooldown and self.mode == "on":
             try:
-                if recommended > current:
-                    self.fleet.add_replica()
-                elif self.migrate_on_retire is None:
-                    # no override: the fleet's migrate_on_retire default
-                    # applies (kwarg omitted so scripted stub fleets with
-                    # the old retire signature keep working)
-                    self.fleet.retire_replica(
-                        timeout_s=self.retire_timeout_s
-                    )
+                kwargs: Dict[str, Any] = {}
+                if role is not None:
+                    # only role-aware fleets see the kwarg: scripted stub
+                    # fleets with the old signatures keep working when
+                    # ratio mode is off
+                    kwargs["role"] = role
+                if direction == "up":
+                    self.fleet.add_replica(**kwargs)
                 else:
-                    self.fleet.retire_replica(
-                        timeout_s=self.retire_timeout_s,
-                        migrate=self.migrate_on_retire,
-                    )
+                    kwargs["timeout_s"] = self.retire_timeout_s
+                    if self.migrate_on_retire is not None:
+                        kwargs["migrate"] = self.migrate_on_retire
+                    self.fleet.retire_replica(**kwargs)
                 decision["applied"] = True
                 self._last_action_t = now
             except Exception as e:  # fleet at bounds / factory failure
@@ -490,6 +633,55 @@ class Autoscaler:
         with self._lock:
             self._decisions.append(decision)
         return decision
+
+    def _pick_role(self, report: Dict[str, Any], direction: str) -> Optional[str]:
+        """Which role a count-driven step should touch (None = fleet
+        default, i.e. a mixed replica). Scale-ups grow the most-pressured
+        stage; scale-downs give back a dedicated replica of the
+        least-pressured stage, or defer to the fleet default when neither
+        stage has a dedicated replica to spare."""
+        roles = report.get("roles") or {}
+        if not self.ratio or not roles:
+            return None
+        if direction == "up":
+            return max(roles, key=lambda r: roles[r]["utilization"])
+        cands = [r for r in roles if roles[r]["dedicated_replicas"] > 0]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: roles[r]["utilization"])
+
+    def _ratio_move(
+        self, report: Dict[str, Any], current: int
+    ) -> Optional[tuple]:
+        """A ratio-only step when the fleet total is already in-band:
+        (direction, role, recommended) or None. A role whose scoped
+        recommendation exceeds its capable count is starved — grow it if
+        the fleet has headroom, otherwise trade away a dedicated replica
+        of an over-provisioned role so the next tick's count recovery
+        re-adds capacity where it's needed."""
+        if not self.ratio:
+            return None
+        roles = report.get("roles") or {}
+        if not roles or report["capacity"]["per_replica_tokens_per_s"] <= 0.0:
+            return None
+        over = [
+            r for r, s in roles.items()
+            if s["recommended_replicas"] > s["replicas"]
+        ]
+        under = [
+            r for r, s in roles.items()
+            if s["recommended_replicas"] < s["replicas"]
+            and s["dedicated_replicas"] > 0
+        ]
+        if not over:
+            return None
+        starved = max(over, key=lambda r: roles[r]["utilization"])
+        if current < self.max_replicas:
+            return ("up", starved, current + 1)
+        if under and current > self.min_replicas:
+            surplus = min(under, key=lambda r: roles[r]["utilization"])
+            return ("down", surplus, current - 1)
+        return None
 
     def decisions(self, limit: int = 64) -> List[Dict[str, Any]]:
         """Most recent decisions, newest last (bounded history for

@@ -95,6 +95,16 @@ class ServingStats:
         "prefix_blocks_spilled", "prefix_blocks_discarded",
         "host_tier_restore_hits", "host_tier_restore_misses",
         "slots_migrated",
+        # disaggregated serving: token attribution split by stage.
+        # prefill_tokens counts prompt positions a prefill forward actually
+        # ingested (prefix-cache hits don't count — they create no prefill
+        # demand); decode_tokens counts tokens emitted by decode ticks.
+        # tokens_served stays the user-facing total (first token included).
+        # requests_handed_off counts prefill->decode handoffs that left
+        # this replica; requests_handoff_failed counts handoffs that
+        # degraded to decode-in-place.
+        "prefill_tokens", "decode_tokens",
+        "requests_handed_off", "requests_handoff_failed",
     )
     GAUGES = (
         "queue_depth", "live_slots", "engine_generation",
@@ -515,6 +525,27 @@ def prometheus_exposition(
                         f'{name}{{replica="{label}",result="{result}"}} '
                         f"{int(rsnap[key])}"
                     )
+    # disaggregated serving: the fleet's stage-split token totals grouped
+    # by replica role (``tokens_by_role`` is a dict value, skipped by the
+    # numeric loop), emitted with a ``role`` label. Gated on the key (only
+    # fleet aggregates carry it); TYPE lines are then UNCONDITIONAL so the
+    # schema is identical for homogeneous and disaggregated fleets.
+    by_role = snap.get("tokens_by_role")
+    if by_role is not None:
+        for key, kind in (
+            ("prefill_tokens", "counter"),
+            ("decode_tokens", "counter"),
+            ("replicas", "gauge"),
+        ):
+            name = f"{prefix}_role_{key}"
+            if kind == "counter":
+                name += "_total"
+            lines.append(f"# TYPE {name} {kind}")
+            for role in sorted(by_role):
+                lines.append(
+                    f'{name}{{role="{role}"}} '
+                    f"{int(by_role[role].get(key, 0))}"
+                )
     # compile-ledger samples: ``compile`` is a nested dict (skipped by the
     # numeric loop), so per-program compile counts/seconds are emitted
     # explicitly with a ``program`` label. TYPE lines are UNCONDITIONAL so

@@ -1,0 +1,577 @@
+"""Disaggregated prefill/decode pools (infer/routing.py roles +
+infer/engine.py handoff + infer/fleet.py placement + observe/capacity.py
+ratio autoscaling).
+
+What this file pins, layer by layer:
+
+- ``choose_replica`` stage filtering: new requests never land on a
+  decode-only replica, handoffs never land on a prefill-only one, and a
+  filter that would empty the candidate set is DROPPED (an all-decode
+  fleet degrades to mixed placement instead of going dead);
+- a prefill-role replica runs the prompt to first token and hands the
+  live request to a decode replica through the shared host tier — the
+  original stream iterator finishes there, greedy output bit-identical
+  to a mixed fleet and to solo ``generate_ids``;
+- EVERY handoff failure (injected fault, no decode sibling) degrades to
+  decode-on-the-prefill-replica with IDENTICAL greedy output — slower,
+  never a drop;
+- handoff placement prefers the sibling sharing the source's host block
+  tier (its restore path already holds the spilled blocks);
+- ``prefill_tokens``/``decode_tokens`` split ``tokens_served`` by stage
+  (first tokens ride the prefill forward and land in neither split);
+- the forecaster's read-side staleness decay: an idle replica's frozen
+  peak rates decay toward zero at read, so the scale-down band can fire
+  on a starved runner whose engines stopped ticking (the PR 17
+  SERVE_ELASTIC failure);
+- ``capacity_report`` grows per-role demand/capacity/headroom sections,
+  and the ratio-mode ``Autoscaler`` grows the starved role, trades away
+  a surplus dedicated replica at max, and stamps the role into its
+  ``scale_decision`` events.
+"""
+
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_fine_tune_distributed_tpu.data.tokenizer import ByteChatMLTokenizer
+from llm_fine_tune_distributed_tpu.infer import (
+    EngineFleet,
+    GenerationConfig,
+    Generator,
+)
+from llm_fine_tune_distributed_tpu.infer.engine import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
+from llm_fine_tune_distributed_tpu.infer.paged import HostBlockTier
+from llm_fine_tune_distributed_tpu.infer.routing import (
+    REPLICA_ROLES,
+    ReplicaView,
+    choose_replica,
+)
+from llm_fine_tune_distributed_tpu.models.configs import get_preset
+from llm_fine_tune_distributed_tpu.models.transformer import init_params
+from llm_fine_tune_distributed_tpu.observe.capacity import (
+    Autoscaler,
+    LoadForecaster,
+    report_from_capacity_snapshots,
+)
+from llm_fine_tune_distributed_tpu.observe.tracing import FlightRecorder
+
+GREEDY = GenerationConfig(max_new_tokens=6, do_sample=False)
+GREEDY48 = GenerationConfig(max_new_tokens=48, do_sample=False)
+
+
+@pytest.fixture(scope="module")
+def generator():
+    mc = get_preset("tiny")
+    params = init_params(jax.random.PRNGKey(0), mc, dtype=jnp.float32)
+    return Generator(
+        params, mc, ByteChatMLTokenizer(), compute_dtype=jnp.float32,
+        eos_token_ids=[],
+    )
+
+
+def _enc(text):
+    return ByteChatMLTokenizer().encode(text)
+
+
+def _paged(generator, tier, role="mixed", **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("buf_len", 256)
+    kw.setdefault("prompt_bucket", 64)
+    kw.setdefault("block_len", 16)
+    kw.setdefault("prefill_chunk", 256)
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("restart_backoff_max_s", 0.02)
+    return PagedContinuousBatchingEngine(
+        generator, host_tier=tier, role=role, **kw
+    )
+
+
+def _role_fleet(generator, roles, tier=None):
+    """Fleet with per-replica roles sharing ONE HostBlockTier — the
+    sharing is the handoff transport (server.py wires it the same way)."""
+    tier = tier if tier is not None else HostBlockTier(64 << 20)
+    return EngineFleet(
+        [_paged(generator, tier, role=r) for r in roles], routing="prefix"
+    ), tier
+
+
+# a prompt spanning >= 2 full 16-token blocks, so handoffs move blocks
+VICTIM_TEXT = "a forty-ish token victim prompt for prefill handoffs"
+
+
+# ------------------------------------------------------------ role routing
+
+
+def test_choose_replica_stage_filters_roles():
+    views = [
+        ReplicaView(0, role="decode"),
+        ReplicaView(1, role="prefill"),
+        ReplicaView(2, role="mixed"),
+    ]
+    for policy in ("prefix", "least-loaded", "round-robin"):
+        for seq in range(8):
+            # new requests: never on the decode-only replica
+            p = choose_replica(policy, views, rr_seq=seq)
+            assert p is not None and p.index in (1, 2)
+            # post-prefill handoffs: never on the prefill-only replica
+            p = choose_replica(policy, views, rr_seq=seq, stage="decode")
+            assert p is not None and p.index in (0, 2)
+
+
+def test_choose_replica_role_filter_degrades_not_dead():
+    # an all-decode fleet still places new requests (filter dropped)...
+    views = [ReplicaView(0, role="decode"), ReplicaView(1, role="decode")]
+    assert choose_replica("prefix", views).index in (0, 1)
+    # ...and an all-prefill fleet still accepts handoffs
+    assert choose_replica(
+        "prefix", [ReplicaView(0, role="prefill")], stage="decode"
+    ).index == 0
+    # an unknown stage is a caller bug, not a degradation
+    with pytest.raises(ValueError):
+        choose_replica("prefix", views, stage="verify")
+
+
+def test_engine_rejects_unknown_role(generator):
+    assert REPLICA_ROLES == ("mixed", "prefill", "decode")
+    with pytest.raises(ValueError):
+        ContinuousBatchingEngine(
+            generator, slots=1, buf_len=64, role="verifier"
+        )
+
+
+def test_all_decode_fleet_warns_and_serves(generator):
+    """A role mix with no prefill-capable replica is almost certainly a
+    misconfiguration: the fleet says so ONCE at startup, then degrades to
+    mixed placement instead of going dead."""
+    tier = HostBlockTier(64 << 20)
+    with pytest.warns(RuntimeWarning, match="no prefill-capable"):
+        fleet = EngineFleet(
+            [_paged(generator, tier, role="decode") for _ in range(2)],
+            routing="prefix",
+        )
+    assert any(
+        ev["kind"] == "role_degraded" and ev["missing"] == "prefill"
+        for ev in fleet.recorder.events()
+    )
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY)
+    assert fleet.submit(prompt, GREEDY, timeout=240) == solo
+
+
+# ------------------------------------------------------- handoff (tentpole)
+
+
+def test_prefill_to_decode_handoff_bit_identical(generator):
+    """The disaggregated path end-to-end: routing lands the new request on
+    the prefill replica, the first token triggers the handoff, the decode
+    replica adopts through the shared tier, and the ORIGINAL stream
+    iterator finishes there — tokens bit-identical to solo decode."""
+    fleet, _tier = _role_fleet(generator, ["prefill", "decode"])
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    stream = fleet.stream(prompt, GREEDY48, timeout=240)
+    tokens = list(stream)
+    assert tokens == solo
+    pre, dec = fleet.replicas
+    psnap, dsnap = pre.stats_snapshot(), dec.stats_snapshot()
+    # the prefill replica ingested the prompt, emitted the first token,
+    # handed off, and never ran a decode tick for this request
+    assert psnap["requests_handed_off"] == 1
+    assert psnap["requests_handoff_failed"] == 0
+    assert psnap["requests_completed"] == 0
+    assert psnap["prefill_tokens"] >= len(prompt) - 1
+    assert psnap["decode_tokens"] == 0
+    # the decode replica adopted and settled it — exactly once, fleet-wide
+    assert dsnap["slots_migrated"] == 1
+    assert dsnap["requests_completed"] == 1
+    assert dsnap["decode_tokens"] > 0
+    kinds = [ev["kind"] for ev in fleet.recorder.events()]
+    assert "handoff" in kinds
+    # both engines' traces carry the hop
+    assert any(ev["kind"] == "handoff" for ev in pre.recorder.events())
+    # fleet rollups: the role split and the per-role capacity sections
+    fsnap = fleet.stats_snapshot()
+    by_role = fsnap["tokens_by_role"]
+    assert by_role["prefill"]["replicas"] == 1
+    assert by_role["decode"]["replicas"] == 1
+    assert by_role["prefill"]["prefill_tokens"] >= len(prompt) - 1
+    assert by_role["prefill"]["decode_tokens"] == 0
+    assert by_role["decode"]["decode_tokens"] > 0
+    assert fsnap["role"] == "disaggregated"
+    report = fleet.capacity_report()
+    assert set(report["roles"]) == {"prefill", "decode"}
+    for sec in report["roles"].values():
+        assert sec["replicas"] == 1
+        for key in (
+            "demand_tokens_per_s", "forecast_demand_tokens_per_s",
+            "capacity_tokens_per_s", "headroom_tokens_per_s",
+            "utilization", "recommended_replicas", "dedicated_replicas",
+        ):
+            assert key in sec
+
+
+def test_handoff_fault_degrades_to_decode_in_place(generator):
+    """An injected handoff fault fires BEFORE anything leaves the prefill
+    replica: the slot stays live, decode continues in place, and greedy
+    output is bit-identical — the disaggregation win is lost for that one
+    request, nothing else."""
+    fleet, _tier = _role_fleet(generator, ["prefill", "decode"])
+    pre, dec = fleet.replicas
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    pre.faults.fail_handoff_next(1)
+    assert fleet.submit(prompt, GREEDY48, timeout=240) == solo
+    psnap = pre.stats_snapshot()
+    assert psnap["requests_handoff_failed"] == 1
+    assert psnap["requests_handed_off"] == 0
+    assert psnap["requests_completed"] == 1
+    assert dec.stats_snapshot()["requests_completed"] == 0
+    assert dec.stats_snapshot()["slots_migrated"] == 0
+    failed = [
+        ev for ev in pre.recorder.events() if ev["kind"] == "handoff_failed"
+    ]
+    assert failed and failed[-1]["where"] == "spill"
+    # the fault self-disarmed: the next request hands off normally
+    prompt2 = _enc("a different long prompt that should hand off cleanly")
+    solo2 = generator.generate_ids(prompt2, GREEDY48)
+    assert fleet.submit(prompt2, GREEDY48, timeout=240) == solo2
+    assert pre.stats_snapshot()["requests_handed_off"] == 1
+    assert dec.stats_snapshot()["requests_completed"] == 1
+
+
+def test_handoff_without_decode_sibling_decodes_in_place(generator):
+    """No adoptable decode replica (the only one is draining): the spill
+    already ran, so the request re-enters the LOCAL queue and re-admission
+    resumes from the locally cached blocks — identical output, counted as
+    a handoff failure at the adopt step."""
+    fleet, _tier = _role_fleet(generator, ["prefill", "decode"])
+    pre, dec = fleet.replicas
+    dec.begin_drain()
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    assert fleet.submit(prompt, GREEDY48, timeout=240) == solo
+    psnap = pre.stats_snapshot()
+    assert psnap["requests_handoff_failed"] == 1
+    assert psnap["requests_completed"] == 1
+    assert dec.stats_snapshot()["requests_completed"] == 0
+    failed = [
+        ev for ev in pre.recorder.events() if ev["kind"] == "handoff_failed"
+    ]
+    assert failed and failed[-1]["where"] == "adopt"
+
+
+def test_handoff_prefers_tier_sharing_sibling(generator):
+    """Two decode candidates, one sharing the source's host tier: the
+    sharer wins even with a later id — its restore path already holds the
+    spilled blocks; any other tier means a full re-prefill."""
+    tier = HostBlockTier(64 << 20)
+    far_tier = HostBlockTier(64 << 20)
+    reps = [
+        _paged(generator, tier, role="prefill"),
+        _paged(generator, far_tier, role="decode"),  # id 1: different tier
+        _paged(generator, tier, role="decode"),      # id 2: shares the tier
+    ]
+    fleet = EngineFleet(reps, routing="prefix")
+    prompt = _enc(VICTIM_TEXT)
+    solo = generator.generate_ids(prompt, GREEDY48)
+    assert fleet.submit(prompt, GREEDY48, timeout=240) == solo
+    assert reps[2].stats_snapshot()["slots_migrated"] == 1
+    assert reps[1].stats_snapshot()["slots_migrated"] == 0
+    assert reps[2].stats_snapshot()["requests_completed"] == 1
+
+
+# ------------------------------------------------- token-split attribution
+
+
+@pytest.mark.parametrize("kind", ["continuous", "paged"])
+def test_token_split_attribution(generator, kind):
+    """``prefill_tokens`` counts prompt positions actually ingested by
+    prefill forwards; ``decode_tokens`` counts decode-tick emissions. The
+    first token rides the prefill forward and lands in NEITHER split, so
+    tokens_served = decode_tokens + completed first tokens."""
+    if kind == "paged":
+        eng = _paged(generator, HostBlockTier(64 << 20))
+    else:
+        eng = ContinuousBatchingEngine(
+            generator, slots=4, buf_len=256, prompt_bucket=64,
+        )
+    prompt = _enc(VICTIM_TEXT)
+    out = eng.submit(prompt, GREEDY, timeout=240)
+    snap = eng.stats_snapshot()
+    assert snap["tokens_served"] == len(out) == 6
+    assert snap["decode_tokens"] == 5
+    assert snap["prefill_tokens"] in (len(prompt) - 1, len(prompt))
+    if kind == "paged":
+        assert snap["prompt_tokens"] == len(prompt)
+        # a repeat prompt reuses cached full blocks: only the tail is
+        # ingested again, and the split reflects the work actually done
+        eng.submit(prompt, GREEDY, timeout=240)
+        snap2 = eng.stats_snapshot()
+        assert snap2["decode_tokens"] == 10
+        assert snap2["prefix_tokens_reused"] > 0
+        assert (
+            snap2["prefill_tokens"]
+            < snap["prefill_tokens"] + len(prompt)
+        )
+
+
+# ------------------------------------------- forecaster staleness decay
+
+
+def test_forecaster_staleness_decay_reads_idle_as_idle():
+    """``update`` only runs when the engine ticks, so an idle replica's
+    EWMAs freeze at the last busy tick's rates. Reads that pass ``now``
+    decay by exp(-gap/tau) — the continuous limit of feeding zero-rate
+    samples over the gap — so a quiet phase can actually fire the
+    scale-down band (the SERVE_ELASTIC guard failure on starved runners).
+    """
+    fc = LoadForecaster(short_tau_s=10.0, long_tau_s=100.0)
+    for i in range(40):
+        fc.update(
+            float(i), arrivals=10 * i, admitted=10 * i, tokens=100 * i,
+            queue_depth=4, live_slots=4,
+            prefill_tokens=40 * i, decode_tokens=60 * i,
+        )
+    rate0 = fc.rate("token_rate")
+    assert rate0 == pytest.approx(100.0, rel=0.05)
+    # no ``now`` (or a read at the last sample) is byte-identical to the
+    # raw EWMAs — every existing caller is unchanged
+    assert fc.rate("token_rate", now=39.0) == rate0
+    assert fc.rate("token_rate", now=20.0) == rate0  # never amplifies
+    # one short tau of silence decays the short read by e^-1
+    assert fc.rate("token_rate", now=49.0) == pytest.approx(
+        rate0 * math.exp(-1.0)
+    )
+    # the split rates decay the same way
+    assert fc.rate("prefill_token_rate", now=49.0) == pytest.approx(
+        fc.rate("prefill_token_rate") * math.exp(-1.0)
+    )
+    # a long-idle forecaster reads as (essentially) zero demand
+    assert fc.rate("token_rate", now=4000.0) < 1e-6
+    assert fc.forecast(60.0, now=4000.0) < 1e-6
+    snap = fc.snapshot(now=139.0)  # gap 100 = 10 short taus, 1 long tau
+    assert snap["rates_short"]["token_rate"] == pytest.approx(
+        rate0 * math.exp(-10.0)
+    )
+    assert snap["rates_long"]["token_rate"] == pytest.approx(
+        fc.rate("token_rate", "long") * math.exp(-1.0)
+    )
+    assert snap["queue_depth"] == pytest.approx(
+        fc.queue_depth * math.exp(-10.0)
+    )
+    # snapshot without ``now`` stays the raw view
+    assert fc.snapshot()["rates_short"]["token_rate"] == rate0
+
+
+# ------------------------------------------------- per-role capacity model
+
+
+def _role_snap(role, prefill_rate, decode_rate, tick_s=0.05):
+    return {
+        "slots": 4,
+        "role": role,
+        "mean_decode_tick_s": tick_s,
+        "mean_tokens_per_step": 0.0,
+        "live_slots_mean": 2.0,
+        "model_flops_utilization": 0.0,
+        "hbm_bandwidth_utilization": 0.0,
+        "forecaster": {
+            "rates_short": {
+                "arrival_rate": 1.0,
+                "admit_rate": 1.0,
+                "token_rate": prefill_rate + decode_rate,
+                "prefill_token_rate": prefill_rate,
+                "decode_token_rate": decode_rate,
+            },
+            "trend_tokens_per_s2": 0.0,
+            "queue_depth": 0.0,
+            "queue_wait_s": 0.0,
+            "live_slots_mean": 2.0,
+        },
+    }
+
+
+def test_report_role_sections_split_demand_and_capacity():
+    # per-replica capacity: 4 slots / 0.05s tick = 80 tokens/s
+    snaps = [
+        _role_snap("prefill", 70.0, 0.0),
+        _role_snap("decode", 0.0, 30.0),
+    ]
+    rep = report_from_capacity_snapshots(snaps, 2)
+    roles = rep["roles"]
+    assert set(roles) == {"prefill", "decode"}
+    pre, dec = roles["prefill"], roles["decode"]
+    assert pre["replicas"] == 1 and pre["dedicated_replicas"] == 1
+    assert pre["demand_tokens_per_s"] == pytest.approx(70.0)
+    assert pre["capacity_tokens_per_s"] == pytest.approx(80.0)
+    assert pre["utilization"] == pytest.approx(70.0 / 80.0)
+    # 87.5% > the up band: the prefill pool wants another replica
+    assert pre["recommended_replicas"] == 2
+    assert dec["demand_tokens_per_s"] == pytest.approx(30.0)
+    assert dec["headroom_tokens_per_s"] == pytest.approx(50.0)
+    assert dec["recommended_replicas"] == 1
+    # a mixed replica is capable of BOTH stages
+    snaps.append(_role_snap("mixed", 10.0, 10.0))
+    roles = report_from_capacity_snapshots(snaps, 3)["roles"]
+    assert roles["prefill"]["replicas"] == 2
+    assert roles["decode"]["replicas"] == 2
+    assert roles["prefill"]["dedicated_replicas"] == 1
+    assert roles["prefill"]["demand_tokens_per_s"] == pytest.approx(80.0)
+    assert roles["decode"]["demand_tokens_per_s"] == pytest.approx(40.0)
+
+
+# ---------------------------------------------------- ratio autoscaling
+
+
+class _RoleScriptedFleet:
+    """The role-aware surface Autoscaler reads, with scripted per-stage
+    demand routed through the REAL pure report."""
+
+    def __init__(self, roles, prefill_demand=0.0, decode_demand=0.0):
+        self.roles = list(roles)
+        self.prefill_demand = prefill_demand
+        self.decode_demand = decode_demand
+        self.recorder = FlightRecorder(64)
+        self.added: list = []
+        self.retired: list = []
+
+    def capacity_report(self, horizon_s=60.0, min_replicas=1,
+                        max_replicas=None):
+        # spread each stage's demand over its dedicated replicas (the
+        # split is summed fleet-wide, so the spread doesn't matter)
+        snaps = []
+        n_pre = max(1, sum(1 for r in self.roles if r != "decode"))
+        n_dec = max(1, sum(1 for r in self.roles if r != "prefill"))
+        for r in self.roles:
+            snaps.append(_role_snap(
+                r,
+                self.prefill_demand / n_pre if r != "decode" else 0.0,
+                self.decode_demand / n_dec if r != "prefill" else 0.0,
+            ))
+        return report_from_capacity_snapshots(
+            snaps, len(self.roles),
+            horizon_s=horizon_s, min_replicas=min_replicas,
+            max_replicas=max_replicas,
+        )
+
+    def add_replica(self, role=None):
+        self.roles.append(role or "mixed")
+        self.added.append(role)
+        return len(self.roles) - 1, object()
+
+    def retire_replica(self, rid=None, timeout_s=60.0, migrate=None,
+                       role=None):
+        if len(self.roles) <= 1:
+            raise ValueError("cannot retire the last replica")
+        self.retired.append(role)
+        if role is not None:
+            self.roles.remove(role)
+        else:
+            self.roles.pop()
+        return rid
+
+
+def test_ratio_autoscaler_grows_starved_role_in_band():
+    """Fleet totals inside the hysteresis band, prefill pool starved:
+    ratio mode takes an up step aimed at the prefill role; without ratio
+    mode the same report produces NO decision."""
+    fleet = _RoleScriptedFleet(
+        ["prefill", "decode"], prefill_demand=95.0, decode_demand=25.0,
+    )  # fleet util 120/160 = 0.75: in band; prefill util 95/80 > up
+    plain = Autoscaler(fleet, mode="on", max_replicas=4, cooldown_s=0.0)
+    assert plain.tick(0.0) is None
+    scaler = Autoscaler(
+        fleet, mode="on", max_replicas=4, cooldown_s=0.0, ratio=True,
+    )
+    d = scaler.tick(0.0)
+    assert d is not None and d["applied"] is True
+    assert d["direction"] == "up" and d["role"] == "prefill"
+    assert d["role_demand_tokens_per_s"]["prefill"] == pytest.approx(95.0)
+    assert fleet.added == ["prefill"]
+    assert fleet.roles == ["prefill", "decode", "prefill"]
+    # the decision is visible in the flight recorder with its role
+    evs = [
+        ev for ev in fleet.recorder.events() if ev["kind"] == "scale_decision"
+    ]
+    assert evs and evs[-1]["role"] == "prefill"
+    assert evs[-1]["applied"] is True
+
+
+def test_ratio_autoscaler_trades_surplus_role_at_max():
+    """At max replicas with a starved prefill pool and an over-provisioned
+    decode pool: ratio mode trades a dedicated decode replica away so the
+    next tick's count recovery can re-add it where it's needed."""
+    fleet = _RoleScriptedFleet(
+        ["prefill", "decode", "decode"],
+        prefill_demand=95.0, decode_demand=60.0,
+    )  # fleet util 155/240 = 0.65: in band; prefill starved, decode cold
+    scaler = Autoscaler(
+        fleet, mode="on", max_replicas=3, cooldown_s=0.0, ratio=True,
+    )
+    d = scaler.tick(0.0)
+    assert d is not None and d["applied"] is True
+    assert d["direction"] == "down" and d["role"] == "decode"
+    assert fleet.retired == ["decode"]
+    assert fleet.roles == ["prefill", "decode"]
+
+
+def test_ratio_autoscaler_count_step_picks_pressured_role():
+    """A count-driven scale-up under ratio mode grows the most-utilized
+    role instead of a default mixed replica."""
+    fleet = _RoleScriptedFleet(
+        ["prefill", "decode"], prefill_demand=190.0, decode_demand=30.0,
+    )  # fleet util 220/160 > up: count wants more replicas
+    scaler = Autoscaler(
+        fleet, mode="on", max_replicas=4, cooldown_s=0.0, ratio=True,
+    )
+    d = scaler.tick(0.0)
+    assert d["direction"] == "up" and d["applied"] is True
+    assert d["role"] == "prefill"
+    assert fleet.added == ["prefill"]
+
+
+def test_ratio_mode_off_keeps_decisions_role_free():
+    fleet = _RoleScriptedFleet(
+        ["prefill", "decode"], prefill_demand=190.0, decode_demand=30.0,
+    )
+    scaler = Autoscaler(fleet, mode="dry-run", max_replicas=4, cooldown_s=0.0)
+    d = scaler.tick(0.0)
+    assert d is not None and "role" not in d
+    assert fleet.added == [] and fleet.retired == []
+
+
+def test_fleet_add_and_retire_replica_by_role(generator):
+    """The fleet ends of the ratio dimension: add_replica(role=...) builds
+    and wires that role, retire_replica(role=...) takes the NEWEST replica
+    of the role, and both stamp the role into their scale events."""
+    tier = HostBlockTier(64 << 20)
+
+    def factory(rid, role=None):
+        return _paged(generator, tier, role=role or "mixed")
+
+    fleet = EngineFleet(
+        [_paged(generator, tier, role="prefill"),
+         _paged(generator, tier, role="decode")],
+        routing="prefix", replica_factory=factory,
+    )
+    rid, rep = fleet.add_replica(role="decode")
+    assert rep.role == "decode" and len(fleet.replicas) == 3
+    ups = [ev for ev in fleet.recorder.events() if ev["kind"] == "scale_up"]
+    assert ups and ups[-1]["role"] == "decode"
+    retired = fleet.retire_replica(role="decode", timeout_s=30)
+    assert retired == rid  # newest decode replica, not the original
+    downs = [
+        ev for ev in fleet.recorder.events() if ev["kind"] == "scale_down"
+    ]
+    assert downs and downs[-1]["role"] == "decode"
+    with pytest.raises(KeyError):
+        fleet.retire_replica(role="mixed")
+    # a grown prefill replica gets the handoff hook wired on the spot
+    rid2, rep2 = fleet.add_replica(role="prefill")
+    assert rep2.role == "prefill" and rep2.handoff is not None

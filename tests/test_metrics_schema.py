@@ -73,6 +73,10 @@ SNAPSHOT_KEYS = {
     "prefix_blocks_spilled", "prefix_blocks_discarded",
     "host_tier_restore_hits", "host_tier_restore_misses",
     "slots_migrated",
+    # disaggregated prefill/decode (infer/engine.py): the prompt/decode
+    # token attribution split and the handoff outcome counters
+    "prefill_tokens", "decode_tokens",
+    "requests_handed_off", "requests_handoff_failed",
     # gauges
     "queue_depth", "live_slots", "engine_generation", "weight_generation",
     # overload control: the brownout controller's current stage (0-3)
@@ -92,6 +96,9 @@ SNAPSHOT_KEYS = {
     "histograms",
     # supervision (engine.stats_snapshot)
     "circuit_state", "draining",
+    # disaggregation: this replica's pool role (mixed/prefill/decode) —
+    # a string, so it rides the info/replica_info label lines
+    "role",
     # XLA introspection (engine.stats_snapshot): the compile-ledger
     # sub-snapshot and the roofline utilization gauges
     "compile", "model_flops_utilization", "hbm_bandwidth_utilization",
@@ -166,6 +173,12 @@ EXPECTED_METRICS = {
     ("serving_host_tier_restores_total", "counter"),
     ("serving_slots_migrated_total", "counter"),
     ("serving_host_tier_bytes", "gauge"),
+    # disaggregated prefill/decode: token attribution split and handoff
+    # outcome counters
+    ("serving_prefill_tokens_total", "counter"),
+    ("serving_decode_tokens_total", "counter"),
+    ("serving_requests_handed_off_total", "counter"),
+    ("serving_requests_handoff_failed_total", "counter"),
     # per-tenant series (tenant="name" labels; TYPE lines are emitted even
     # with zero tenants so the schema is load-independent)
     ("serving_tenant_requests_total", "counter"),
@@ -308,6 +321,9 @@ FLEET_EXTRA_KEYS = {
     "requests_routed_round_robin", "requests_failed_over",
     "requests_rerouted_overflow", "requests_shed_fleet_saturated",
     "requests_shed_fleet_brownout",
+    # disaggregation: role -> {replicas, prefill_tokens, decode_tokens}
+    # aggregation (fleet-only; single engines have no role mix to report)
+    "tokens_by_role",
 }
 
 # The fleet /metrics contract: the single-engine TYPE set plus the router
@@ -328,6 +344,10 @@ FLEET_EXPECTED_METRICS = EXPECTED_METRICS | {
     ("serving_requests_rerouted_overflow_total", "counter"),
     ("serving_requests_shed_fleet_saturated_total", "counter"),
     ("serving_requests_shed_fleet_brownout_total", "counter"),
+    # disaggregation: role-labelled token split + per-role replica counts
+    ("serving_role_prefill_tokens_total", "counter"),
+    ("serving_role_decode_tokens_total", "counter"),
+    ("serving_role_replicas", "gauge"),
 }
 
 
