@@ -291,6 +291,14 @@ class TrainConfig:
     freeze_strategy: str = "last_n_and_head"  # or "none" / "lora" / "qlora"
     unfreeze_last_n_layers: int = 2
 
+    # frozen-trunk compute (ISSUE 20): "bf16" runs frozen layers exactly as
+    # today; "int8" runs the projection matmuls of entirely-frozen leading
+    # layers as w8a8 (per-channel int8 weights x per-row dynamic int8
+    # activations on the MXU int8 path) with a stop_gradient at the
+    # trunk/trainable boundary and no trunk remat. No-op when the freeze
+    # policy leaves trainable leaves in every layer (lora/qlora/none).
+    frozen_compute: str = "bf16"       # or "int8"
+
     # QLoRA quantization (freeze_strategy="qlora": NF4 frozen base)
     quant_block_size: int = 64        # NF4 scale block (QLoRA paper default)
     quant_double_quant: bool = True   # int8-compress the absmax scales
@@ -454,6 +462,7 @@ class TrainConfig:
         "OPTIMIZER": ("optimizer", str),
         "PARAM_DTYPE": ("param_dtype", str),
         "FREEZE_STRATEGY": ("freeze_strategy", str),
+        "FROZEN_COMPUTE": ("frozen_compute", str),
         "REMAT_POLICY": ("remat_policy", str),
         "LOSS_CHUNK_SIZE": ("loss_chunk_size", int),
         "LOSS_VOCAB_CHUNK": ("loss_vocab_chunk", int),

@@ -131,7 +131,8 @@ def init_params(rng, config: ModelConfig, dtype=jnp.float32) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def _linear(x, p, compute_dtype, quant_impl: str = "auto", adapter_idx=None):
+def _linear(x, p, compute_dtype, quant_impl: str = "auto", adapter_idx=None,
+            w8a8: bool = False):
     """x @ kernel (+ bias), with optional additive LoRA branch.
 
     LoRA params, when present (parallel/lora.py), live beside the kernel as
@@ -152,16 +153,24 @@ def _linear(x, p, compute_dtype, quant_impl: str = "auto", adapter_idx=None):
     with sibling leaves ``kernel_nf4`` (+ absmax scales); the matmul then
     runs through the fused Pallas decode kernel or the XLA dequant path.
     Int8 weight-only kernels (inference, ops/int8.py) replace it with
-    ``kernel_int8`` + ``kernel_int8_scale``.
+    ``kernel_int8`` + ``kernel_int8_scale``. ``w8a8=True`` (the frozen-trunk
+    training fast path, TrainConfig.frozen_compute="int8") runs those same
+    leaves as a true int8 x int8 MXU matmul with dynamic per-row activation
+    quantization instead of the weight-only dequant; adapters, biases, and
+    every non-projection op stay in ``compute_dtype``.
     """
     if "kernel_int8" in p:
-        from llm_fine_tune_distributed_tpu.ops.int8 import int8_matmul
+        q = {"int8": p["kernel_int8"], "int8_scale": p["kernel_int8_scale"]}
+        if w8a8:
+            from llm_fine_tune_distributed_tpu.ops.int8_matmul import (
+                int8_w8a8_matmul,
+            )
 
-        y = int8_matmul(
-            x,
-            {"int8": p["kernel_int8"], "int8_scale": p["kernel_int8_scale"]},
-            compute_dtype=compute_dtype,
-        )
+            y = int8_w8a8_matmul(x, q, compute_dtype=compute_dtype)
+        else:
+            from llm_fine_tune_distributed_tpu.ops.int8 import int8_matmul
+
+            y = int8_matmul(x, q, compute_dtype=compute_dtype)
     elif "kernel_nf4" in p:
         from llm_fine_tune_distributed_tpu.ops.nf4 import QUANT_SUFFIXES, nf4_matmul
 
@@ -210,6 +219,7 @@ def _block(
     windowed_mask=None,
     block_tables=None,
     adapter_idx=None,
+    w8a8: bool = False,
 ):
     """One transformer block. Returns (x, new_cache_entry, moe_aux).
 
@@ -229,9 +239,9 @@ def _block(
     attn_p = lp["self_attn"]
 
     hid = rms_norm(x, lp["input_layernorm"]["weight"], eps, zero_centered=zc)
-    q = _linear(hid, attn_p["q_proj"], compute_dtype, quant_impl, adapter_idx).reshape(b, s, config.num_heads, d)
-    k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl, adapter_idx).reshape(b, s, config.num_kv_heads, d)
-    v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl, adapter_idx).reshape(b, s, config.num_kv_heads, d)
+    q = _linear(hid, attn_p["q_proj"], compute_dtype, quant_impl, adapter_idx, w8a8).reshape(b, s, config.num_heads, d)
+    k = _linear(hid, attn_p["k_proj"], compute_dtype, quant_impl, adapter_idx, w8a8).reshape(b, s, config.num_kv_heads, d)
+    v = _linear(hid, attn_p["v_proj"], compute_dtype, quant_impl, adapter_idx, w8a8).reshape(b, s, config.num_kv_heads, d)
 
     if config.qk_norm:
         # Qwen3: per-head RMSNorm over head_dim, before RoPE (HF Qwen3Attention)
@@ -389,7 +399,7 @@ def _block(
         )
 
     out = out.reshape(b, s, config.num_heads * d)
-    attn_out = _linear(out, attn_p["o_proj"], compute_dtype, quant_impl, adapter_idx)
+    attn_out = _linear(out, attn_p["o_proj"], compute_dtype, quant_impl, adapter_idx, w8a8)
     if config.sandwich_norms:
         # Gemma2: post_attention_layernorm norms the attention OUTPUT
         attn_out = rms_norm(
@@ -428,8 +438,8 @@ def _block(
             )
         x = x + moe_out
     else:
-        gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl, adapter_idx)
-        up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype, quant_impl, adapter_idx)
+        gate = _linear(hid, lp["mlp"]["gate_proj"], compute_dtype, quant_impl, adapter_idx, w8a8)
+        up = _linear(hid, lp["mlp"]["up_proj"], compute_dtype, quant_impl, adapter_idx, w8a8)
         # Named so remat_policy="mlp" can save JUST this [b, s, f] product: the
         # gate/up matmuls are ~58% of a block's param FLOPs, so saving their
         # fused output avoids most of full-remat's recompute at one tensor per
@@ -441,7 +451,7 @@ def _block(
         else:
             act = jax.nn.silu(gate)
         prod = checkpoint_name(act * up, "mlp_act")
-        mlp_out = _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl, adapter_idx)
+        mlp_out = _linear(prod, lp["mlp"]["down_proj"], compute_dtype, quant_impl, adapter_idx, w8a8)
         if config.sandwich_norms:
             mlp_out = rms_norm(
                 mlp_out, lp["post_feedforward_layernorm"]["weight"], eps, zero_centered=zc
@@ -471,6 +481,8 @@ def forward(
     quant_impl: str = "auto",
     return_aux: bool = False,
     adapter_idx=None,
+    frozen_layers: int = 0,
+    frozen_compute: str = "bf16",
 ) -> (
     Tuple[jax.Array, Optional[Dict[str, Any]]]
     | Tuple[jax.Array, Optional[Dict[str, Any]], jax.Array]
@@ -499,6 +511,12 @@ def forward(
         params tree carries no ``lora_*_pool`` leaves.
       remat: rematerialize each block on backward
         (analog of reference ``gradient_checkpointing=True``, training.py:280).
+      frozen_layers / frozen_compute: the frozen-trunk fast path
+        (TrainConfig.frozen_compute="int8"): with ``frozen_compute="int8"``
+        and no cache, layers ``[0, frozen_layers)`` run their projection
+        matmuls w8a8 on pre-quantized ``kernel_int8`` siblings, skip remat,
+        and end in a boundary ``stop_gradient``. ``"bf16"`` (default) and
+        the cache path are bit-identical to a model without these kwargs.
       output_hidden: return the final-norm hidden states [batch, seq, hidden]
         (in ``compute_dtype``) instead of logits — the chunked-loss path
         (train/step.py) unembeds chunk-by-chunk so the [batch, seq, vocab]
@@ -611,8 +629,25 @@ def forward(
 
     new_layers = {}
     moe_aux = jnp.float32(0.0)
+    # Frozen-trunk fast path (TrainConfig.frozen_compute="int8"): layers
+    # [0, frozen_layers) carry pre-quantized kernel_int8 siblings and run
+    # their projections w8a8 (ops/int8_matmul). The trunk is a pure
+    # inference forward: no remat wrap (nothing will ever replay it) and a
+    # stop_gradient at the boundary so no cotangent enters it — the
+    # compile-cost guard (tests/test_frozen_trunk.py) pins both.
+    trunk_layers = frozen_layers if (frozen_compute == "int8" and cache is None) else 0
     for i in range(config.num_layers):
         entry = cache["layers"][str(i)] if cache is not None else None
+        in_trunk = i < trunk_layers
+        if in_trunk and i == 0:
+            # trunk ENTRY stop_gradient: with tied embeddings the trunk's
+            # input lookup carries a tangent (embed_tokens is trainable);
+            # killing it here — not just at the exit boundary below — means
+            # autodiff never traces the trunk at all, which the Pallas
+            # w8a8 kernel requires (pallas_call has no JVP rule) and which
+            # drops the same embedding-through-trunk gradient the exit
+            # boundary drops anyway (documented approximation).
+            x = jax.lax.stop_gradient(x)
         block_fn = partial(
             _block,
             config=config,
@@ -624,8 +659,9 @@ def forward(
             windowed_mask=windowed_mask,
             block_tables=block_tables,
             adapter_idx=adapter_idx,
+            w8a8=in_trunk,
         )
-        if remat and cache is None:
+        if remat and cache is None and not in_trunk:
             if remat_policy in (None, "full"):
                 block_fn = jax.checkpoint(block_fn)
             else:
@@ -655,6 +691,13 @@ def forward(
             cache_pos,
         )
         x = constrain(x)
+        if in_trunk and i == trunk_layers - 1:
+            # trunk/trainable boundary: the only gradient path through the
+            # trunk is the (tied) embedding's contribution via the input
+            # lookup — deliberately dropped here (documented approximation,
+            # docs/architecture.md "Training fast path") so the trunk
+            # backward is dead code the compiler eliminates.
+            x = jax.lax.stop_gradient(x)
         moe_aux = moe_aux + layer_aux
         if new_entry is not None:
             new_layers[str(i)] = new_entry

@@ -26,18 +26,23 @@ class ThroughputMeter:
         self._t0 = time.perf_counter()
         self._samples = 0
         self._steps = 0
+        self._real_tokens = 0
         # (timestamp, cumulative_samples) ring for the steady-state window;
         # seeded with t0 so the first window spans step 1..N and the compile
         # falls out of the window once _WINDOW_STEPS+1 entries exist
         self._window = deque([(self._t0, 0)], maxlen=_WINDOW_STEPS + 1)
 
-    def update(self, samples: int, steps: int = 1) -> None:
+    def update(self, samples: int, steps: int = 1, real_tokens: int = 0) -> None:
         """Stamp ``samples`` (and ``steps`` optimizer steps) completed since
         the previous stamp. Callers that sync the device only at logging
         boundaries pass the accumulated interval; the window stores
-        cumulative samples, so per-interval rates stay correct."""
+        cumulative samples, so per-interval rates stay correct.
+        ``real_tokens`` is the attention-mask-weighted token count of the
+        interval — non-pad tokens, the honest numerator for throughput
+        (``tokens_per_second_per_chip`` counts padded slots)."""
         self._samples += samples
         self._steps += steps
+        self._real_tokens += real_tokens
         self._window.append((time.perf_counter(), self._samples))
 
     def rebase(self) -> None:
@@ -78,4 +83,16 @@ class ThroughputMeter:
                 out["samples_per_second_per_chip_steady"] = median / self.n_chips
         if self.tokens_per_sample:
             out["tokens_per_second_per_chip"] = sps * self.tokens_per_sample / self.n_chips
+        if self._real_tokens:
+            # real (non-pad) token throughput + packing efficiency: how much
+            # of each padded [batch, seq] slab carries actual data. A low
+            # ratio says the win is in the loader (packing / bucketing),
+            # not the step — the attribution the padded rate hides.
+            out["real_tokens_per_second_per_chip"] = (
+                self._real_tokens / dt / self.n_chips
+            )
+            if self.tokens_per_sample and self._samples:
+                out["packing_efficiency"] = self._real_tokens / (
+                    self._samples * self.tokens_per_sample
+                )
         return out

@@ -98,6 +98,8 @@ TRAIN_GAUGES = (
     "samples_per_second_per_chip",
     "steps_per_second",
     "tokens_per_second_per_chip",
+    "real_tokens_per_second_per_chip",
+    "packing_efficiency",
     "preempted",
     "model_flops_utilization",
     "hbm_bandwidth_utilization",

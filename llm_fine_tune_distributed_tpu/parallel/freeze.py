@@ -60,6 +60,72 @@ def trainable_mask(params, config: ModelConfig, train: TrainConfig):
     return map_with_path(lambda path, leaf: pred(path), params)
 
 
+def frozen_trunk_boundary(flat_mask: dict, num_layers: int) -> int:
+    """Number of leading *entirely frozen* transformer layers — the trunk.
+
+    ``flat_mask`` is the flattened trainable mask (path -> bool). Returns the
+    earliest layer index with ANY trainable leaf; layers ``[0, boundary)``
+    form the frozen trunk eligible for the int8 fast path
+    (``TrainConfig.frozen_compute``). 0 means "no trunk":
+
+    - ``last_n_and_head`` (unfreeze_last_n_layers=n) -> ``num_layers - n``;
+    - lora/qlora (trainable lora_a/lora_b in every layer) -> 0;
+    - ``none`` (full fine-tune) -> 0.
+
+    Note the boundary is *layer*-based: a trainable non-layer leaf (tied
+    ``embed_tokens``/``lm_head``) does not shrink the trunk. Under int8
+    frozen-compute the tied embedding's gradient contribution *through the
+    trunk's input lookup* is dropped by the boundary ``stop_gradient`` — a
+    documented approximation (docs/architecture.md "Training fast path");
+    the lm_head-side gradient of the tied matrix is unaffected.
+    """
+    boundary = num_layers
+    for path, trainable in flat_mask.items():
+        if not trainable:
+            continue
+        m = _LAYER_RE.search(path)
+        if m:
+            boundary = min(boundary, int(m.group(1)))
+            if boundary == 0:
+                break
+    return boundary
+
+
+def quantize_trunk_int8(frozen: dict, boundary: int):
+    """Quantize the projection kernels of the frozen trunk (layers
+    ``[0, boundary)``) to the serving int8 sibling layout: each 2-D
+    ``.../kernel`` leaf is replaced by ``kernel_int8`` codes +
+    ``kernel_int8_scale`` per-output-channel f32 scales (ops/int8.py).
+    Norms, embeddings, and the MoE router gate pass through unchanged —
+    they run bf16 in the trunk too. Quantize from full precision (before
+    any bf16 cast) so the 8-bit rounding is the only rounding.
+
+    Returns ``(new_flat, n_quantized)``. Shared by the trainer
+    (_prepare_state) and bench.py so the two can never disagree on which
+    leaves the w8a8 fast path covers.
+    """
+    from llm_fine_tune_distributed_tpu.ops.int8 import INT8_SUFFIXES, quantize_int8
+
+    quantized = {}
+    n_quant = 0
+    for k, v in frozen.items():
+        m = _LAYER_RE.search(k)
+        if (
+            m is not None
+            and int(m.group(1)) < boundary
+            and k.endswith("/kernel")
+            and not k.endswith("block_sparse_moe/gate/kernel")
+            and getattr(v, "ndim", 0) == 2
+        ):
+            q = quantize_int8(v)
+            for suffix in INT8_SUFFIXES:
+                quantized[f"{k}_{suffix}"] = q[suffix]
+            n_quant += 1
+        else:
+            quantized[k] = v
+    return quantized, n_quant
+
+
 def describe_trainable(params, mask) -> dict:
     """Trainable-parameter report (the reference prints this at
     ``training.py:147-149``; values recorded into training_summary.json at

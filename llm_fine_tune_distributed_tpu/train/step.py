@@ -186,7 +186,8 @@ def static_seq_parallel_size(
 
 
 def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activation_sharding=None,
-                 quant_impl: Optional[str] = None, include_router_aux: bool = True):
+                 quant_impl: Optional[str] = None, include_router_aux: bool = True,
+                 frozen_layers: int = 0):
     compute_dtype = str_to_dtype(train_config.compute_dtype)
     _mesh = getattr(activation_sharding, "mesh", None)
     seq_parallel = static_seq_parallel_size(model_config, train_config, _mesh)
@@ -199,6 +200,16 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             "(vocab streaming) are mutually exclusive"
         )
     quant_impl = quant_impl or train_config.quant_matmul_impl
+    # Frozen-trunk fast path (ISSUE 20): frozen_layers is the trainable
+    # boundary (parallel/freeze.frozen_trunk_boundary) the trainer computed
+    # from the freeze mask; forward() runs those leading layers w8a8 with a
+    # boundary stop_gradient when frozen_compute="int8". The default "bf16"
+    # (or boundary 0 — lora/qlora/full fine-tune) leaves forward untouched.
+    frozen_compute = getattr(train_config, "frozen_compute", "bf16")
+    if frozen_compute not in ("bf16", "int8"):
+        raise ValueError(
+            f"unknown frozen_compute {frozen_compute!r} (expected 'bf16' or 'int8')"
+        )
     # MoE: add the load-balancing aux loss to the TRAIN objective only (eval
     # loss stays pure CE so perplexity/best-model tracking is comparable with
     # dense runs). Dense models skip the plumbing entirely.
@@ -239,6 +250,8 @@ def make_loss_fn(model_config: ModelConfig, train_config: TrainConfig, activatio
             output_hidden=chunk is not None or vocab_chunk is not None,
             quant_impl=quant_impl,
             return_aux=want_aux,
+            frozen_layers=frozen_layers,
+            frozen_compute=frozen_compute,
         )
         out = result[0]
         targets = batch["input_ids"][:, 1:]
@@ -290,6 +303,7 @@ def build_train_step(
     optimizer: optax.GradientTransformation,
     activation_sharding=None,
     quant_impl: Optional[str] = None,
+    frozen_layers: int = 0,
 ) -> Callable:
     """Returns train_step(state, batch) -> (state, metrics).
 
@@ -298,7 +312,10 @@ def build_train_step(
     the accumulation factor (reference ``gradient_accumulation_steps=4``,
     ``training.py:262``).
     """
-    loss_fn = make_loss_fn(model_config, train_config, activation_sharding, quant_impl)
+    loss_fn = make_loss_fn(
+        model_config, train_config, activation_sharding, quant_impl,
+        frozen_layers=frozen_layers,
+    )
     accum = train_config.gradient_accumulation_steps
 
     def train_step(state: TrainState, batch):
@@ -340,6 +357,7 @@ def build_eval_step(
     train_config: TrainConfig,
     activation_sharding=None,
     quant_impl: Optional[str] = None,
+    frozen_layers: int = 0,
 ) -> Callable:
     """eval_step(state, batch[b, s]) -> (sum_ce, token_count), or
     (sum_ce, tokens, answer_sum_ce, answer_tokens) when the batch carries a
@@ -350,7 +368,7 @@ def build_eval_step(
     ``eval_loss``/best-model tracking (reference ``training.py:273-275``)."""
     loss_fn = make_loss_fn(
         model_config, train_config, activation_sharding, quant_impl,
-        include_router_aux=False,
+        include_router_aux=False, frozen_layers=frozen_layers,
     )
 
     def eval_step(state: TrainState, batch):

@@ -406,6 +406,29 @@ class SFTTrainer:
         # kept for cross-layout checkpoint resume (train/layout.py): the
         # per-leaf mask decides flat-layout trainable membership
         self._flat_mask = flatten_dict(mask)
+        # Frozen-trunk fast path (frozen_compute="int8"): the trainable
+        # boundary is the earliest layer with any trainable leaf; layers
+        # below it run w8a8 (models/transformer forward). 0 = no trunk —
+        # lora/qlora/full fine-tuning resolve to 0 and change nothing.
+        self._frozen_boundary = 0
+        if getattr(cfg, "frozen_compute", "bf16") == "int8":
+            if cfg.objective != "sft":
+                raise ValueError(
+                    "frozen_compute='int8' supports objective='sft' only "
+                    "(the DPO forwards do not thread the trunk boundary)"
+                )
+            from llm_fine_tune_distributed_tpu.parallel.freeze import (
+                frozen_trunk_boundary,
+            )
+
+            self._frozen_boundary = frozen_trunk_boundary(
+                self._flat_mask, mc.num_layers
+            )
+        elif getattr(cfg, "frozen_compute", "bf16") != "bf16":
+            raise ValueError(
+                f"unknown frozen_compute {cfg.frozen_compute!r} "
+                "(expected 'bf16' or 'int8')"
+            )
         if self._pipe_size > 1:
             # Pipeline state representation: per-layer block leaves stacked
             # [num_layers, ...] and sharded over `pipe` (parallel/pipeline.py),
@@ -442,10 +465,27 @@ class SFTTrainer:
                     f"bytes in NF4 (block {cfg.quant_block_size}, "
                     f"double_quant={cfg.quant_double_quant})"
                 )
+        if self._frozen_boundary > 0:
+            # w8a8 trunk: serving int8 sibling layout from FULL precision —
+            # before the bf16 cast, like the QLoRA path (parallel/freeze.py
+            # owns the which-leaves rule, shared with bench.py)
+            from llm_fine_tune_distributed_tpu.parallel.freeze import (
+                quantize_trunk_int8,
+            )
+
+            frozen, n_quant = quantize_trunk_int8(frozen, self._frozen_boundary)
+            if is_primary_host():
+                print(
+                    f"Frozen trunk: layers [0, {self._frozen_boundary}) run "
+                    f"w8a8 int8 ({n_quant} projections quantized)"
+                )
         frozen = {
             k: jnp.asarray(v, compute_dtype)
-            # scales stay f32; packed codes / int8 absmax keep their dtype
-            if jnp.issubdtype(v.dtype, jnp.floating) and "absmax" not in k
+            # scales stay f32; packed codes / int8 + NF4 absmax scales keep
+            # their dtype (kernel_int8_scale must NOT round-trip through bf16)
+            if jnp.issubdtype(v.dtype, jnp.floating)
+            and "absmax" not in k
+            and not k.endswith("int8_scale")
             else jnp.asarray(v)
             for k, v in frozen.items()
         }
@@ -546,6 +586,12 @@ class SFTTrainer:
             # the f32 logits the flag promises to avoid
             problems.append("loss_vocab_chunk (pipeline CE streams by sequence; "
                             "use loss_chunk_size)")
+        if getattr(cfg, "frozen_compute", "bf16") == "int8":
+            # the layer-scan treats every layer identically (stacked leaves,
+            # layer_idx as data) — a per-layer w8a8/bf16 split needs the
+            # unstacked forward
+            problems.append("frozen_compute='int8' (the pipeline layer-scan "
+                            "has no per-layer trunk split)")
         if mc.num_layers % self._pipe_size:
             problems.append(
                 f"{mc.num_layers} layers not divisible by pipe={self._pipe_size}"
@@ -630,9 +676,11 @@ class SFTTrainer:
             )
         else:
             quant_impl = self._resolved_quant_impl()
+            frozen_layers = getattr(self, "_frozen_boundary", 0)
             train_step = build_train_step(
                 self.model_config, self.config, self.optimizer,
                 activation_sharding=act, quant_impl=quant_impl,
+                frozen_layers=frozen_layers,
             )
             self.train_step = instrument(
                 "train_step", jit_train_step(train_step),
@@ -640,7 +688,7 @@ class SFTTrainer:
             )
             self._eval_step_fn = build_eval_step(
                 self.model_config, self.config, activation_sharding=act,
-                quant_impl=quant_impl,
+                quant_impl=quant_impl, frozen_layers=frozen_layers,
             )
         self.eval_step = instrument(
             "eval_step", jax.jit(self._eval_step_fn),
@@ -1039,6 +1087,7 @@ class SFTTrainer:
         final_loss = None
         preempted = False
         pending_samples, synced_step = 0, step
+        pending_real_tokens = 0
 
         # Per-step phase timing into the serving stack's mergeable histogram
         # (observe/tracing.Histogram): where does a step's wall clock go —
@@ -1104,6 +1153,18 @@ class SFTTrainer:
                     self.state, metrics = self.train_step(self.state, dev_batch)
                     step += 1
                     pending_samples += samples_per_step
+                    # real-token accounting for the throughput meter: a host
+                    # numpy mean over the loader's (pre-device) mask — cheap
+                    # next to the step, never touches device buffers, and
+                    # scaling the mean to the GLOBAL token count keeps the
+                    # figure right under multi-host local-shard loading
+                    am = batch.get("attention_mask")
+                    if am is not None and meter.tokens_per_sample:
+                        pending_real_tokens += int(
+                            float(np.mean(am))
+                            * samples_per_step
+                            * meter.tokens_per_sample
+                        )
                     if watchdog is not None:
                         watchdog.poke(step)
                     if self._preempt.is_set():
@@ -1128,8 +1189,13 @@ class SFTTrainer:
                     # correct rates.
                     if do_log or do_eval or do_save:
                         jax.block_until_ready(metrics["loss"])
-                        meter.update(pending_samples, steps=step - synced_step)
+                        meter.update(
+                            pending_samples,
+                            steps=step - synced_step,
+                            real_tokens=pending_real_tokens,
+                        )
                         pending_samples, synced_step = 0, step
+                        pending_real_tokens = 0
                     phase_hist["step"].observe(time.perf_counter() - t_step)
                     profiler.step(step)
 
@@ -1580,6 +1646,29 @@ class SFTTrainer:
                 k: np.asarray(v)
                 for k, v in dequantize_frozen(frozen_flat, jnp.float32).items()
             }
+        if getattr(self, "_frozen_boundary", 0) > 0:
+            # same export contract for the int8 trunk: decode the w8a8
+            # kernels back to plain bf16-exportable kernels
+            from llm_fine_tune_distributed_tpu.ops.int8 import dequantize_int8
+
+            decoded = {}
+            for k, v in frozen_flat.items():
+                if k.endswith("/kernel_int8"):
+                    base = k[: -len("_int8")]
+                    decoded[base] = np.asarray(
+                        dequantize_int8(
+                            {
+                                "int8": jnp.asarray(v),
+                                "int8_scale": jnp.asarray(
+                                    frozen_flat[f"{k}_scale"]
+                                ),
+                            },
+                            jnp.float32,
+                        )
+                    )
+                elif not k.endswith("/kernel_int8_scale"):
+                    decoded[k] = v
+            frozen_flat = decoded
         params = merge_flat(trainable_flat, frozen_flat)
         if cfg.freeze_strategy in ("lora", "qlora"):
             # Export both forms: standalone PEFT adapter (small, composable)

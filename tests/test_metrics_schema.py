@@ -589,6 +589,8 @@ TRAINER_EXPECTED_METRICS = {
     ("training_samples_per_second_per_chip", "gauge"),
     ("training_steps_per_second", "gauge"),
     ("training_tokens_per_second_per_chip", "gauge"),
+    ("training_real_tokens_per_second_per_chip", "gauge"),
+    ("training_packing_efficiency", "gauge"),
     ("training_preempted", "gauge"),
     ("training_model_flops_utilization", "gauge"),
     ("training_hbm_bandwidth_utilization", "gauge"),

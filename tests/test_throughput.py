@@ -49,6 +49,29 @@ def test_meter_multi_step_intervals():
     assert "samples_per_second_per_chip_steady" in snap
 
 
+def test_real_token_accounting():
+    """real_tokens stamps produce the non-pad throughput + packing gauge;
+    without stamps neither key appears (schema only grows when fed)."""
+    m = ThroughputMeter(n_chips=2, tokens_per_sample=10)
+    time.sleep(0.01)
+    m.update(4, real_tokens=30)  # 40 padded slots, 30 real tokens
+    time.sleep(0.01)
+    m.update(4, real_tokens=30)
+    s = m.snapshot()
+    assert abs(s["packing_efficiency"] - 0.75) < 1e-9
+    # real rate = padded rate x packing efficiency, per construction
+    assert abs(
+        s["real_tokens_per_second_per_chip"]
+        - 0.75 * s["tokens_per_second_per_chip"]
+    ) < 1e-6
+
+    bare = ThroughputMeter(n_chips=1, tokens_per_sample=10)
+    bare.update(4)
+    s = bare.snapshot()
+    assert "packing_efficiency" not in s
+    assert "real_tokens_per_second_per_chip" not in s
+
+
 def test_metric_logger_hparams(tmp_path):
     import json
 
